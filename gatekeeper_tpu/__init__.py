@@ -1,0 +1,33 @@
+"""gatekeeper_tpu — a TPU-native policy-evaluation framework.
+
+A ground-up rebuild of the capabilities of OPA Gatekeeper (reference:
+jessica-dl/gatekeeper, an admission webhook + audit engine evaluating
+Rego ConstraintTemplates with an embedded tree-walking interpreter).
+
+Architecture (TPU-first, not a port):
+
+- ``rego/``    — Rego-subset front-end: lexer, parser, conformance checks,
+                 and a scalar interpreter that is the semantics oracle and
+                 the fallback path (replaces vendor OPA ast/ + topdown/).
+- ``ir/``      — vectorized predicate IR; templates lower to column programs
+                 (the analogue of OPA's internal/planner→ir→wasm pipeline,
+                 aimed at XLA instead of Wasm).
+- ``store/``   — columnar inventory store: string interner + flattened
+                 field-path columns (CSR ragged layouts) mirroring the
+                 path-addressed document store.
+- ``engine/``  — the evaluation engines: vectorized JAX evaluator over the
+                 (constraints × resources) matrix, match-mask engine, and
+                 executable cache with shape bucketing.
+- ``ops/``     — device kernels: padded-string ops, batched regex NFA.
+- ``client/``  — the constraint-framework seams: Client / Backend / Driver
+                 interface, plus the ``local`` (scalar) and ``jax`` drivers.
+- ``target/``  — the K8s validation target handler (match semantics,
+                 ProcessData/HandleReview/HandleViolation).
+- ``audit/``, ``webhook/``, ``controllers/``, ``watch/`` — the control
+  plane: audit sweeps, micro-batched admission, reconcilers, dynamic watch.
+- ``cluster/`` — in-memory apiserver fixture (envtest equivalent).
+- ``parallel/``— device meshes, sharded multi-chip audit (shard_map).
+- ``utils/``   — tracing, metrics, HA status, flags.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,73 @@
+"""Framework error taxonomy.
+
+Mirrors the error surfaces of the reference: Rego parse/compile errors are
+reported with code + message + location (so they can land in
+``status.byPod[].errors`` the way the reference records template errors,
+cf. constrainttemplate_controller.go:143-158), while client-level errors
+(unknown template, bad constraint, path conflicts) are distinct types.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+class GatekeeperError(Exception):
+    """Base class for all framework errors."""
+
+
+@dataclasses.dataclass
+class Location:
+    """Source location of a parse/compile diagnostic."""
+
+    row: int = 0
+    col: int = 0
+    file: str = ""
+
+    def __str__(self) -> str:  # pragma: no cover - cosmetic
+        return f"{self.file or '<rego>'}:{self.row}:{self.col}"
+
+
+class RegoError(GatekeeperError):
+    """A Rego front-end error with an error code and location.
+
+    Codes follow the reference's shape (`rego_parse_error`,
+    `rego_type_error`, ...) so status reporting looks familiar.
+    """
+
+    def __init__(self, code: str, message: str, location: Location | None = None):
+        self.code = code
+        self.location = location or Location()
+        super().__init__(f"{code}: {message} ({self.location})")
+        self.message = message
+
+
+class ParseError(RegoError):
+    def __init__(self, message: str, location: Location | None = None):
+        super().__init__("rego_parse_error", message, location)
+
+
+class CompileError(RegoError):
+    def __init__(self, message: str, location: Location | None = None):
+        super().__init__("rego_compile_error", message, location)
+
+
+class TypeError_(RegoError):
+    def __init__(self, message: str, location: Location | None = None):
+        super().__init__("rego_type_error", message, location)
+
+
+class EvalError(GatekeeperError):
+    """Runtime evaluation error (conflict, builtin failure with strictness)."""
+
+
+class ConflictError(EvalError):
+    """Complete rule / function produced two different values."""
+
+
+class StorageError(GatekeeperError):
+    """Path-addressed data store errors (conflicts, missing parents)."""
+
+
+class ClientError(GatekeeperError):
+    """Constraint-framework client errors (bad template/constraint, etc.)."""

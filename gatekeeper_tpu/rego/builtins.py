@@ -1,0 +1,525 @@
+"""Scalar builtin registry.
+
+The reference embeds 103 OPA builtins (vendor opa/topdown/*.go); real
+ConstraintTemplates exercise a few dozen.  This registry implements that
+working set with OPA semantics: builtin *errors* (bad types, unparsable
+numbers) make the expression undefined rather than failing the query, which
+templates rely on (e.g. k8scontainerlimits uses `not canonify_cpu(x)` to
+detect unparsable limits).
+
+Formatting matches OPA: `sprintf` renders composite values in Rego syntax
+(sets as {"a"}, arrays as ["a"]), which is what Gatekeeper's violation
+messages contain.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import re as _re
+from typing import Any, Callable
+
+from gatekeeper_tpu.rego.values import Obj, canon_num, freeze, sorted_values
+
+UNDEFINED = object()  # sentinel: builtin produced no value
+
+
+class BuiltinError(Exception):
+    """Raised by builtins on type/value errors; evaluator maps to undefined."""
+
+
+def rego_repr(v: Any, top: bool = False) -> str:
+    """Render a value the way OPA's ast String()/sprintf %v does."""
+    if v is None:
+        return "null"
+    if v is True:
+        return "true"
+    if v is False:
+        return "false"
+    if isinstance(v, str):
+        return v if top else json.dumps(v)
+    if isinstance(v, (int, float)):
+        return _num_repr(v)
+    if isinstance(v, tuple):
+        return "[" + ", ".join(rego_repr(x) for x in v) + "]"
+    if isinstance(v, frozenset):
+        if not v:
+            return "set()"
+        return "{" + ", ".join(rego_repr(x) for x in sorted_values(v)) + "}"
+    if isinstance(v, Obj):
+        return "{" + ", ".join(f"{rego_repr(k)}: {rego_repr(val)}" for k, val in v.items()) + "}"
+    raise BuiltinError(f"unprintable value {v!r}")
+
+
+def _num_repr(x) -> str:
+    if isinstance(x, int):
+        return str(x)
+    # Go %v for float64 is %g-like
+    s = repr(x)
+    return s
+
+
+def _need_string(x, op: str) -> str:
+    if not isinstance(x, str):
+        raise BuiltinError(f"{op}: operand must be string, got {type(x).__name__}")
+    return x
+
+
+def _need_number(x, op: str):
+    if isinstance(x, bool) or not isinstance(x, (int, float)):
+        raise BuiltinError(f"{op}: operand must be number, got {type(x).__name__}")
+    return x
+
+
+def _need_collection(x, op: str):
+    if isinstance(x, (tuple, frozenset, Obj, str)):
+        return x
+    raise BuiltinError(f"{op}: operand must be a collection or string")
+
+
+def _need_set(x, op: str):
+    if not isinstance(x, frozenset):
+        raise BuiltinError(f"{op}: operand must be set")
+    return x
+
+
+def _need_array(x, op: str):
+    if not isinstance(x, tuple):
+        raise BuiltinError(f"{op}: operand must be array")
+    return x
+
+
+# --- regex (Go RE2 syntax ~ Python re for the common subset) ---
+
+_RE_CACHE: dict[str, "_re.Pattern[str]"] = {}
+
+
+def compile_go_regex(pattern: str) -> "_re.Pattern[str]":
+    pat = _RE_CACHE.get(pattern)
+    if pat is None:
+        try:
+            pat = _re.compile(pattern)
+        except _re.error as e:
+            raise BuiltinError(f"invalid regex {pattern!r}: {e}")
+        _RE_CACHE[pattern] = pat
+    return pat
+
+
+def _re_match(pattern, value):
+    p = compile_go_regex(_need_string(pattern, "re_match"))
+    return p.search(_need_string(value, "re_match")) is not None
+
+
+# --- glob (github.com/gobwas/glob semantics, as vendored by OPA) ---
+
+def _glob_to_regex(pattern: str, delims: tuple[str, ...]) -> str:
+    """Translate a glob to a regex: `*` matches any run NOT crossing a
+    delimiter, `**` crosses them, `?` is one non-delimiter char, `[...]`
+    char classes and `{a,b}` alternates pass through."""
+    delim_cls = "".join(_re.escape(d) for d in delims)
+    single = f"[^{delim_cls}]" if delim_cls else "."
+    out = []
+    i, n = 0, len(pattern)
+    while i < n:
+        c = pattern[i]
+        if c == "*":
+            if i + 1 < n and pattern[i + 1] == "*":
+                out.append(".*")
+                i += 2
+            else:
+                out.append(f"{single}*")
+                i += 1
+        elif c == "?":
+            out.append(single)
+            i += 1
+        elif c == "[":
+            j = i + 1
+            if j < n and pattern[j] in "!^":
+                j += 1
+            if j < n and pattern[j] == "]":
+                j += 1
+            while j < n and pattern[j] != "]":
+                j += 1
+            if j >= n:
+                out.append(_re.escape(c))
+                i += 1
+            else:
+                cls = pattern[i + 1 : j]
+                if cls.startswith("!"):
+                    cls = "^" + cls[1:]
+                out.append(f"[{cls}]")
+                i = j + 1
+        elif c == "{":
+            j = pattern.find("}", i)
+            if j < 0:
+                out.append(_re.escape(c))
+                i += 1
+            else:
+                alts = pattern[i + 1 : j].split(",")
+                out.append("(?:" + "|".join(
+                    _glob_to_regex(a, delims)[2:-2] or "" for a in alts) + ")")
+                i = j + 1
+        else:
+            out.append(_re.escape(c))
+            i += 1
+    return r"\A" + "".join(out) + r"\Z"
+
+
+def _glob_match(pattern, delimiters, value):
+    pat = _need_string(pattern, "glob.match")
+    val = _need_string(value, "glob.match")
+    if delimiters is None:
+        delims: tuple[str, ...] = (".",)
+    elif isinstance(delimiters, tuple):
+        delims = tuple(_need_string(d, "glob.match") for d in delimiters)
+        if not delims:
+            delims = ()
+    else:
+        raise BuiltinError("glob.match: delimiters must be array or null")
+    key = ("glob", pat, delims)
+    rx = _RE_CACHE.get(key)  # type: ignore[arg-type]
+    if rx is None:
+        try:
+            rx = _re.compile(_glob_to_regex(pat, delims))
+        except _re.error as e:
+            raise BuiltinError(f"glob.match: bad pattern {pat!r}: {e}")
+        _RE_CACHE[key] = rx  # type: ignore[index]
+    return rx.match(val) is not None
+
+
+# --- sprintf ---
+
+_VERB = _re.compile(r"%[-+# 0]*\d*(?:\.\d+)?[vdsfgtexXoqb%]")
+
+
+def opa_sprintf(fmt: str, args) -> str:
+    fmt = _need_string(fmt, "sprintf")
+    arglist = list(_need_array(args, "sprintf"))
+    out = []
+    pos = 0
+    idx = 0
+    for m in _VERB.finditer(fmt):
+        out.append(fmt[pos : m.start()])
+        pos = m.end()
+        verb = m.group(0)
+        kind = verb[-1]
+        if kind == "%":
+            out.append("%")
+            continue
+        if idx >= len(arglist):
+            out.append(f"%!{kind}(MISSING)")
+            continue
+        a = arglist[idx]
+        idx += 1
+        if kind == "v":
+            out.append(rego_repr(a, top=True))
+        elif kind in "dxXob":
+            try:
+                iv = int(a)
+            except (TypeError, ValueError):
+                out.append(f"%!{kind}({a!r})")
+                continue
+            base = {"d": "d", "x": "x", "X": "X", "o": "o", "b": "b"}[kind]
+            out.append(format(iv, base))
+        elif kind in "fge":
+            try:
+                out.append(verb.replace("v", kind) % float(a))
+            except (TypeError, ValueError):
+                out.append(f"%!{kind}({a!r})")
+        elif kind == "s":
+            out.append(a if isinstance(a, str) else rego_repr(a, top=True))
+        elif kind == "q":
+            out.append(json.dumps(a if isinstance(a, str) else rego_repr(a, top=True)))
+        elif kind == "t":
+            out.append("true" if a is True else "false" if a is False else f"%!t({a!r})")
+    out.append(fmt[pos:])
+    return "".join(out)
+
+
+# --- numbers ---
+
+def _to_number(x):
+    if isinstance(x, bool):
+        return 1 if x else 0
+    if isinstance(x, (int, float)):
+        return x
+    if x is None:
+        return 0
+    if isinstance(x, str):
+        try:
+            return canon_num(json.loads(x)) if _NUMRE.match(x) else _raise_num(x)
+        except (json.JSONDecodeError, ValueError):
+            raise BuiltinError(f"to_number: cannot parse {x!r}")
+    raise BuiltinError(f"to_number: bad operand {x!r}")
+
+
+_NUMRE = _re.compile(r"^-?(0|[1-9][0-9]*)(\.[0-9]+)?([eE][-+]?[0-9]+)?$")
+
+
+def _raise_num(x):
+    raise BuiltinError(f"to_number: cannot parse {x!r}")
+
+
+def _count(x):
+    c = _need_collection(x, "count")
+    return len(c)
+
+
+def _sum(x):
+    if isinstance(x, (tuple, frozenset)):
+        total = 0
+        for v in x:
+            total += _need_number(v, "sum")
+        return canon_num(total)
+    raise BuiltinError("sum: operand must be array or set")
+
+
+def _product(x):
+    if isinstance(x, (tuple, frozenset)):
+        total = 1
+        for v in x:
+            total *= _need_number(v, "product")
+        return canon_num(total)
+    raise BuiltinError("product: operand must be array or set")
+
+
+def _max(x):
+    if isinstance(x, (tuple, frozenset)) and len(x):
+        return sorted_values(x)[-1]
+    raise BuiltinError("max: empty or non-collection")
+
+
+def _min(x):
+    if isinstance(x, (tuple, frozenset)) and len(x):
+        return sorted_values(x)[0]
+    raise BuiltinError("min: empty or non-collection")
+
+
+def _abs(x):
+    return canon_num(abs(_need_number(x, "abs")))
+
+
+def _round(x):
+    # Go math.Round: half away from zero (floor(x+0.5) would send -0.5 to 0)
+    v = _need_number(x, "round")
+    return int(math.floor(v + 0.5)) if v >= 0 else int(math.ceil(v - 0.5))
+
+
+def _ceil(x):
+    return int(math.ceil(_need_number(x, "ceil")))
+
+
+def _floor(x):
+    return int(math.floor(_need_number(x, "floor")))
+
+
+# --- strings ---
+
+def _concat(delim, coll):
+    d = _need_string(delim, "concat")
+    if isinstance(coll, tuple):
+        items = list(coll)
+    elif isinstance(coll, frozenset):
+        items = sorted_values(coll)
+    else:
+        raise BuiltinError("concat: operand must be array or set")
+    for i in items:
+        _need_string(i, "concat")
+    return d.join(items)
+
+
+def _split(s, delim):
+    return tuple(_need_string(s, "split").split(_need_string(delim, "split")))
+
+
+def _substring(s, start, length):
+    s = _need_string(s, "substring")
+    start = int(_need_number(start, "substring"))
+    length = int(_need_number(length, "substring"))
+    if start < 0:
+        raise BuiltinError("substring: negative start")
+    if start >= len(s):
+        return ""
+    if length < 0:
+        return s[start:]
+    return s[start : start + length]
+
+
+def _trim(s, cutset):
+    return _need_string(s, "trim").strip(_need_string(cutset, "trim"))
+
+
+def _indexof(s, sub):
+    return _need_string(s, "indexof").find(_need_string(sub, "indexof"))
+
+
+def _format_int(x, base):
+    return format(int(_need_number(x, "format_int")), {2: "b", 8: "o", 10: "d", 16: "x"}[int(base)])
+
+
+# --- aggregates over bools ---
+
+def _all(x):
+    if isinstance(x, (tuple, frozenset)):
+        return all(v is True for v in x)
+    raise BuiltinError("all: operand must be array or set")
+
+
+def _any(x):
+    if isinstance(x, (tuple, frozenset)):
+        return any(v is True for v in x)
+    raise BuiltinError("any: operand must be array or set")
+
+
+# --- sets/arrays/objects ---
+
+def _sort(x):
+    if isinstance(x, (tuple, frozenset)):
+        return tuple(sorted_values(x))
+    raise BuiltinError("sort: operand must be array or set")
+
+
+def _array_concat(a, b):
+    return _need_array(a, "array.concat") + _need_array(b, "array.concat")
+
+
+def _array_slice(a, lo, hi):
+    arr = _need_array(a, "array.slice")
+    lo = max(0, int(_need_number(lo, "array.slice")))
+    hi = min(len(arr), int(_need_number(hi, "array.slice")))
+    return arr[lo:hi] if lo < hi else ()
+
+
+def _intersection(sets):
+    ss = _need_set(sets, "intersection")
+    result = None
+    for s in ss:
+        s = _need_set(s, "intersection")
+        result = s if result is None else result & s
+    return result if result is not None else frozenset()
+
+
+def _union(sets):
+    ss = _need_set(sets, "union")
+    result = frozenset()
+    for s in ss:
+        result |= _need_set(s, "union")
+    return result
+
+
+def _object_get(obj, key, default):
+    if not isinstance(obj, Obj):
+        raise BuiltinError("object.get: operand must be object")
+    return obj[key] if key in obj else default
+
+
+def _cast_array(x):
+    if isinstance(x, tuple):
+        return x
+    if isinstance(x, frozenset):
+        return tuple(sorted_values(x))
+    raise BuiltinError("cast_array: operand must be array or set")
+
+
+def _cast_set(x):
+    if isinstance(x, frozenset):
+        return x
+    if isinstance(x, tuple):
+        return frozenset(x)
+    raise BuiltinError("cast_set: operand must be array or set")
+
+
+def _to_set_members(x):
+    """Members iterable for set(x) style coercions."""
+    if isinstance(x, (tuple, frozenset)):
+        return x
+    raise BuiltinError("expected array or set")
+
+
+# --- json ---
+
+def _json_marshal(x):
+    from gatekeeper_tpu.rego.values import thaw
+
+    return json.dumps(thaw(x), separators=(",", ":"), sort_keys=False)
+
+
+def _json_unmarshal(s):
+    try:
+        return freeze(json.loads(_need_string(s, "json.unmarshal")))
+    except json.JSONDecodeError as e:
+        raise BuiltinError(f"json.unmarshal: {e}")
+
+
+# --- type checks ---
+
+def _is_number(x):
+    return not isinstance(x, bool) and isinstance(x, (int, float))
+
+
+REGISTRY: dict[tuple[str, ...], Callable] = {
+    # aggregates
+    ("count",): _count,
+    ("sum",): _sum,
+    ("product",): _product,
+    ("max",): _max,
+    ("min",): _min,
+    ("all",): _all,
+    ("any",): _any,
+    ("sort",): _sort,
+    # numbers
+    ("abs",): _abs,
+    ("round",): _round,
+    ("ceil",): _ceil,
+    ("floor",): _floor,
+    ("to_number",): _to_number,
+    # strings
+    ("startswith",): lambda s, p: _need_string(s, "startswith").startswith(_need_string(p, "startswith")),
+    ("endswith",): lambda s, p: _need_string(s, "endswith").endswith(_need_string(p, "endswith")),
+    ("contains",): lambda s, p: _need_string(p, "contains") in _need_string(s, "contains"),
+    ("concat",): _concat,
+    ("split",): _split,
+    ("replace",): lambda s, old, new: _need_string(s, "replace").replace(
+        _need_string(old, "replace"), _need_string(new, "replace")),
+    ("substring",): _substring,
+    ("sprintf",): opa_sprintf,
+    ("lower",): lambda s: _need_string(s, "lower").lower(),
+    ("upper",): lambda s: _need_string(s, "upper").upper(),
+    ("trim",): _trim,
+    ("trim_space",): lambda s: _need_string(s, "trim_space").strip(),
+    ("trim_prefix",): lambda s, p: s[len(p):] if _need_string(s, "trim_prefix").startswith(_need_string(p, "trim_prefix")) else s,
+    ("trim_suffix",): lambda s, p: s[: len(s) - len(p)] if _need_string(s, "trim_suffix").endswith(_need_string(p, "trim_suffix")) else s,
+    ("indexof",): _indexof,
+    ("format_int",): _format_int,
+    # regex / glob
+    ("re_match",): _re_match,
+    ("regex", "match"): _re_match,
+    ("glob", "match"): _glob_match,
+    # arrays / sets / objects
+    ("array", "concat"): _array_concat,
+    ("array", "slice"): _array_slice,
+    ("intersection",): _intersection,
+    ("union",): _union,
+    ("object", "get"): _object_get,
+    ("cast_array",): _cast_array,
+    ("cast_set",): _cast_set,
+    # json
+    ("json", "marshal"): _json_marshal,
+    ("json", "unmarshal"): _json_unmarshal,
+    # types
+    ("is_number",): _is_number,
+    ("is_string",): lambda x: isinstance(x, str),
+    ("is_boolean",): lambda x: isinstance(x, bool),
+    ("is_array",): lambda x: isinstance(x, tuple),
+    ("is_object",): lambda x: isinstance(x, Obj),
+    ("is_set",): lambda x: isinstance(x, frozenset),
+    ("is_null",): lambda x: x is None,
+    ("type_name",): lambda x: (
+        "null" if x is None else
+        "boolean" if isinstance(x, bool) else
+        "number" if isinstance(x, (int, float)) else
+        "string" if isinstance(x, str) else
+        "array" if isinstance(x, tuple) else
+        "set" if isinstance(x, frozenset) else
+        "object"),
+}

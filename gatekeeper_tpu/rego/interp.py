@@ -1,0 +1,567 @@
+"""Scalar Rego-subset interpreter — the semantics oracle.
+
+Replaces the reference's tree-walking evaluator (vendor
+opa/topdown/eval.go — the `eval/evalExpr/biunify` core that is the hot
+loop of both admission and audit, cf. SURVEY.md §3.2/3.3) for the template
+subset.  The vectorized device engine is property-tested against this
+implementation, and templates that cannot be lowered run here, restricted
+to match-mask candidate pairs.
+
+Semantics notes (OPA-compatible):
+- undefined propagates: missing keys / failed builtins produce no results;
+- statement truthiness: only `false` and undefined fail;
+- `not e` succeeds iff e has no truthy result;
+- complete rules / functions raise ConflictError on two distinct outputs;
+- partial-set rules union results across clauses.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Iterator
+
+from gatekeeper_tpu.errors import ConflictError, EvalError
+from gatekeeper_tpu.rego import builtins as bi
+from gatekeeper_tpu.rego.ast_nodes import (
+    ArrayTerm, Assign, BinOp, Call, Compare, Comprehension, Literal, Module,
+    ObjectTerm, Ref, Rule, Scalar, SetTerm, SomeDecl, Term, UnaryMinus, Var,
+)
+from gatekeeper_tpu.rego.values import Obj, canon_num, freeze, is_truthy, _sort_key
+
+UNDEFINED = bi.UNDEFINED
+
+_MAX_DEPTH = 64
+
+
+@dataclasses.dataclass
+class _Ctx:
+    input: Any            # frozen value or UNDEFINED
+    data: Any             # frozen Obj
+    tracer: list | None
+    memo: dict
+    depth: int = 0
+
+
+class Interpreter:
+    """Evaluates rules of one module against (input, data) documents."""
+
+    def __init__(self, module: Module):
+        from gatekeeper_tpu.rego.reorder import reorder_module
+
+        self.module = reorder_module(module)
+        self.rules: dict[str, list[Rule]] = {}
+        for r in self.module.rules:
+            self.rules.setdefault(r.name, []).append(r)
+
+    # ------------------------------------------------------------------
+    # public entry points
+
+    def query_set(self, name: str, input_doc: Any = UNDEFINED,
+                  data_doc: Any = None, tracer: list | None = None) -> list:
+        """Evaluate a partial-set rule; returns its members (frozen values)."""
+        ctx = self._ctx(input_doc, data_doc, tracer)
+        out, seen = [], set()
+        for rule in self.rules.get(name, []):
+            if rule.kind != "partial_set":
+                continue
+            for env in self._eval_body(ctx, rule.body, 0, {}):
+                for v, _ in self._eval_term(ctx, rule.key, env):
+                    if v not in seen:
+                        seen.add(v)
+                        out.append(v)
+        return out
+
+    def query_value(self, name: str, input_doc: Any = UNDEFINED,
+                    data_doc: Any = None, tracer: list | None = None) -> Any:
+        """Evaluate a complete rule's value; UNDEFINED if no clause fires."""
+        ctx = self._ctx(input_doc, data_doc, tracer)
+        return self._rule_value(ctx, name)
+
+    def _ctx(self, input_doc, data_doc, tracer) -> _Ctx:
+        if input_doc is not UNDEFINED:
+            input_doc = freeze(input_doc)
+        data = freeze(data_doc) if data_doc is not None else Obj()
+        return _Ctx(input=input_doc, data=data, tracer=tracer, memo={})
+
+    # ------------------------------------------------------------------
+    # rule evaluation
+
+    def _rule_value(self, ctx: _Ctx, name: str) -> Any:
+        key = ("rule", name)
+        if key in ctx.memo:
+            v = ctx.memo[key]
+            if v is _IN_PROGRESS:
+                raise EvalError(f"recursive rule reference: {name}")
+            return v
+        ctx.memo[key] = _IN_PROGRESS
+        rules = self.rules.get(name, [])
+        value = UNDEFINED
+        if rules and rules[0].kind == "partial_set":
+            members = []
+            seen: set = set()
+            for rule in rules:
+                if rule.is_default:
+                    continue
+                for env in self._eval_body(ctx, rule.body, 0, {}):
+                    for v, _ in self._eval_term(ctx, rule.key, env):
+                        if v not in seen:
+                            seen.add(v)
+                            members.append(v)
+            value = frozenset(members)
+        elif rules and rules[0].kind == "partial_obj":
+            pairs: dict = {}
+            for rule in rules:
+                for env in self._eval_body(ctx, rule.body, 0, {}):
+                    for k, env2 in self._eval_term(ctx, rule.key, env):
+                        for v, _ in self._eval_term(ctx, rule.value, env2):
+                            if k in pairs and not (pairs[k] == v and _same_kind(pairs[k], v)):
+                                raise ConflictError(
+                                    f"partial object rule {name}: conflicting values for key {k!r}")
+                            pairs[k] = v
+            value = Obj(pairs)
+        else:
+            results: list = []
+            default_val = UNDEFINED
+            for rule in rules:
+                if rule.is_default:
+                    for v, _ in self._eval_term(ctx, rule.value, {}):
+                        default_val = v
+                    continue
+                for env in self._eval_body(ctx, rule.body, 0, {}):
+                    if rule.value is None:
+                        v = True
+                    else:
+                        got = list(self._eval_term(ctx, rule.value, env))
+                        if not got:
+                            continue
+                        v = got[0][0]
+                    if not _contains(results, v):
+                        results.append(v)
+            if len(results) > 1:
+                raise ConflictError(f"complete rule {name} produced multiple values")
+            value = results[0] if results else default_val
+        ctx.memo[key] = value
+        return value
+
+    def _call_function(self, ctx: _Ctx, name: str, argvals: tuple) -> Any:
+        if ctx.depth > _MAX_DEPTH:
+            raise EvalError(f"max call depth exceeded in {name}")
+        rules = self.rules.get(name, [])
+        outputs: list = []
+        ctx = dataclasses.replace(ctx, depth=ctx.depth + 1, memo=ctx.memo)
+        for rule in rules:
+            if rule.kind != "function" or len(rule.args or ()) != len(argvals):
+                continue
+            for env in self._match_args(ctx, rule.args, argvals, {}):
+                for env2 in self._eval_body(ctx, rule.body, 0, env):
+                    if rule.value is None:
+                        v = True
+                    else:
+                        got = list(self._eval_term(ctx, rule.value, env2))
+                        if not got:
+                            continue
+                        v = got[0][0]
+                    if not _contains(outputs, v):
+                        outputs.append(v)
+        # OPA: all function clauses that fire must agree on the output
+        if len(outputs) > 1:
+            raise ConflictError(f"function {name} produced multiple values for one input")
+        return outputs[0] if outputs else UNDEFINED
+
+    def _match_args(self, ctx: _Ctx, params, argvals, env) -> Iterator[dict]:
+        def rec(i, env):
+            if i == len(argvals):
+                yield env
+                return
+            for env2 in self._match_pattern(ctx, params[i], argvals[i], env):
+                yield from rec(i + 1, env2)
+        yield from rec(0, env)
+
+    # ------------------------------------------------------------------
+    # body / literal evaluation
+
+    def _eval_body(self, ctx: _Ctx, body, i: int, env: dict) -> Iterator[dict]:
+        if i >= len(body):
+            yield env
+            return
+        for env2 in self._eval_literal(ctx, body[i], env):
+            yield from self._eval_body(ctx, body, i + 1, env2)
+
+    def _eval_literal(self, ctx: _Ctx, lit: Literal, env: dict) -> Iterator[dict]:
+        if isinstance(lit.expr, SomeDecl):
+            env2 = {k: v for k, v in env.items() if k not in lit.expr.names}
+            yield env2
+            return
+        if lit.withs:
+            ctx = self._apply_withs(ctx, lit.withs, env)
+            if ctx is None:  # a with-value was undefined => literal undefined
+                return
+        if lit.negated:
+            for _ in self._eval_expr(ctx, lit.expr, env):
+                return
+            yield env
+            return
+        yield from self._eval_expr(ctx, lit.expr, env)
+
+    def _apply_withs(self, ctx: _Ctx, withs, env) -> _Ctx | None:
+        from gatekeeper_tpu.rego.values import thaw
+
+        new_input, new_data = ctx.input, ctx.data
+        for w in withs:
+            vals = list(self._eval_term(ctx, w.value, env))
+            if not vals:
+                return None  # undefined with-value makes the literal undefined
+            value = vals[0][0]
+            names = [w.target.base.name] + [
+                p.value for p in w.target.path if isinstance(p, Scalar)]
+            if names == ["input"]:
+                new_input = value
+            elif names[0] == "data":
+                doc = thaw(new_data)
+                cur = doc
+                for part in names[1:-1]:
+                    cur = cur.setdefault(part, {})
+                if len(names) > 1:
+                    cur[names[-1]] = thaw(value)
+                    new_data = freeze(doc)
+                else:
+                    new_data = value
+            else:
+                raise EvalError(f"unsupported with target: {'.'.join(names)}")
+        return dataclasses.replace(ctx, input=new_input, data=new_data,
+                                   memo={})  # memo invalidated under overrides
+
+    def _eval_expr(self, ctx: _Ctx, expr, env: dict) -> Iterator[dict]:
+        if isinstance(expr, Assign):
+            yield from self._unify(ctx, expr.lhs, expr.rhs, env)
+            return
+        if isinstance(expr, Compare):
+            for lv, env1 in self._eval_term(ctx, expr.lhs, env):
+                for rv, env2 in self._eval_term(ctx, expr.rhs, env1):
+                    if _compare(expr.op, lv, rv):
+                        yield env2
+            return
+        # plain term used as statement
+        for v, env2 in self._eval_term(ctx, expr, env):
+            if is_truthy(v):
+                yield env2
+
+    # ------------------------------------------------------------------
+    # unification
+
+    def _unify(self, ctx: _Ctx, lhs, rhs, env: dict) -> Iterator[dict]:
+        if self._is_pattern(lhs, env):
+            for rv, env2 in self._eval_term(ctx, rhs, env):
+                yield from self._match_pattern(ctx, lhs, rv, env2)
+        elif self._is_pattern(rhs, env):
+            for lv, env2 in self._eval_term(ctx, lhs, env):
+                yield from self._match_pattern(ctx, rhs, lv, env2)
+        else:
+            for lv, env1 in self._eval_term(ctx, lhs, env):
+                for rv, env2 in self._eval_term(ctx, rhs, env1):
+                    if lv == rv and _same_kind(lv, rv):
+                        yield env2
+
+    def _is_pattern(self, term: Term, env: dict) -> bool:
+        """Does term contain unbound vars in binding positions?"""
+        if isinstance(term, Var):
+            return term.name not in env and term.name not in self.rules
+        if isinstance(term, ArrayTerm):
+            return any(self._is_pattern(t, env) for t in term.items)
+        if isinstance(term, ObjectTerm):
+            return any(self._is_pattern(v, env) for _, v in term.pairs)
+        return False
+
+    def _match_pattern(self, ctx: _Ctx, pat: Term, value, env: dict) -> Iterator[dict]:
+        if isinstance(pat, Var):
+            if pat.name in env:
+                if env[pat.name] == value and _same_kind(env[pat.name], value):
+                    yield env
+            elif pat.name in self.rules:
+                rv = self._rule_value(ctx, pat.name)
+                if rv is not UNDEFINED and rv == value:
+                    yield env
+            else:
+                env2 = dict(env)
+                env2[pat.name] = value
+                yield env2
+            return
+        if isinstance(pat, ArrayTerm):
+            if isinstance(value, tuple) and len(value) == len(pat.items):
+                def rec(i, env):
+                    if i == len(pat.items):
+                        yield env
+                        return
+                    for env2 in self._match_pattern(ctx, pat.items[i], value[i], env):
+                        yield from rec(i + 1, env2)
+                yield from rec(0, env)
+            return
+        if isinstance(pat, ObjectTerm):
+            # OPA object unification requires identical key sets, not subset
+            if isinstance(value, Obj) and len(pat.pairs) == len(value):
+                def rec(i, env):
+                    if i == len(pat.pairs):
+                        yield env
+                        return
+                    kterm, vterm = pat.pairs[i]
+                    for kv, env1 in self._eval_term(ctx, kterm, env):
+                        if kv in value:
+                            for env2 in self._match_pattern(ctx, vterm, value[kv], env1):
+                                yield from rec(i + 1, env2)
+                yield from rec(0, env)
+            return
+        # ground term: evaluate and compare
+        for pv, env2 in self._eval_term(ctx, pat, env):
+            if pv == value and _same_kind(pv, value):
+                yield env2
+
+    # ------------------------------------------------------------------
+    # term evaluation
+
+    def _eval_term(self, ctx: _Ctx, term: Term, env: dict) -> Iterator[tuple[Any, dict]]:
+        if isinstance(term, Scalar):
+            yield canon_num(term.value) if isinstance(term.value, (int, float)) else term.value, env
+            return
+        if isinstance(term, Var):
+            name = term.name
+            if name in env:
+                yield env[name], env
+                return
+            if name == "input":
+                if ctx.input is not UNDEFINED:
+                    yield ctx.input, env
+                return
+            if name == "data":
+                yield ctx.data, env
+                return
+            if name in self.rules:
+                v = self._rule_value(ctx, name)
+                if v is not UNDEFINED:
+                    yield v, env
+                return
+            raise EvalError(f"unsafe variable: {name}")
+        if isinstance(term, Ref):
+            for base_v, env1 in self._eval_term(ctx, term.base, env):
+                yield from self._walk_ref(ctx, base_v, term.path, 0, env1)
+            return
+        if isinstance(term, ArrayTerm):
+            yield from self._eval_seq(ctx, term.items, env, tuple)
+            return
+        if isinstance(term, SetTerm):
+            yield from self._eval_seq(ctx, term.items, env, frozenset)
+            return
+        if isinstance(term, ObjectTerm):
+            def rec_obj(i, env, acc):
+                if i == len(term.pairs):
+                    yield Obj(acc), env
+                    return
+                kt, vt = term.pairs[i]
+                for kv, env1 in self._eval_term(ctx, kt, env):
+                    for vv, env2 in self._eval_term(ctx, vt, env1):
+                        yield from rec_obj(i + 1, env2, acc + [(kv, vv)])
+            yield from rec_obj(0, env, [])
+            return
+        if isinstance(term, BinOp):
+            for lv, env1 in self._eval_term(ctx, term.lhs, env):
+                for rv, env2 in self._eval_term(ctx, term.rhs, env1):
+                    v = _binop(term.op, lv, rv)
+                    if v is not UNDEFINED:
+                        yield v, env2
+            return
+        if isinstance(term, UnaryMinus):
+            for v, env1 in self._eval_term(ctx, term.operand, env):
+                if isinstance(v, (int, float)) and not isinstance(v, bool):
+                    yield canon_num(-v), env1
+            return
+        if isinstance(term, Call):
+            yield from self._eval_call(ctx, term, env)
+            return
+        if isinstance(term, Comprehension):
+            yield self._eval_comprehension(ctx, term, env), env
+            return
+        raise EvalError(f"cannot evaluate term {term!r}")
+
+    def _eval_seq(self, ctx, items, env, ctor) -> Iterator[tuple[Any, dict]]:
+        def rec(i, env, acc):
+            if i == len(items):
+                yield ctor(acc), env
+                return
+            for v, env2 in self._eval_term(ctx, items[i], env):
+                yield from rec(i + 1, env2, acc + [v])
+        yield from rec(0, env, [])
+
+    def _walk_ref(self, ctx: _Ctx, value, path, i: int, env: dict) -> Iterator[tuple[Any, dict]]:
+        if i == len(path):
+            yield value, env
+            return
+        op = path[i]
+        if isinstance(op, Var) and op.name not in env and op.name not in self.rules \
+                and op.name not in ("input", "data"):
+            # unbound var: iterate the collection, binding the key/index/member
+            if isinstance(value, Obj):
+                for k, v in value.items():
+                    env2 = dict(env)
+                    env2[op.name] = k
+                    yield from self._walk_ref(ctx, v, path, i + 1, env2)
+            elif isinstance(value, tuple):
+                for idx, v in enumerate(value):
+                    env2 = dict(env)
+                    env2[op.name] = idx
+                    yield from self._walk_ref(ctx, v, path, i + 1, env2)
+            elif isinstance(value, frozenset):
+                for m in value:
+                    env2 = dict(env)
+                    env2[op.name] = m
+                    yield from self._walk_ref(ctx, m, path, i + 1, env2)
+            return
+        for kv, env2 in self._eval_term(ctx, op, env):
+            if isinstance(value, Obj):
+                if kv in value:
+                    yield from self._walk_ref(ctx, value[kv], path, i + 1, env2)
+            elif isinstance(value, tuple):
+                if isinstance(kv, int) and not isinstance(kv, bool) and 0 <= kv < len(value):
+                    yield from self._walk_ref(ctx, value[kv], path, i + 1, env2)
+            elif isinstance(value, frozenset):
+                if kv in value:
+                    yield from self._walk_ref(ctx, kv, path, i + 1, env2)
+        return
+
+    def _eval_call(self, ctx: _Ctx, term: Call, env: dict) -> Iterator[tuple[Any, dict]]:
+        name = term.name
+        if name == ("trace",):
+            for v, env2 in self._eval_term(ctx, term.args[0], env):
+                if ctx.tracer is not None:
+                    ctx.tracer.append(str(v))
+                yield True, env2
+            return
+        if name == ("internal", "compare"):
+            op_t = term.args[0]
+            assert isinstance(op_t, Scalar)
+            for lv, env1 in self._eval_term(ctx, term.args[1], env):
+                for rv, env2 in self._eval_term(ctx, term.args[2], env1):
+                    yield _compare(str(op_t.value), lv, rv), env2
+            return
+        if len(name) == 1 and name[0] in self.rules:
+            # user-defined function
+            for argvals, env2 in self._eval_seq(ctx, term.args, env, tuple):
+                v = self._call_function(ctx, name[0], argvals)
+                if v is not UNDEFINED:
+                    yield v, env2
+            return
+        fn = bi.REGISTRY.get(name)
+        if fn is None:
+            raise EvalError(f"unknown function: {'.'.join(name)}")
+        for argvals, env2 in self._eval_seq(ctx, term.args, env, tuple):
+            try:
+                v = fn(*argvals)
+            except bi.BuiltinError:
+                continue  # builtin error => undefined (OPA non-strict mode)
+            except (TypeError, ValueError, KeyError, IndexError, ZeroDivisionError):
+                continue
+            if v is UNDEFINED:
+                continue
+            yield v, env2
+
+    def _eval_comprehension(self, ctx: _Ctx, term: Comprehension, env: dict):
+        if term.kind == "array":
+            out = []
+            for env2 in self._eval_body(ctx, term.body, 0, env):
+                for v, _ in self._eval_term(ctx, term.head[0], env2):
+                    out.append(v)
+            return tuple(out)
+        if term.kind == "set":
+            out_set = []
+            seen: set = set()
+            for env2 in self._eval_body(ctx, term.body, 0, env):
+                for v, _ in self._eval_term(ctx, term.head[0], env2):
+                    if v not in seen:
+                        seen.add(v)
+                        out_set.append(v)
+            return frozenset(out_set)
+        # object comprehension
+        pairs: dict = {}
+        for env2 in self._eval_body(ctx, term.body, 0, env):
+            for k, env3 in self._eval_term(ctx, term.head[0], env2):
+                for v, _ in self._eval_term(ctx, term.head[1], env3):
+                    if k in pairs and pairs[k] != v:
+                        raise ConflictError("object comprehension: conflicting keys")
+                    pairs[k] = v
+        return Obj(pairs)
+
+
+_IN_PROGRESS = object()
+
+
+def _contains(values: list, v) -> bool:
+    """Membership that does not coerce bool==int (True vs 1 are distinct)."""
+    return any(x == v and _same_kind(x, v) for x in values)
+
+
+def _same_kind(a, b) -> bool:
+    """Guard against bool==int / 1==True coercion surprises in unification."""
+    if isinstance(a, bool) != isinstance(b, bool):
+        return False
+    return True
+
+
+def _compare(op: str, lv, rv) -> bool:
+    if op == "==":
+        return lv == rv and _same_kind(lv, rv)
+    if op == "!=":
+        return lv != rv or not _same_kind(lv, rv)
+    # ordering: numbers compare numerically; otherwise OPA's type order
+    if isinstance(lv, (int, float)) and not isinstance(lv, bool) and \
+       isinstance(rv, (int, float)) and not isinstance(rv, bool):
+        a, b = lv, rv
+    else:
+        a, b = _sort_key(lv), _sort_key(rv)
+    try:
+        if op == "<":
+            return a < b
+        if op == "<=":
+            return a <= b
+        if op == ">":
+            return a > b
+        if op == ">=":
+            return a >= b
+    except TypeError:
+        return False
+    raise EvalError(f"unknown comparison op {op}")
+
+
+def _binop(op: str, lv, rv):
+    is_num = (lambda x: isinstance(x, (int, float)) and not isinstance(x, bool))
+    if op == "+":
+        if is_num(lv) and is_num(rv):
+            return canon_num(lv + rv)
+        return UNDEFINED
+    if op == "-":
+        if is_num(lv) and is_num(rv):
+            return canon_num(lv - rv)
+        if isinstance(lv, frozenset) and isinstance(rv, frozenset):
+            return lv - rv
+        return UNDEFINED
+    if op == "*":
+        if is_num(lv) and is_num(rv):
+            return canon_num(lv * rv)
+        return UNDEFINED
+    if op == "/":
+        if is_num(lv) and is_num(rv):
+            if rv == 0:
+                return UNDEFINED
+            return canon_num(lv / rv)
+        return UNDEFINED
+    if op == "%":
+        if isinstance(lv, int) and isinstance(rv, int) and not isinstance(lv, bool) \
+                and not isinstance(rv, bool) and rv != 0:
+            return lv % rv
+        return UNDEFINED
+    if op == "|":
+        if isinstance(lv, frozenset) and isinstance(rv, frozenset):
+            return lv | rv
+        return UNDEFINED
+    if op == "&":
+        if isinstance(lv, frozenset) and isinstance(rv, frozenset):
+            return lv & rv
+        return UNDEFINED
+    raise EvalError(f"unknown binary op {op}")

@@ -1,0 +1,16 @@
+"""Test configuration.
+
+Tests run JAX on a virtual 8-device CPU mesh (the driver separately
+dry-run-compiles the multi-chip path; real-TPU benchmarking happens via
+bench.py).  Env vars must be set before jax is imported anywhere.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+prev = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in prev:
+    os.environ["XLA_FLAGS"] = (prev + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,140 @@
+"""Builtin + semantics regression tests (incl. code-review findings)."""
+
+import pytest
+
+from gatekeeper_tpu.errors import ConflictError
+from gatekeeper_tpu.rego import parse_module, Interpreter
+from gatekeeper_tpu.rego.builtins import (
+    BuiltinError, _glob_match, _round, opa_sprintf, rego_repr)
+from gatekeeper_tpu.rego.interp import UNDEFINED
+from gatekeeper_tpu.rego.values import freeze, thaw
+
+
+class TestSprintf:
+    def test_v_string_unquoted_at_top(self):
+        assert opa_sprintf("x=%v", ("hi",)) == "x=hi"
+
+    def test_v_set_rego_syntax(self):
+        assert opa_sprintf("%v", (frozenset(["b", "a"]),)) == '{"a", "b"}'
+
+    def test_v_array(self):
+        assert opa_sprintf("%v", ((1, "a"),)) == '[1, "a"]'
+
+    def test_v_number(self):
+        assert opa_sprintf("%v and %v", (3, 2.5)) == "3 and 2.5"
+
+    def test_d(self):
+        assert opa_sprintf("%d", (42,)) == "42"
+
+    def test_missing_arg(self):
+        assert "MISSING" in opa_sprintf("%v %v", ("x",))
+
+    def test_percent_literal(self):
+        assert opa_sprintf("100%%", ()) == "100%"
+
+
+class TestGlob:
+    def test_star_stops_at_delimiter(self):
+        assert _glob_match("*", None, "ab") is True
+        assert _glob_match("*", None, "a.b") is False  # default delim '.'
+
+    def test_doublestar_crosses(self):
+        assert _glob_match("**", None, "a.b") is True
+
+    def test_explicit_delims(self):
+        assert _glob_match("*.example.com", (".",), "api.example.com") is True
+        assert _glob_match("*.example.com", (".",), "a.b.example.com") is False
+
+    def test_alternates(self):
+        assert _glob_match("{api,web}.com", (".",), "api.com") is True
+        assert _glob_match("{api,web}.com", (".",), "db.com") is False
+
+    def test_question(self):
+        assert _glob_match("a?c", (".",), "abc") is True
+        assert _glob_match("a?c", (".",), "a.c") is False
+
+    def test_charclass(self):
+        assert _glob_match("[ab]x", None, "ax") is True
+        assert _glob_match("[!ab]x", None, "cx") is True
+        assert _glob_match("[!ab]x", None, "ax") is False
+
+
+class TestRound:
+    def test_half_away_from_zero(self):
+        assert _round(0.5) == 1
+        assert _round(-0.5) == -1
+        assert _round(-2.5) == -3
+        assert _round(2.4) == 2
+
+
+class TestSemanticsRegressions:
+    def test_with_undefined_value_makes_literal_undefined(self):
+        m = parse_module("""
+package t
+inner { input.x == 2 }
+violation[{"msg": "bad"}] { inner with input as input.missing }
+""")
+        # OPA: with-value undefined => expression undefined => no violation
+        assert Interpreter(m).query_set("violation", {"x": 2}, {}) == []
+
+    def test_object_unification_requires_exact_keys(self):
+        m = parse_module("""
+package t
+violation[{"msg": "hit"}] { {"a": x} = input.obj; x == 1 }
+""")
+        i = Interpreter(m)
+        assert i.query_set("violation", {"obj": {"a": 1, "b": 2}}, {}) == []
+        assert len(i.query_set("violation", {"obj": {"a": 1}}, {})) == 1
+
+    def test_complete_rule_conflict_true_vs_one(self):
+        m = parse_module("""
+package t
+x = true { input.a }
+x = 1 { input.b }
+violation[{"msg": "v"}] { x }
+""")
+        with pytest.raises(ConflictError):
+            Interpreter(m).query_set("violation", {"a": 1, "b": 1}, {})
+
+    def test_bool_int_distinct_in_compare(self):
+        m = parse_module("""
+package t
+violation[{"msg": "eq"}] { input.x == true }
+""")
+        i = Interpreter(m)
+        assert len(i.query_set("violation", {"x": True}, {})) == 1
+        assert i.query_set("violation", {"x": 1}, {}) == []
+
+    def test_reorder_out_of_order_comprehension(self):
+        # mirrors k8suniqueserviceselector's flatten_selector
+        m = parse_module("""
+package t
+violation[{"msg": flat}] {
+  selectors := [s | s = concat(":", [key, val]); val = input.sel[key]]
+  flat := concat(",", sort(selectors))
+}
+""")
+        got = Interpreter(m).query_set("violation", {"sel": {"b": "2", "a": "1"}}, {})
+        assert thaw(got[0])["msg"] == "a:1,b:2"
+
+    def test_division_by_zero_undefined(self):
+        m = parse_module("""
+package t
+violation[{"msg": "v"}] { x := 1 / input.z; x > 0 }
+""")
+        assert Interpreter(m).query_set("violation", {"z": 0}, {}) == []
+
+    def test_raw_string_locations(self):
+        from gatekeeper_tpu.rego.lexer import tokenize
+        toks = tokenize("x = `ab` ; y")
+        semi = [t for t in toks if t.kind == "op" and t.value == ";"][0]
+        assert semi.loc.col == 10
+
+
+class TestRegoRepr:
+    def test_nested(self):
+        v = freeze({"k": [1, True, None, {"n": 2}]})
+        assert rego_repr(v) == '{"k": [1, true, null, {"n": 2}]}'
+
+    def test_empty_set(self):
+        assert rego_repr(frozenset()) == "set()"

@@ -1,0 +1,490 @@
+"""Interpreter semantics tests against the reference's demo templates.
+
+Each template below is re-typed from the reference's YAML demos
+(example/templates/, demo/agilebank/templates/, demo/basic/templates/) and
+evaluated with inputs whose expected outcomes follow from OPA semantics.
+"""
+
+import pytest
+
+from gatekeeper_tpu.rego import parse_module
+from gatekeeper_tpu.rego.interp import Interpreter, UNDEFINED
+from gatekeeper_tpu.rego.values import Obj, freeze, thaw
+
+REQUIRED_LABELS = """
+package k8srequiredlabels
+
+violation[{"msg": msg, "details": {"missing_labels": missing}}] {
+  provided := {label | input.review.object.metadata.labels[label]}
+  required := {label | label := input.constraint.spec.parameters.labels[_]}
+  missing := required - provided
+  count(missing) > 0
+  msg := sprintf("you must provide labels: %v", [missing])
+}
+"""
+
+ALLOWED_REPOS = """
+package k8sallowedrepos
+
+violation[{"msg": msg}] {
+  container := input.review.object.spec.containers[_]
+  satisfied := [good | repo = input.constraint.spec.parameters.repos[_] ; good = startswith(container.image, repo)]
+  not any(satisfied)
+  msg := sprintf("container <%v> has an invalid image repo <%v>, allowed repos are %v", [container.name, container.image, input.constraint.spec.parameters.repos])
+}
+"""
+
+
+def make_input(obj, params, review_extra=None):
+    review = {"object": obj, "kind": {"group": "", "version": "v1", "kind": obj.get("kind", "Pod")}}
+    if review_extra:
+        review.update(review_extra)
+    return {
+        "review": review,
+        "constraint": {"spec": {"parameters": params}},
+    }
+
+
+class TestRequiredLabels:
+    def setup_method(self):
+        self.interp = Interpreter(parse_module(REQUIRED_LABELS))
+
+    def test_missing_label_violates(self):
+        inp = make_input({"metadata": {"labels": {"a": "1"}}}, {"labels": ["gatekeeper"]})
+        got = self.interp.query_set("violation", inp, {})
+        assert len(got) == 1
+        v = thaw(got[0])
+        assert v["msg"] == 'you must provide labels: {"gatekeeper"}'
+        assert v["details"]["missing_labels"] == ["gatekeeper"]
+
+    def test_present_label_ok(self):
+        inp = make_input({"metadata": {"labels": {"gatekeeper": "yes"}}}, {"labels": ["gatekeeper"]})
+        assert self.interp.query_set("violation", inp, {}) == []
+
+    def test_no_labels_at_all(self):
+        inp = make_input({"metadata": {}}, {"labels": ["x", "y"]})
+        got = self.interp.query_set("violation", inp, {})
+        assert len(got) == 1
+        assert thaw(got[0])["details"]["missing_labels"] == ["x", "y"]
+
+    def test_multiple_missing_sorted_in_msg(self):
+        inp = make_input({"metadata": {"labels": {}}}, {"labels": ["b", "a"]})
+        got = self.interp.query_set("violation", inp, {})
+        assert thaw(got[0])["msg"] == 'you must provide labels: {"a", "b"}'
+
+
+class TestAllowedRepos:
+    def setup_method(self):
+        self.interp = Interpreter(parse_module(ALLOWED_REPOS))
+
+    def pod(self, *images):
+        return {"spec": {"containers": [
+            {"name": f"c{i}", "image": img} for i, img in enumerate(images)]}}
+
+    def test_bad_repo(self):
+        inp = make_input(self.pod("docker.io/nginx"), {"repos": ["gcr.io/"]})
+        got = self.interp.query_set("violation", inp, {})
+        assert len(got) == 1
+        assert "invalid image repo" in thaw(got[0])["msg"]
+        assert "<docker.io/nginx>" in thaw(got[0])["msg"]
+
+    def test_good_repo(self):
+        inp = make_input(self.pod("gcr.io/org/img"), {"repos": ["gcr.io/"]})
+        assert self.interp.query_set("violation", inp, {}) == []
+
+    def test_mixed_containers(self):
+        inp = make_input(self.pod("gcr.io/a", "bad.io/b"), {"repos": ["gcr.io/"]})
+        got = self.interp.query_set("violation", inp, {})
+        assert len(got) == 1
+        assert "<bad.io/b>" in thaw(got[0])["msg"]
+
+
+CONTAINER_LIMITS = """
+package k8scontainerlimits
+
+missing(obj, field) = true {
+  not obj[field]
+}
+
+missing(obj, field) = true {
+  obj[field] == ""
+}
+
+canonify_cpu(orig) = new {
+  is_number(orig)
+  new := orig * 1000
+}
+
+canonify_cpu(orig) = new {
+  not is_number(orig)
+  endswith(orig, "m")
+  new := to_number(replace(orig, "m", ""))
+}
+
+canonify_cpu(orig) = new {
+  not is_number(orig)
+  not endswith(orig, "m")
+  re_match("^[0-9]+$", orig)
+  new := to_number(orig) * 1000
+}
+
+mem_multiple("E") = 1000000000000000000 { true }
+mem_multiple("P") = 1000000000000000 { true }
+mem_multiple("T") = 1000000000000 { true }
+mem_multiple("G") = 1000000000 { true }
+mem_multiple("M") = 1000000 { true }
+mem_multiple("K") = 1000 { true }
+mem_multiple("") = 1 { true }
+mem_multiple("Ki") = 1024 { true }
+mem_multiple("Mi") = 1048576 { true }
+mem_multiple("Gi") = 1073741824 { true }
+mem_multiple("Ti") = 1099511627776 { true }
+mem_multiple("Pi") = 1125899906842624 { true }
+mem_multiple("Ei") = 1152921504606846976 { true }
+
+get_suffix(mem) = suffix {
+  not is_string(mem)
+  suffix := ""
+}
+
+get_suffix(mem) = suffix {
+  is_string(mem)
+  suffix := substring(mem, count(mem) - 1, -1)
+  mem_multiple(suffix)
+}
+
+get_suffix(mem) = suffix {
+  is_string(mem)
+  suffix := substring(mem, count(mem) - 2, -1)
+  mem_multiple(suffix)
+}
+
+get_suffix(mem) = suffix {
+  is_string(mem)
+  not substring(mem, count(mem) - 1, -1)
+  not substring(mem, count(mem) - 2, -1)
+  suffix := ""
+}
+
+canonify_mem(orig) = new {
+  is_number(orig)
+  new := orig
+}
+
+canonify_mem(orig) = new {
+  not is_number(orig)
+  suffix := get_suffix(orig)
+  raw := replace(orig, suffix, "")
+  new := to_number(raw) * mem_multiple(suffix)
+}
+
+violation[{"msg": msg}] {
+  container := input.review.object.spec.containers[_]
+  cpu_orig := container.resources.limits.cpu
+  not canonify_cpu(cpu_orig)
+  msg := sprintf("container <%v> cpu limit <%v> could not be parsed", [container.name, cpu_orig])
+}
+
+violation[{"msg": msg}] {
+  container := input.review.object.spec.containers[_]
+  not container.resources
+  msg := sprintf("container <%v> has no resource limits", [container.name])
+}
+
+violation[{"msg": msg}] {
+  container := input.review.object.spec.containers[_]
+  not container.resources.limits
+  msg := sprintf("container <%v> has no resource limits", [container.name])
+}
+
+violation[{"msg": msg}] {
+  container := input.review.object.spec.containers[_]
+  missing(container.resources.limits, "cpu")
+  msg := sprintf("container <%v> has no cpu limit", [container.name])
+}
+
+violation[{"msg": msg}] {
+  container := input.review.object.spec.containers[_]
+  missing(container.resources.limits, "memory")
+  msg := sprintf("container <%v> has no memory limit", [container.name])
+}
+
+violation[{"msg": msg}] {
+  container := input.review.object.spec.containers[_]
+  cpu_orig := container.resources.limits.cpu
+  cpu := canonify_cpu(cpu_orig)
+  max_cpu_orig := input.constraint.spec.parameters.cpu
+  max_cpu := canonify_cpu(max_cpu_orig)
+  cpu > max_cpu
+  msg := sprintf("container <%v> cpu limit <%v> is higher than the maximum allowed of <%v>", [container.name, cpu_orig, max_cpu_orig])
+}
+
+violation[{"msg": msg}] {
+  container := input.review.object.spec.containers[_]
+  mem_orig := container.resources.limits.memory
+  mem := canonify_mem(mem_orig)
+  max_mem_orig := input.constraint.spec.parameters.memory
+  max_mem := canonify_mem(max_mem_orig)
+  mem > max_mem
+  msg := sprintf("container <%v> memory limit <%v> is higher than the maximum allowed of <%v>", [container.name, mem_orig, max_mem_orig])
+}
+"""
+
+
+class TestContainerLimits:
+    def setup_method(self):
+        self.interp = Interpreter(parse_module(CONTAINER_LIMITS))
+
+    def limits_pod(self, cpu=None, memory=None, resources=True, limits=True):
+        c = {"name": "main", "image": "img"}
+        if resources:
+            c["resources"] = {}
+            if limits:
+                lim = {}
+                if cpu is not None:
+                    lim["cpu"] = cpu
+                if memory is not None:
+                    lim["memory"] = memory
+                c["resources"]["limits"] = lim
+        return {"spec": {"containers": [c]}}
+
+    def msgs(self, obj, params):
+        got = self.interp.query_set("violation", make_input(obj, params), {})
+        return sorted(thaw(v)["msg"] for v in got)
+
+    def test_over_cpu_limit(self):
+        msgs = self.msgs(self.limits_pod(cpu="2", memory="1Gi"),
+                         {"cpu": "500m", "memory": "2Gi"})
+        assert msgs == ["container <main> cpu limit <2> is higher than the maximum allowed of <500m>"]
+
+    def test_over_mem_limit(self):
+        msgs = self.msgs(self.limits_pod(cpu="100m", memory="4Gi"),
+                         {"cpu": "1", "memory": "2Gi"})
+        assert msgs == ["container <main> memory limit <4Gi> is higher than the maximum allowed of <2Gi>"]
+
+    def test_within_limits(self):
+        assert self.msgs(self.limits_pod(cpu="100m", memory="1Gi"),
+                         {"cpu": "1", "memory": "2Gi"}) == []
+
+    def test_no_resources(self):
+        msgs = self.msgs(self.limits_pod(resources=False), {"cpu": "1", "memory": "1Gi"})
+        assert "container <main> has no resource limits" in msgs
+
+    def test_missing_cpu(self):
+        msgs = self.msgs(self.limits_pod(memory="1Gi"), {"cpu": "1", "memory": "2Gi"})
+        assert msgs == ["container <main> has no cpu limit"]
+
+    def test_unparsable_cpu(self):
+        msgs = self.msgs(self.limits_pod(cpu="weird", memory="1Gi"),
+                         {"cpu": "1", "memory": "2Gi"})
+        assert msgs == ["container <main> cpu limit <weird> could not be parsed"]
+
+    def test_mem_units(self):
+        # 1000Ki = 1024000 bytes > 1M = 1000000 bytes
+        msgs = self.msgs(self.limits_pod(cpu="1m", memory="1000Ki"),
+                         {"cpu": "1", "memory": "1M"})
+        assert len(msgs) == 1 and "memory limit" in msgs[0]
+
+
+UNIQUE_LABEL = """
+package k8suniquelabel
+
+make_apiversion(kind) = apiVersion {
+  g := kind.group
+  v := kind.version
+  g != ""
+  apiVersion = sprintf("%v/%v", [g, v])
+}
+
+make_apiversion(kind) = apiVersion {
+  kind.group == ""
+  apiVersion = kind.version
+}
+
+identical_namespace(obj, review) {
+  obj.metadata.namespace == review.namespace
+  obj.metadata.name == review.name
+  obj.kind == review.kind.kind
+  obj.apiVersion == make_apiversion(review.kind)
+}
+
+identical_cluster(obj, review) {
+  obj.metadata.name == review.name
+  obj.kind == review.kind.kind
+  obj.apiVersion == make_apiversion(review.kind)
+}
+
+violation[{"msg": msg, "details": {"value": val, "label": label}}] {
+  label := input.constraint.spec.parameters.label
+  val := input.review.object.metadata.labels[label]
+  cluster_objs := [o | o = data.inventory.cluster[_][_][_]; not identical_cluster(o, input.review)]
+  ns_objs := [o | o = data.inventory.namespace[_][_][_][_]; not identical_namespace(o, input.review)]
+  all_objs := array.concat(cluster_objs, ns_objs)
+  all_values := {val | obj = all_objs[_]; val = obj.metadata.labels[label]}
+  count({val} - all_values) == 0
+  msg := sprintf("label %v has duplicate value %v", [label, val])
+}
+"""
+
+
+class TestUniqueLabel:
+    def setup_method(self):
+        self.interp = Interpreter(parse_module(UNIQUE_LABEL))
+
+    def ns_obj(self, name, labels):
+        return {"apiVersion": "v1", "kind": "Namespace",
+                "metadata": {"name": name, "labels": labels}}
+
+    def inventory(self, *namespaces):
+        return {"inventory": {
+            "cluster": {"v1": {"Namespace": {n["metadata"]["name"]: n for n in namespaces}}},
+            "namespace": {},
+        }}
+
+    def test_duplicate_label_value(self):
+        inv = self.inventory(self.ns_obj("other", {"color": "blue"}))
+        obj = self.ns_obj("mine", {"color": "blue"})
+        inp = {"review": {"object": obj, "name": "mine",
+                          "kind": {"group": "", "version": "v1", "kind": "Namespace"}},
+               "constraint": {"spec": {"parameters": {"label": "color"}}}}
+        got = self.interp.query_set("violation", inp, inv)
+        assert len(got) == 1
+        assert thaw(got[0])["msg"] == "label color has duplicate value blue"
+
+    def test_unique_label_value(self):
+        inv = self.inventory(self.ns_obj("other", {"color": "red"}))
+        obj = self.ns_obj("mine", {"color": "blue"})
+        inp = {"review": {"object": obj, "name": "mine",
+                          "kind": {"group": "", "version": "v1", "kind": "Namespace"}},
+               "constraint": {"spec": {"parameters": {"label": "color"}}}}
+        assert self.interp.query_set("violation", inp, inv) == []
+
+    def test_self_is_excluded(self):
+        me = self.ns_obj("mine", {"color": "blue"})
+        inv = self.inventory(me)  # only me in inventory
+        inp = {"review": {"object": me, "name": "mine",
+                          "kind": {"group": "", "version": "v1", "kind": "Namespace"}},
+               "constraint": {"spec": {"parameters": {"label": "color"}}}}
+        assert self.interp.query_set("violation", inp, inv) == []
+
+
+class TestCoreSemantics:
+    def test_deny_all(self):
+        m = parse_module("""
+package foo
+violation[{"msg": "DENIED", "details": {}}] {
+  "always" == "always"
+}
+""")
+        got = Interpreter(m).query_set("violation", {"review": {}}, {})
+        assert len(got) == 1
+        assert thaw(got[0])["msg"] == "DENIED"
+
+    def test_undefined_propagation(self):
+        m = parse_module("""
+package t
+violation[{"msg": "x"}] { input.review.object.metadata.labels["nope"] == "y" }
+""")
+        assert Interpreter(m).query_set("violation", {"review": {"object": {}}}, {}) == []
+
+    def test_negation_of_undefined_succeeds(self):
+        m = parse_module("""
+package t
+violation[{"msg": "no-labels"}] { not input.review.object.metadata.labels }
+""")
+        got = Interpreter(m).query_set("violation", {"review": {"object": {}}}, {})
+        assert len(got) == 1
+
+    def test_false_vs_undefined(self):
+        m = parse_module("""
+package t
+flag = false { true }
+violation[{"msg": "via-not"}] { not flag }
+""")
+        # flag is defined and false -> `not flag` succeeds
+        got = Interpreter(m).query_set("violation", {}, {})
+        assert len(got) == 1
+
+    def test_default_rule(self):
+        m = parse_module("""
+package t
+default allow = false
+allow = true { input.ok }
+violation[{"msg": "denied"}] { not allow }
+""")
+        i = Interpreter(m)
+        assert len(i.query_set("violation", {"nope": 1}, {})) == 1
+        assert i.query_set("violation", {"ok": True}, {}) == []
+
+    def test_some_decl(self):
+        m = parse_module("""
+package t
+violation[{"msg": msg}] {
+  some i
+  input.items[i] > 3
+  msg := sprintf("item %v over", [i])
+}
+""")
+        got = Interpreter(m).query_set("violation", {"items": [1, 5, 2, 9]}, {})
+        assert sorted(thaw(v)["msg"] for v in got) == ["item 1 over", "item 3 over"]
+
+    def test_set_ops(self):
+        m = parse_module("""
+package t
+violation[{"msg": msg}] {
+  s := {"a", "b", "c"} & {"b", "c", "d"}
+  u := s | {"z"}
+  count(u) == 3
+  msg := concat(",", sort(u))
+}
+""")
+        got = Interpreter(m).query_set("violation", {}, {})
+        assert thaw(got[0])["msg"] == "b,c,z"
+
+    def test_object_comprehension(self):
+        m = parse_module("""
+package t
+violation[{"msg": msg}] {
+  o := {k: v | v := input.m[k]; v > 1}
+  count(o) == 2
+  msg := concat(",", sort([k | o[k]]))
+}
+""")
+        got = Interpreter(m).query_set("violation", {"m": {"a": 1, "b": 2, "c": 3}}, {})
+        assert thaw(got[0])["msg"] == "b,c"
+
+    def test_array_destructuring(self):
+        m = parse_module("""
+package t
+violation[{"msg": g}] {
+  contains(input.av, "/")
+  [g, v] := split(input.av, "/")
+}
+""")
+        got = Interpreter(m).query_set("violation", {"av": "apps/v1"}, {})
+        assert thaw(got[0])["msg"] == "apps"
+
+    def test_arith(self):
+        m = parse_module("""
+package t
+violation[{"msg": "hit"}] { (input.a + input.b) * 2 == 10 - input.c }
+""")
+        assert len(Interpreter(m).query_set("violation", {"a": 1, "b": 2, "c": 4}, {})) == 1
+
+    def test_with_input(self):
+        m = parse_module("""
+package t
+inner { input.x == 1 }
+violation[{"msg": "ok"}] { inner with input as {"x": 1} }
+""")
+        assert len(Interpreter(m).query_set("violation", {"x": 2}, {})) == 1
+
+    def test_trace_builtin(self):
+        m = parse_module("""
+package t
+violation[{"msg": "ok"}] { trace(sprintf("INPUT IS: %v", [input.x])); true }
+""")
+        tracer = []
+        got = Interpreter(m).query_set("violation", {"x": 5}, {}, tracer=tracer)
+        assert len(got) == 1
+        assert tracer == ["INPUT IS: 5"]
