@@ -1,0 +1,231 @@
+"""The policy client — the façade every control-plane component calls.
+
+Reference: vendor/.../constraint/pkg/client/client.go:24-47 (interface),
+462-509 (init), 545-612 (Review/Audit).  Lifecycle and semantics follow
+the reference: templates compile + register per target, constraints
+validate against the generated CRD, data flows through target
+ProcessData, Review/Audit fan out over targets and reconstruct violating
+resources via HandleViolation.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any
+
+from gatekeeper_tpu.api.templates import (
+    CompiledTemplate, ConstraintTemplate, compile_target_rego)
+from gatekeeper_tpu.client.crd_helpers import (
+    CONSTRAINT_GROUP, CONSTRAINT_VERSION, build_crd, validate_cr)
+from gatekeeper_tpu.client.interface import Driver, QueryOpts
+from gatekeeper_tpu.client.targets import TargetHandler, UnhandledData, WipeData
+from gatekeeper_tpu.client.types import Response, Responses
+from gatekeeper_tpu.errors import ClientError
+
+
+class Client:
+    def __init__(self, driver: Driver, targets: list[TargetHandler]):
+        if not targets:
+            raise ClientError("at least one target is required")
+        self.driver = driver
+        self.targets: dict[str, TargetHandler] = {}
+        for t in targets:
+            if t.name in self.targets:
+                raise ClientError(f"duplicate target {t.name!r}")
+            self.targets[t.name] = t
+        # kind -> {target -> CompiledTemplate}; plus the generated CRD
+        self.templates: dict[str, dict[str, CompiledTemplate]] = {}
+        self.crds: dict[str, dict] = {}
+        self.constraints: dict[str, dict[str, dict]] = {}
+        self._lock = threading.RLock()
+        driver.init(self.targets)
+
+    # ------------------------------------------------------------------
+    # templates (client.go:211-300)
+
+    def create_crd(self, template_doc: dict) -> dict:
+        """Validate the template and build its constraint CRD without
+        registering anything (used by the webhook's synchronous template
+        validation, policy.go:211-227)."""
+        tmpl = ConstraintTemplate.from_dict(template_doc)
+        if not tmpl.targets:
+            raise ClientError("template has no targets")
+        if len(tmpl.targets) > 1:
+            raise ClientError("multi-target templates are not supported")
+        tt = tmpl.targets[0]
+        handler = self.targets.get(tt.target)
+        if handler is None:
+            raise ClientError(f"unknown target {tt.target!r}")
+        compile_target_rego(tmpl.kind, tt.target, tt.rego)
+        return build_crd(tmpl, handler.match_schema())
+
+    def add_template(self, template_doc: dict) -> Responses:
+        with self._lock:
+            tmpl = ConstraintTemplate.from_dict(template_doc)
+            if not tmpl.targets:
+                raise ClientError("template has no targets")
+            if len(tmpl.targets) > 1:
+                raise ClientError("multi-target templates are not supported")
+            tt = tmpl.targets[0]
+            handler = self.targets.get(tt.target)
+            if handler is None:
+                raise ClientError(f"unknown target {tt.target!r}")
+            compiled = compile_target_rego(tmpl.kind, tt.target, tt.rego)
+            crd = build_crd(tmpl, handler.match_schema())
+            self.templates[tmpl.kind] = {tt.target: compiled}
+            self.crds[tmpl.kind] = crd
+            self.constraints.setdefault(tmpl.kind, {})
+            self.driver.put_template(tt.target, tmpl.kind, compiled)
+            return Responses(handled={tt.target: True})
+
+    def remove_template(self, template_doc: dict) -> Responses:
+        with self._lock:
+            tmpl = ConstraintTemplate.from_dict(template_doc)
+            handled = {}
+            targets = self.templates.pop(tmpl.kind, {})
+            self.crds.pop(tmpl.kind, None)
+            self.constraints.pop(tmpl.kind, None)
+            for target in targets:
+                self.driver.delete_template(target, tmpl.kind)
+                handled[target] = True
+            return Responses(handled=handled)
+
+    # ------------------------------------------------------------------
+    # constraints (client.go:340-432)
+
+    def validate_constraint(self, constraint: dict) -> None:
+        kind = constraint.get("kind", "")
+        crd = self.crds.get(kind)
+        if crd is None:
+            raise ClientError(f"no template registered for constraint kind {kind!r}")
+        validate_cr(constraint, crd)
+        for target, handler in self.targets.items():
+            if target in self.templates.get(kind, {}):
+                handler.validate_constraint(constraint)
+
+    def add_constraint(self, constraint: dict) -> Responses:
+        with self._lock:
+            self.validate_constraint(constraint)
+            kind = constraint["kind"]
+            name = constraint["metadata"]["name"]
+            self.constraints.setdefault(kind, {})[name] = constraint
+            handled = {}
+            for target in self.templates.get(kind, {}):
+                self.driver.put_constraint(target, kind, name, constraint)
+                handled[target] = True
+            return Responses(handled=handled)
+
+    def remove_constraint(self, constraint: dict) -> Responses:
+        with self._lock:
+            kind = constraint.get("kind", "")
+            name = (constraint.get("metadata") or {}).get("name", "")
+            self.constraints.get(kind, {}).pop(name, None)
+            handled = {}
+            for target in self.templates.get(kind, {}):
+                self.driver.delete_constraint(target, kind, name)
+                handled[target] = True
+            return Responses(handled=handled)
+
+    # ------------------------------------------------------------------
+    # data (client.go:152-209)
+
+    def add_data(self, obj: Any) -> Responses:
+        with self._lock:
+            handled = {}
+            for name, handler in self.targets.items():
+                if isinstance(obj, WipeData) or obj is WipeData:
+                    self.driver.wipe_data(name)
+                    handled[name] = True
+                    continue
+                try:
+                    key, meta, doc = handler.process_data(obj)
+                except UnhandledData:
+                    continue
+                self.driver.put_data(name, key, meta, doc)
+                handled[name] = True
+            return Responses(handled=handled)
+
+    def remove_data(self, obj: Any) -> Responses:
+        with self._lock:
+            handled = {}
+            for name, handler in self.targets.items():
+                if isinstance(obj, WipeData) or obj is WipeData:
+                    self.driver.wipe_data(name)
+                    handled[name] = True
+                    continue
+                try:
+                    key, _, _ = handler.process_data(obj)
+                except UnhandledData:
+                    continue
+                self.driver.delete_data(name, key)
+                handled[name] = True
+            return Responses(handled=handled)
+
+    # ------------------------------------------------------------------
+    # queries (client.go:545-612)
+
+    def review(self, obj: Any, tracing: bool = False) -> Responses:
+        # queries share the writer lock: the reference guards Review/Audit
+        # with the client RWMutex (client.go:545,584)
+        with self._lock:
+            return self._review_locked(obj, tracing)
+
+    def _review_locked(self, obj: Any, tracing: bool) -> Responses:
+        responses = Responses()
+        for name, handler in self.targets.items():
+            try:
+                review = handler.handle_review(obj)
+            except UnhandledData:
+                continue
+            results, trace = self.driver.query_review(
+                name, review, QueryOpts(tracing=tracing))
+            for r in results:
+                handler.handle_violation(r)
+            responses.by_target[name] = Response(
+                target=name, results=results, trace=trace,
+                input={"review": review} if tracing else None)
+            responses.handled[name] = True
+        return responses
+
+    def audit(self, tracing: bool = False) -> Responses:
+        with self._lock:
+            return self._audit_locked(tracing)
+
+    def _audit_locked(self, tracing: bool) -> Responses:
+        responses = Responses()
+        for name, handler in self.targets.items():
+            results, trace = self.driver.query_audit(name, QueryOpts(tracing=tracing))
+            for r in results:
+                handler.handle_violation(r)
+            responses.by_target[name] = Response(target=name, results=results,
+                                                 trace=trace)
+            responses.handled[name] = True
+        return responses
+
+    def reset(self) -> None:
+        with self._lock:
+            for kind, targets in list(self.templates.items()):
+                for target in targets:
+                    self.driver.delete_template(target, kind)
+            for name in self.targets:
+                self.driver.wipe_data(name)
+            self.templates.clear()
+            self.crds.clear()
+            self.constraints.clear()
+
+    def dump(self) -> dict:
+        return self.driver.dump()
+
+
+class Backend:
+    """One-client-per-backend guard (backend.go:10-67)."""
+
+    def __init__(self, driver: Driver):
+        self.driver = driver
+        self._has_client = False
+
+    def new_client(self, targets: list[TargetHandler]) -> Client:
+        if self._has_client:
+            raise ClientError("only one client per backend is allowed")
+        self._has_client = True
+        return Client(self.driver, targets)

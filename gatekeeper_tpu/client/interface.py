@@ -1,0 +1,77 @@
+"""The Driver seam — where evaluation engines plug in.
+
+The reference's Driver interface is six methods over Rego sources and
+path-addressed JSON (vendor/.../drivers/interface.go:21-33).  The native
+equivalent is typed rather than stringly:
+
+  reference                      this seam
+  ---------------------------------------------------------------
+  Init                           init(targets)
+  PutModule(name, rego)          put_template(target, kind, compiled)
+  DeleteModule(name)             delete_template(target, kind)
+  PutData("/constraints/...")    put_constraint(target, kind, name, c)
+  PutData("/external/...")       put_data(target, key, meta, obj)
+  DeleteData(path)               delete_constraint / delete_data / wipe_data
+  Query("hooks[t].violation")    query_review(target, review, opts)
+  Query("hooks[t].audit")        query_audit(target, opts)
+  Dump                           dump()
+
+Two drivers implement it: ``local`` (scalar oracle engine, the dev /
+conformance reference — analogue of drivers/local) and ``jax`` (vectorized
+device engine with scalar fallback).  Both must pass the same conformance
+suite, like the reference's local and remote drivers
+(client_test.go:17-23).
+"""
+
+from __future__ import annotations
+
+import abc
+import dataclasses
+from typing import Any
+
+from gatekeeper_tpu.api.templates import CompiledTemplate
+from gatekeeper_tpu.client.targets import TargetHandler
+from gatekeeper_tpu.client.types import Result
+from gatekeeper_tpu.store.table import ResourceMeta
+
+
+@dataclasses.dataclass
+class QueryOpts:
+    tracing: bool = False  # drivers.Tracing (interface.go:9-19)
+
+
+class Driver(abc.ABC):
+    @abc.abstractmethod
+    def init(self, targets: dict[str, TargetHandler]) -> None: ...
+
+    @abc.abstractmethod
+    def put_template(self, target: str, kind: str, compiled: CompiledTemplate) -> None: ...
+
+    @abc.abstractmethod
+    def delete_template(self, target: str, kind: str) -> None: ...
+
+    @abc.abstractmethod
+    def put_constraint(self, target: str, kind: str, name: str, constraint: dict) -> None: ...
+
+    @abc.abstractmethod
+    def delete_constraint(self, target: str, kind: str, name: str) -> None: ...
+
+    @abc.abstractmethod
+    def put_data(self, target: str, key: str, meta: ResourceMeta, obj: dict) -> None: ...
+
+    @abc.abstractmethod
+    def delete_data(self, target: str, key: str) -> bool: ...
+
+    @abc.abstractmethod
+    def wipe_data(self, target: str) -> None: ...
+
+    @abc.abstractmethod
+    def query_review(self, target: str, review: dict,
+                     opts: QueryOpts | None = None) -> tuple[list[Result], str | None]: ...
+
+    @abc.abstractmethod
+    def query_audit(self, target: str,
+                    opts: QueryOpts | None = None) -> tuple[list[Result], str | None]: ...
+
+    @abc.abstractmethod
+    def dump(self) -> dict: ...

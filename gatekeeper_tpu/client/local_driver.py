@@ -1,0 +1,207 @@
+"""The ``local`` driver: scalar oracle engine.
+
+Evaluates the hooks dataflow (reference: regolib/src.go — violation =
+autoreject ∪ (matching_constraints × template violation); audit =
+matching_reviews_and_constraints × template violation) entirely on host
+with the scalar interpreter.  This is the conformance reference and the
+development engine, playing the role of drivers/local in the reference
+(in-process OPA, local.go:28).  The jax driver must agree with it
+everywhere.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterator
+
+from gatekeeper_tpu.api.templates import CompiledTemplate
+from gatekeeper_tpu.client.interface import Driver, QueryOpts
+from gatekeeper_tpu.client.targets import TargetHandler
+from gatekeeper_tpu.client.types import Result
+from gatekeeper_tpu.errors import ClientError
+from gatekeeper_tpu.rego.values import Obj, freeze, thaw
+from gatekeeper_tpu.store.table import ResourceMeta, ResourceTable
+
+
+class TargetState:
+    def __init__(self):
+        self.table = ResourceTable()
+        self.templates: dict[str, CompiledTemplate] = {}
+        self.constraints: dict[str, dict[str, dict]] = {}  # kind -> name -> raw
+        self._frozen_constraints: dict[tuple[str, str], Any] = {}
+        self._inv_cache: tuple[int, Any] | None = None
+
+    def all_constraints(self) -> Iterator[dict]:
+        for kind in sorted(self.constraints):
+            for name in sorted(self.constraints[kind]):
+                yield self.constraints[kind][name]
+
+    def inventory_doc(self) -> Any:
+        """Frozen {"cluster": ..., "namespace": ...} doc — the shape of
+        data.external[target] that templates see as data.inventory
+        (regolib/src.go:55-60).  Cached per table generation."""
+        gen = self.table.generation
+        if self._inv_cache is not None and self._inv_cache[0] == gen:
+            return self._inv_cache[1]
+        import urllib.parse
+
+        cluster: dict = {}
+        namespace: dict = {}
+        for key, row in self.table.rows_items():
+            meta = self.table.meta_at(row)
+            obj = self.table.object_at(row)
+            if meta is None:
+                continue
+            escaped = urllib.parse.quote(meta.api_version, safe="")
+            if meta.namespace is None:
+                cluster.setdefault(escaped, {}).setdefault(meta.kind, {})[meta.name] = obj
+            else:
+                namespace.setdefault(meta.namespace, {}).setdefault(
+                    escaped, {}).setdefault(meta.kind, {})[meta.name] = obj
+        frozen = freeze({"inventory": {"cluster": cluster, "namespace": namespace}})
+        self._inv_cache = (gen, frozen)
+        return frozen
+
+
+class LocalDriver(Driver):
+    """Scalar reference engine (tracing mirrors local.New(local.Tracing(true)),
+    main.go:68: construction-time default, overridable per query)."""
+
+    def __init__(self, tracing: bool = False):
+        self.default_tracing = tracing
+        self.targets: dict[str, TargetHandler] = {}
+        self.state: dict[str, TargetState] = {}
+
+    # ------------------------------------------------------------------
+
+    def init(self, targets: dict[str, TargetHandler]) -> None:
+        self.targets = dict(targets)
+        for name in targets:
+            self.state.setdefault(name, TargetState())
+
+    def _state(self, target: str) -> TargetState:
+        st = self.state.get(target)
+        if st is None:
+            raise ClientError(f"unknown target {target!r}")
+        return st
+
+    def put_template(self, target: str, kind: str, compiled: CompiledTemplate) -> None:
+        self._state(target).templates[kind] = compiled
+
+    def delete_template(self, target: str, kind: str) -> None:
+        st = self._state(target)
+        st.templates.pop(kind, None)
+        st.constraints.pop(kind, None)
+        for k in [k for k in st._frozen_constraints if k[0] == kind]:
+            del st._frozen_constraints[k]
+
+    def put_constraint(self, target: str, kind: str, name: str, constraint: dict) -> None:
+        st = self._state(target)
+        st.constraints.setdefault(kind, {})[name] = constraint
+        st._frozen_constraints[(kind, name)] = freeze(constraint)
+
+    def delete_constraint(self, target: str, kind: str, name: str) -> None:
+        st = self._state(target)
+        st.constraints.get(kind, {}).pop(name, None)
+        st._frozen_constraints.pop((kind, name), None)
+
+    def put_data(self, target: str, key: str, meta: ResourceMeta, obj: dict) -> None:
+        self._state(target).table.upsert(key, obj, meta)
+
+    def delete_data(self, target: str, key: str) -> bool:
+        return self._state(target).table.remove(key)
+
+    def wipe_data(self, target: str) -> None:
+        self._state(target).table.wipe()
+
+    # ------------------------------------------------------------------
+
+    def _frozen_constraint(self, st: TargetState, c: dict) -> Any:
+        kind = (c.get("kind"), (c.get("metadata") or {}).get("name"))
+        return st._frozen_constraints.get(kind) or freeze(c)
+
+    def _eval_pair(self, st: TargetState, target: str, compiled: CompiledTemplate,
+                   review: dict, frozen_review: Any, constraint: dict,
+                   trace: list | None) -> Iterator[Result]:
+        """One (review, constraint) evaluation — the regolib violation body
+        (src.go:19-34): input = {review, constraint}, data.inventory = inv."""
+        input_doc = Obj({"review": frozen_review,
+                         "constraint": self._frozen_constraint(st, constraint)})
+        inv = st.inventory_doc()
+        tracer: list | None = [] if trace is not None else None
+        for v in compiled.interp.query_set("violation", input_doc, inv, tracer=tracer):
+            if not isinstance(v, Obj) or "msg" not in v:
+                continue  # regolib accesses r.msg; absent msg -> no response
+            details = v["details"] if "details" in v else Obj()
+            yield Result(
+                msg=v["msg"] if isinstance(v["msg"], str) else str(thaw(v["msg"])),
+                metadata={"details": thaw(details)},
+                constraint=constraint,
+                review=review,
+            )
+        if trace is not None and tracer:
+            cname = (constraint.get("metadata") or {}).get("name")
+            for line in tracer:
+                trace.append(f"[{compiled.kind}/{cname}] {line}")
+
+    def query_review(self, target: str, review: dict,
+                     opts: QueryOpts | None = None) -> tuple[list[Result], str | None]:
+        st = self._state(target)
+        handler = self.targets[target]
+        tracing = opts.tracing if opts is not None else self.default_tracing
+        trace: list | None = [] if tracing else None
+        results: list[Result] = []
+
+        constraints = list(st.all_constraints())
+        # autoreject (regolib src.go:7-17)
+        for c, msg, details in handler.autoreject_review(review, constraints, st.table):
+            results.append(Result(msg=msg, metadata={"details": details},
+                                  constraint=c, review=review))
+        frozen_review = freeze(review)
+        for c in handler.matching_constraints(review, constraints, st.table):
+            compiled = st.templates.get(c.get("kind", ""))
+            if compiled is None:
+                continue
+            if trace is not None:
+                trace.append(f"eval {c.get('kind')}/{(c.get('metadata') or {}).get('name')} "
+                             f"review={review.get('name')}")
+            results.extend(self._eval_pair(st, target, compiled, review,
+                                           frozen_review, c, trace))
+        return results, ("\n".join(trace) if trace is not None else None)
+
+    def query_audit(self, target: str,
+                    opts: QueryOpts | None = None) -> tuple[list[Result], str | None]:
+        """The audit cross-product (regolib src.go:38-52 +
+        matching_reviews_and_constraints target.go:69-81): every cached
+        resource × every constraint.  No autoreject in the audit hook."""
+        st = self._state(target)
+        handler = self.targets[target]
+        tracing = opts.tracing if opts is not None else self.default_tracing
+        trace: list | None = [] if tracing else None
+        results: list[Result] = []
+        constraints = list(st.all_constraints())
+        for key, row in sorted(st.table.rows_items()):
+            meta = st.table.meta_at(row)
+            obj = st.table.object_at(row)
+            if meta is None:
+                continue
+            review = handler.make_review(meta, obj)
+            frozen_review = freeze(review)
+            for c in handler.matching_constraints(review, constraints, st.table):
+                compiled = st.templates.get(c.get("kind", ""))
+                if compiled is None:
+                    continue
+                results.extend(self._eval_pair(st, target, compiled, review,
+                                               frozen_review, c, trace))
+        return results, ("\n".join(trace) if trace is not None else None)
+
+    def dump(self) -> dict:
+        """All templates + constraints + data (local.go:251-284)."""
+        out: dict = {}
+        for tname, st in self.state.items():
+            out[tname] = {
+                "templates": {k: t.source for k, t in st.templates.items()},
+                "constraints": st.constraints,
+                "data": {key: st.table.object_at(row)
+                         for key, row in sorted(st.table.rows_items())},
+            }
+        return out

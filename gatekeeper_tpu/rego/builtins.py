@@ -441,7 +441,8 @@ def _to_set_members(x):
 def _json_marshal(x):
     from gatekeeper_tpu.rego.values import thaw
 
-    return json.dumps(thaw(x), separators=(",", ":"), sort_keys=False)
+    # OPA (Go) marshals object keys sorted
+    return json.dumps(thaw(x), separators=(",", ":"), sort_keys=True)
 
 
 def _json_unmarshal(s):

@@ -120,21 +120,24 @@ class Parser:
                 params.append(self.parse_expr())
                 if self.at("op", ","):
                     self.advance()
-            self._nlskip -= 1
             self.expect("op", ")")
+            self._nlskip -= 1
             args = tuple(params)
         elif self.at("op", "["):
             self.advance()
             self._nlskip += 1
             key = self.parse_expr()
-            self._nlskip -= 1
             self.expect("op", "]")
+            self._nlskip -= 1
             kind = "partial_set"
 
         if self.at("op", "=") or self.at("op", ":="):
             self.advance()
             self._nlskip += 1
             value = self.parse_expr()
+            # keep newline transparency only through the value expression
+            # itself; the body brace (or a newline ending a bodiless rule)
+            # must be seen by the caller
             self._nlskip -= 1
             if kind == "partial_set":
                 kind = "partial_obj"
@@ -281,8 +284,8 @@ class Parser:
                 self.advance()
                 self._nlskip += 1
                 idx = self.parse_expr()
-                self._nlskip -= 1
                 self.expect("op", "]")
+                self._nlskip -= 1
                 term = self._extend_ref(term, idx)
             elif self.at("op", "("):
                 term = self._parse_call(term)
@@ -312,8 +315,8 @@ class Parser:
                 raise ParseError(f"expected ',' or ')' in call args, got {self.cur().value!r}",
                                  self.cur().loc)
         self._union_ok = saved_union
-        self._nlskip -= 1
         self.expect("op", ")")
+        self._nlskip -= 1
         return Call(name=name, args=tuple(args))
 
     @staticmethod
@@ -355,8 +358,8 @@ class Parser:
             self._union_ok = True
             inner = self.parse_expr()
             self._union_ok = saved_union
-            self._nlskip -= 1
             self.expect("op", ")")
+            self._nlskip -= 1
             if isinstance(inner, Compare):
                 # parenthesized comparison used as a value-position bool expr
                 return Call(name=("internal", "compare"),

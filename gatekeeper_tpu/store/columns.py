@@ -1,0 +1,164 @@
+"""Columnar field-path extraction.
+
+Flattens JSON resources into fixed-dtype numpy columns for device
+evaluation: scalar paths become dense id/float arrays, list/dict paths
+become CSR ragged arrays.  Column *specs* are derived from template
+lowering (which field paths a template touches) plus the always-on match
+columns (gvk/name/namespace/labels, cf. pkg/target's match semantics).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Iterator
+
+import numpy as np
+
+from gatekeeper_tpu.store.interner import Interner, MISSING
+
+STAR = "*"
+
+
+@dataclasses.dataclass(frozen=True)
+class ColSpec:
+    """A field-path column request.
+
+    path: tuple of keys; "*" iterates a list (any number of stars).
+    mode:
+      'str'     scalar string -> int32 ids (MISSING if absent/non-string)
+      'num'     scalar number -> float64 + bool presence
+      'present' presence of any value at path -> bool
+      'keys'    dict keys at path -> CSR int32 ids
+      'items'   dict (key,value-str) at path -> CSR pairs
+      'strs'    string leaves (wildcard paths) -> CSR int32 ids
+      'nums'    number leaves -> CSR float64
+    """
+
+    path: tuple[str, ...]
+    mode: str
+
+
+@dataclasses.dataclass
+class ScalarColumn:
+    ids: np.ndarray            # int32 [n] (str mode)
+
+
+@dataclasses.dataclass
+class NumColumn:
+    values: np.ndarray         # float64 [n]
+    present: np.ndarray        # bool [n]
+
+
+@dataclasses.dataclass
+class PresenceColumn:
+    present: np.ndarray        # bool [n]
+
+
+@dataclasses.dataclass
+class CSRColumn:
+    values: np.ndarray         # int32 or float64 [total]
+    offsets: np.ndarray        # int32 [n+1]
+    # for 'items': parallel value ids
+    values2: np.ndarray | None = None
+
+    def row(self, i: int) -> np.ndarray:
+        return self.values[self.offsets[i]: self.offsets[i + 1]]
+
+
+def iter_path(obj: Any, path: tuple[str, ...]) -> Iterator[Any]:
+    """Yield every leaf value reachable via path ("*" fans out over lists)."""
+    if not path:
+        yield obj
+        return
+    head, rest = path[0], path[1:]
+    if head == STAR:
+        if isinstance(obj, list):
+            for x in obj:
+                yield from iter_path(x, rest)
+    elif isinstance(obj, dict):
+        if head in obj:
+            yield from iter_path(obj[head], rest)
+
+
+def get_path(obj: Any, path: tuple[str, ...]) -> Any:
+    """Single value at a star-free path, or None."""
+    for p in path:
+        if not isinstance(obj, dict) or p not in obj:
+            return None
+        obj = obj[p]
+    return obj
+
+
+def build_column(spec: ColSpec, objs: list, interner: Interner):
+    """objs: list of resource dicts (None rows are tombstones -> absent)."""
+    n = len(objs)
+    if spec.mode == "str":
+        ids = np.full((n,), MISSING, dtype=np.int32)
+        for i, o in enumerate(objs):
+            if o is None:
+                continue
+            v = get_path(o, spec.path)
+            if isinstance(v, str):
+                ids[i] = interner.intern(v)
+        return ScalarColumn(ids=ids)
+    if spec.mode == "num":
+        vals = np.zeros((n,), dtype=np.float64)
+        pres = np.zeros((n,), dtype=bool)
+        for i, o in enumerate(objs):
+            if o is None:
+                continue
+            v = get_path(o, spec.path)
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                vals[i] = float(v)
+                pres[i] = True
+        return NumColumn(values=vals, present=pres)
+    if spec.mode == "present":
+        pres = np.zeros((n,), dtype=bool)
+        for i, o in enumerate(objs):
+            if o is None:
+                continue
+            pres[i] = any(True for _ in iter_path(o, spec.path))
+        return PresenceColumn(present=pres)
+    if spec.mode in ("keys", "items"):
+        koffs = np.zeros((n + 1,), dtype=np.int32)
+        kids: list[int] = []
+        vids: list[int] = []
+        for i, o in enumerate(objs):
+            if o is not None:
+                d = get_path(o, spec.path)
+                if isinstance(d, dict):
+                    for k in sorted(d.keys()):
+                        if isinstance(k, str):
+                            kids.append(interner.intern(k))
+                            if spec.mode == "items":
+                                v = d[k]
+                                vids.append(interner.intern(v) if isinstance(v, str) else MISSING)
+            koffs[i + 1] = len(kids)
+        values2 = np.asarray(vids, dtype=np.int32) if spec.mode == "items" else None
+        return CSRColumn(values=np.asarray(kids, dtype=np.int32), offsets=koffs,
+                         values2=values2)
+    if spec.mode == "strs":
+        offs = np.zeros((n + 1,), dtype=np.int32)
+        out: list[int] = []
+        for i, o in enumerate(objs):
+            if o is not None:
+                for v in iter_path(o, spec.path):
+                    if isinstance(v, str):
+                        out.append(interner.intern(v))
+                    else:
+                        out.append(MISSING)
+            offs[i + 1] = len(out)
+        return CSRColumn(values=np.asarray(out, dtype=np.int32), offsets=offs)
+    if spec.mode == "nums":
+        offs = np.zeros((n + 1,), dtype=np.int32)
+        fout: list[float] = []
+        for i, o in enumerate(objs):
+            if o is not None:
+                for v in iter_path(o, spec.path):
+                    if isinstance(v, (int, float)) and not isinstance(v, bool):
+                        fout.append(float(v))
+                    else:
+                        fout.append(np.nan)
+            offs[i + 1] = len(fout)
+        return CSRColumn(values=np.asarray(fout, dtype=np.float64), offsets=offs)
+    raise ValueError(f"unknown column mode {spec.mode!r}")

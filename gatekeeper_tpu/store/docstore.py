@@ -1,0 +1,91 @@
+"""Path-addressed JSON document store (host side).
+
+The control-plane equivalent of the reference's transactional inmem store
+(vendor opa/storage/inmem/inmem.go:16-37): documents live in a nested dict
+tree addressed by `/`-separated paths like
+``/external/<target>/cluster/<gv>/<kind>/<name>`` (path layout from
+pkg/target/target.go:271-298).  Single-writer semantics are enforced by the
+GIL + the client's lock; no multi-statement transactions are needed because
+every reference write path is a single Put/Delete.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterator
+
+from gatekeeper_tpu.errors import StorageError
+
+
+def parse_path(path: str) -> list[str]:
+    if not path.startswith("/"):
+        raise StorageError(f"path must start with '/': {path!r}")
+    parts = [p for p in path.split("/") if p != ""]
+    if not parts:
+        raise StorageError("empty path")
+    return parts
+
+
+class DocStore:
+    def __init__(self):
+        self._root: dict = {}
+
+    def put(self, path: str, doc: Any) -> None:
+        parts = parse_path(path)
+        node = self._root
+        for p in parts[:-1]:
+            child = node.get(p)
+            if child is None:
+                child = {}
+                node[p] = child
+            elif not isinstance(child, dict):
+                # same guard as the reference's path-conflict check
+                # (drivers/local/local.go:133-164)
+                raise StorageError(f"path conflict at {p!r} writing {path!r}")
+            node = child
+        node[parts[-1]] = doc
+
+    def get(self, path: str, default: Any = None) -> Any:
+        node: Any = self._root
+        for p in parse_path(path):
+            if not isinstance(node, dict) or p not in node:
+                return default
+            node = node[p]
+        return node
+
+    def delete(self, path: str) -> bool:
+        parts = parse_path(path)
+        node: Any = self._root
+        for p in parts[:-1]:
+            if not isinstance(node, dict) or p not in node:
+                return False
+            node = node[p]
+        if isinstance(node, dict) and parts[-1] in node:
+            del node[parts[-1]]
+            return True
+        return False
+
+    def delete_subtree(self, path: str) -> bool:
+        """WipeData semantics (config_controller.go:178-188 wipes /external/<t>)."""
+        return self.delete(path)
+
+    def snapshot(self) -> dict:
+        """Full data dump (Driver.Dump equivalent, local.go:251-284)."""
+        import copy
+
+        return copy.deepcopy(self._root)
+
+    def walk(self, path: str) -> Iterator[tuple[str, Any]]:
+        """Yield (subpath, leaf_doc) under path; leaves are non-dict values
+        or dicts at the depth callers treat as documents."""
+        base = self.get(path)
+        if base is None:
+            return
+
+        def rec(prefix: str, node: Any) -> Iterator[tuple[str, Any]]:
+            if isinstance(node, dict):
+                for k, v in node.items():
+                    yield from rec(f"{prefix}/{k}", v)
+            else:
+                yield prefix, node
+
+        yield from rec(path.rstrip("/"), base)

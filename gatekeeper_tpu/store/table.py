@@ -1,0 +1,216 @@
+"""The resource table: host objects + columnar mirror.
+
+This is the TPU-first replacement for iterating
+``data.external[target].{cluster,namespace}[...]`` one document at a time
+(the reference's audit hot loop, regolib/src.go:38-52 +
+target.go:69-81): resources occupy stable rows; identity columns
+(group/version/kind/name/namespace ids) and template-demanded field
+columns are materialized as numpy arrays and shipped to device.  Rows are
+tombstoned on delete and compacted when garbage accumulates.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import numpy as np
+
+from gatekeeper_tpu.store.columns import ColSpec, build_column
+from gatekeeper_tpu.store.interner import Interner, MISSING
+
+
+@dataclasses.dataclass(frozen=True)
+class ResourceMeta:
+    api_version: str          # "v1" or "group/version"
+    kind: str
+    name: str
+    namespace: str | None     # None => cluster-scoped
+
+    @property
+    def group(self) -> str:
+        return self.api_version.split("/")[0] if "/" in self.api_version else ""
+
+    @property
+    def version(self) -> str:
+        return self.api_version.split("/")[1] if "/" in self.api_version else self.api_version
+
+
+@dataclasses.dataclass
+class IdentityColumns:
+    group_ids: np.ndarray      # int32 [n]
+    version_ids: np.ndarray
+    kind_ids: np.ndarray
+    name_ids: np.ndarray
+    ns_ids: np.ndarray         # MISSING for cluster-scoped
+    alive: np.ndarray          # bool [n]
+    label_keys: np.ndarray     # CSR over metadata.labels
+    label_vals: np.ndarray
+    label_offsets: np.ndarray
+
+
+class ResourceTable:
+    def __init__(self, interner: Interner | None = None):
+        self.interner = interner or Interner()
+        self._objs: list[Any] = []
+        self._metas: list[ResourceMeta | None] = []
+        self._rows: dict[str, int] = {}      # path key -> row
+        self._free: list[int] = []
+        self.generation = 0
+        self._col_cache: dict[ColSpec, tuple[int, Any]] = {}
+        self._identity_cache: tuple[int, IdentityColumns] | None = None
+
+    # ------------------------------------------------------------------
+
+    def __len__(self) -> int:
+        return len(self._rows)
+
+    @property
+    def n_rows(self) -> int:
+        return len(self._objs)
+
+    def upsert(self, key: str, obj: dict, meta: ResourceMeta) -> int:
+        row = self._rows.get(key)
+        if row is None:
+            if self._free:
+                row = self._free.pop()
+                self._objs[row] = obj
+                self._metas[row] = meta
+            else:
+                row = len(self._objs)
+                self._objs.append(obj)
+                self._metas.append(meta)
+            self._rows[key] = row
+        else:
+            self._objs[row] = obj
+            self._metas[row] = meta
+        self.generation += 1
+        return row
+
+    def bulk_upsert(self, entries: list[tuple[str, dict, ResourceMeta]]) -> None:
+        for key, obj, meta in entries:
+            row = self._rows.get(key)
+            if row is None:
+                if self._free:
+                    row = self._free.pop()
+                    self._objs[row] = obj
+                    self._metas[row] = meta
+                else:
+                    row = len(self._objs)
+                    self._objs.append(obj)
+                    self._metas.append(meta)
+                self._rows[key] = row
+            else:
+                self._objs[row] = obj
+                self._metas[row] = meta
+        self.generation += 1
+
+    def remove(self, key: str) -> bool:
+        row = self._rows.pop(key, None)
+        if row is None:
+            return False
+        self._objs[row] = None
+        self._metas[row] = None
+        self._free.append(row)
+        self.generation += 1
+        if len(self._free) > 64 and len(self._free) > len(self._rows):
+            self.compact()
+        return True
+
+    def wipe(self) -> None:
+        self._objs.clear()
+        self._metas.clear()
+        self._rows.clear()
+        self._free.clear()
+        self._col_cache.clear()
+        self._identity_cache = None
+        self.generation += 1
+
+    def compact(self) -> None:
+        """Drop tombstoned rows; row ids are reassigned."""
+        new_objs, new_metas, new_rows = [], [], {}
+        for key, row in self._rows.items():
+            new_rows[key] = len(new_objs)
+            new_objs.append(self._objs[row])
+            new_metas.append(self._metas[row])
+        self._objs, self._metas, self._rows = new_objs, new_metas, new_rows
+        self._free = []
+        self.generation += 1
+
+    # ------------------------------------------------------------------
+
+    def object_at(self, row: int) -> Any:
+        return self._objs[row]
+
+    def meta_at(self, row: int) -> ResourceMeta | None:
+        return self._metas[row]
+
+    def rows_items(self):
+        """(key, row) pairs for live rows."""
+        return self._rows.items()
+
+    def lookup(self, key: str) -> int | None:
+        """Row index for a cache path key, or None."""
+        return self._rows.get(key)
+
+    # ------------------------------------------------------------------
+    # columns
+
+    def column(self, spec: ColSpec):
+        hit = self._col_cache.get(spec)
+        if hit is not None and hit[0] == self.generation:
+            return hit[1]
+        col = build_column(spec, self._objs, self.interner)
+        self._col_cache[spec] = (self.generation, col)
+        return col
+
+    def identity(self) -> IdentityColumns:
+        if self._identity_cache is not None and \
+                self._identity_cache[0] == self.generation:
+            return self._identity_cache[1]
+        n = len(self._objs)
+        it = self.interner
+        gi = np.full((n,), MISSING, dtype=np.int32)
+        vi = np.full((n,), MISSING, dtype=np.int32)
+        ki = np.full((n,), MISSING, dtype=np.int32)
+        ni = np.full((n,), MISSING, dtype=np.int32)
+        si = np.full((n,), MISSING, dtype=np.int32)
+        alive = np.zeros((n,), dtype=bool)
+        for i, m in enumerate(self._metas):
+            if m is None:
+                continue
+            alive[i] = True
+            gi[i] = it.intern(m.group)
+            vi[i] = it.intern(m.version)
+            ki[i] = it.intern(m.kind)
+            ni[i] = it.intern(m.name)
+            if m.namespace is not None:
+                si[i] = it.intern(m.namespace)
+        labels = self.column(ColSpec(("metadata", "labels"), "items"))
+        ident = IdentityColumns(
+            group_ids=gi, version_ids=vi, kind_ids=ki, name_ids=ni, ns_ids=si,
+            alive=alive, label_keys=labels.values,
+            label_vals=labels.values2 if labels.values2 is not None else labels.values,
+            label_offsets=labels.offsets)
+        self._identity_cache = (self.generation, ident)
+        return ident
+
+    def namespace_label_items(self) -> dict[int, list[tuple[int, int]]]:
+        """ns name id -> [(label key id, label value id)] for every cached
+        v1/Namespace resource — feeds namespaceSelector matching
+        (target.go:236-255) and the autoreject uncached-namespace check."""
+        out: dict[int, list[tuple[int, int]]] = {}
+        it = self.interner
+        for i, m in enumerate(self._metas):
+            if m is None or m.kind != "Namespace" or m.api_version != "v1":
+                continue
+            obj = self._objs[i]
+            labels = obj.get("metadata", {}).get("labels", {}) if isinstance(obj, dict) else {}
+            items = []
+            if isinstance(labels, dict):
+                for k in sorted(labels):
+                    v = labels[k]
+                    if isinstance(k, str):
+                        items.append((it.intern(k), it.intern(v) if isinstance(v, str) else MISSING))
+            out[it.intern(m.name)] = items
+        return out

@@ -1,0 +1,326 @@
+"""Engine conformance suite.
+
+Port of the reference's driver-agnostic scenario table
+(vendor/.../constraint/pkg/client/e2e_tests.go:63, executed at
+client_test.go:17-23) plus the test target (test_handler.go:14-119).
+Every Driver implementation must pass these scenarios verbatim; the
+fixture is parametrized so the jax driver is added alongside local.
+"""
+
+import pytest
+
+from gatekeeper_tpu.client.client import Backend, Client
+from gatekeeper_tpu.client.local_driver import LocalDriver
+from gatekeeper_tpu.client.targets import TargetHandler, UnhandledData, WipeData
+from gatekeeper_tpu.errors import ClientError, CompileError
+from gatekeeper_tpu.store.table import ResourceMeta
+
+
+class TestTarget(TargetHandler):
+    """Native port of test_handler.go: data keyed by Name, constraints match
+    when their kind equals review.ForConstraint, autoreject when a
+    constraint has match.namespaceSelector and no v1/Namespace is cached."""
+
+    name = "test.target"
+
+    def process_data(self, obj):
+        if isinstance(obj, dict) and "Name" in obj:
+            meta = ResourceMeta(api_version="v1", kind="TestData",
+                                name=obj["Name"], namespace=None)
+            return obj["Name"], meta, obj
+        raise UnhandledData(f"unhandled: {obj!r}")
+
+    def handle_review(self, obj):
+        if isinstance(obj, dict) and "Name" in obj:
+            return obj
+        raise UnhandledData(f"unhandled review: {obj!r}")
+
+    def handle_violation(self, result):
+        result.resource = result.review
+
+    def match_schema(self):
+        return {"properties": {"label": {"type": "string"}}}
+
+    def validate_constraint(self, constraint):
+        return None
+
+    def make_review(self, meta, obj):
+        return obj
+
+    def matching_constraints(self, review, constraints, table):
+        for c in constraints:
+            if c.get("kind") == review.get("ForConstraint"):
+                yield c
+
+    def autoreject_review(self, review, constraints, table):
+        has_ns = any(
+            (m := table.meta_at(row)) is not None and m.kind == "Namespace"
+            and m.api_version == "v1"
+            for _, row in table.rows_items())
+        out = []
+        for c in constraints:
+            match = (c.get("spec") or {}).get("match") or {}
+            if "namespaceSelector" in match and not has_ns:
+                out.append((c, "REJECTION", {}))
+        return out
+
+
+DENY_ALL = """package foo
+violation[{"msg": "DENIED", "details": {}}] {
+	"always" == "always"
+}"""
+
+
+def template_doc(kind: str, rego: str) -> dict:
+    return {
+        "apiVersion": "templates.gatekeeper.sh/v1alpha1",
+        "kind": "ConstraintTemplate",
+        "metadata": {"name": kind.lower()},
+        "spec": {
+            "crd": {"spec": {
+                "names": {"kind": kind},
+                "validation": {"openAPIV3Schema": {
+                    "properties": {"expected": {"type": "string"}}}},
+            }},
+            "targets": [{"target": "test.target", "rego": rego}],
+        },
+    }
+
+
+def constraint_doc(kind: str, name: str, params=None) -> dict:
+    doc = {
+        "apiVersion": "constraints.gatekeeper.sh/v1alpha1",
+        "kind": kind,
+        "metadata": {"name": name},
+        "spec": {},
+    }
+    if params:
+        doc["spec"]["parameters"] = params
+    return doc
+
+
+DRIVERS = ["local"]
+
+
+def make_driver(name: str):
+    if name == "local":
+        return LocalDriver()
+    if name == "jax":
+        from gatekeeper_tpu.client.jax_driver import JaxDriver
+
+        return JaxDriver()
+    raise ValueError(name)
+
+
+@pytest.fixture(params=DRIVERS)
+def client(request):
+    backend = Backend(make_driver(request.param))
+    return backend.new_client([TestTarget()])
+
+
+class TestScenarios:
+    def test_add_template(self, client):
+        client.add_template(template_doc("Foo", DENY_ALL))
+
+    def test_deny_all(self, client):
+        client.add_template(template_doc("Foo", DENY_ALL))
+        cstr = constraint_doc("Foo", "ph")
+        client.add_constraint(cstr)
+        rsps = client.review({"Name": "Sara", "ForConstraint": "Foo"})
+        assert rsps.by_target
+        results = rsps.results()
+        assert len(results) == 1
+        assert results[0].constraint == cstr
+        assert results[0].msg == "DENIED"
+
+    def test_deny_all_audit(self, client):
+        client.add_template(template_doc("Foo", DENY_ALL))
+        cstr = constraint_doc("Foo", "ph")
+        client.add_constraint(cstr)
+        obj = {"Name": "Sara", "ForConstraint": "Foo"}
+        client.add_data(obj)
+        rsps = client.audit()
+        results = rsps.results()
+        assert len(results) == 1
+        assert results[0].constraint == cstr
+        assert results[0].msg == "DENIED"
+        assert results[0].resource == obj
+
+    def test_deny_all_audit_x2(self, client):
+        client.add_template(template_doc("Foo", DENY_ALL))
+        cstr = constraint_doc("Foo", "ph")
+        client.add_constraint(cstr)
+        client.add_data({"Name": "Sara", "ForConstraint": "Foo"})
+        client.add_data({"Name": "Max", "ForConstraint": "Foo"})
+        results = client.audit().results()
+        assert len(results) == 2
+        for r in results:
+            assert r.constraint == cstr
+            assert r.msg == "DENIED"
+
+    def test_autoreject_all(self, client):
+        client.add_template(template_doc("Foo", DENY_ALL))
+        cstr = constraint_doc("Foo", "foo-pod")
+        cstr["spec"]["match"] = {
+            "kinds": [{"apiGroups": [""], "kinds": ["Pod"]}],
+            "namespaceSelector": {"matchExpressions": [
+                {"key": "someKey", "operator": "Blah", "values": ["some value"]}]},
+        }
+        cstr["spec"]["parameters"] = {"key": ["value"]}
+        client.add_constraint(cstr)
+        results = client.review({"Name": "Sara", "ForConstraint": "Foo"}).results()
+        assert len(results) == 2
+        msgs = sorted(r.msg for r in results)
+        assert "REJECTION" in msgs
+        for r in results:
+            if r.msg == "REJECTION":
+                assert r.constraint == cstr
+
+    def test_remove_data(self, client):
+        client.add_template(template_doc("Foo", DENY_ALL))
+        client.add_constraint(constraint_doc("Foo", "ph"))
+        obj = {"Name": "Sara", "ForConstraint": "Foo"}
+        obj2 = {"Name": "Max", "ForConstraint": "Foo"}
+        client.add_data(obj)
+        client.add_data(obj2)
+        assert len(client.audit().results()) == 2
+        client.remove_data(obj2)
+        results = client.audit().results()
+        assert len(results) == 1
+        assert results[0].resource == obj
+
+    def test_remove_constraint(self, client):
+        client.add_template(template_doc("Foo", DENY_ALL))
+        cstr = constraint_doc("Foo", "ph")
+        client.add_constraint(cstr)
+        client.add_data({"Name": "Sara", "ForConstraint": "Foo"})
+        assert len(client.audit().results()) == 1
+        client.remove_constraint(cstr)
+        assert client.audit().results() == []
+
+    def test_remove_template(self, client):
+        tmpl = template_doc("Foo", DENY_ALL)
+        client.add_template(tmpl)
+        client.add_constraint(constraint_doc("Foo", "ph"))
+        client.add_data({"Name": "Sara", "ForConstraint": "Foo"})
+        assert len(client.audit().results()) == 1
+        client.remove_template(tmpl)
+        assert client.audit().results() == []
+
+    def test_tracing_off(self, client):
+        client.add_template(template_doc("Foo", DENY_ALL))
+        client.add_constraint(constraint_doc("Foo", "ph"))
+        rsps = client.review({"Name": "Sara", "ForConstraint": "Foo"})
+        assert len(rsps.results()) == 1
+        for r in rsps.by_target.values():
+            assert r.trace is None
+
+    def test_tracing_on(self, client):
+        client.add_template(template_doc("Foo", DENY_ALL))
+        client.add_constraint(constraint_doc("Foo", "ph"))
+        rsps = client.review({"Name": "Sara", "ForConstraint": "Foo"}, tracing=True)
+        assert len(rsps.results()) == 1
+        for r in rsps.by_target.values():
+            assert r.trace is not None
+
+    def test_audit_tracing_enabled(self, client):
+        client.add_template(template_doc("Foo", DENY_ALL))
+        client.add_constraint(constraint_doc("Foo", "ph"))
+        client.add_data({"Name": "Sara", "ForConstraint": "Foo"})
+        client.add_data({"Name": "Max", "ForConstraint": "Foo"})
+        rsps = client.audit(tracing=True)
+        assert len(rsps.results()) == 2
+        for r in rsps.by_target.values():
+            assert r.trace is not None
+
+    def test_audit_tracing_disabled(self, client):
+        client.add_template(template_doc("Foo", DENY_ALL))
+        client.add_constraint(constraint_doc("Foo", "ph"))
+        client.add_data({"Name": "Sara", "ForConstraint": "Foo"})
+        rsps = client.audit(tracing=False)
+        for r in rsps.by_target.values():
+            assert r.trace is None
+
+
+class TestClientValidation:
+    """Template/constraint validation (client_test.go:132-294 +
+    rego_helpers_test.go equivalents)."""
+
+    def test_template_missing_violation_rule(self, client):
+        with pytest.raises(CompileError, match="violation"):
+            client.add_template(template_doc("Foo", "package foo\nx = 1 { true }"))
+
+    def test_template_bad_rego(self, client):
+        with pytest.raises(Exception):
+            client.add_template(template_doc("Foo", "package foo\nviolation[{]"))
+
+    def test_template_import_banned(self, client):
+        with pytest.raises(CompileError, match="import"):
+            client.add_template(template_doc(
+                "Foo", "package foo\nimport data.x\nviolation[{\"msg\": \"m\"}] { true }"))
+
+    def test_template_data_ref_restricted(self, client):
+        with pytest.raises(CompileError, match="data.inventory"):
+            client.add_template(template_doc(
+                "Foo", 'package foo\nviolation[{"msg": "m"}] { data.external.x }'))
+
+    def test_template_data_inventory_allowed(self, client):
+        client.add_template(template_doc(
+            "Foo", 'package foo\nviolation[{"msg": "m"}] { data.inventory.cluster }'))
+
+    def test_template_name_must_match_kind(self, client):
+        doc = template_doc("Foo", DENY_ALL)
+        doc["metadata"]["name"] = "wrongname"
+        with pytest.raises(ClientError, match="lowercase"):
+            client.add_template(doc)
+
+    def test_constraint_unknown_kind(self, client):
+        with pytest.raises(ClientError, match="no template"):
+            client.add_constraint(constraint_doc("Nope", "x"))
+
+    def test_constraint_bad_group(self, client):
+        client.add_template(template_doc("Foo", DENY_ALL))
+        c = constraint_doc("Foo", "ph")
+        c["apiVersion"] = "wrong.group/v1"
+        with pytest.raises(ClientError, match="apiVersion"):
+            client.add_constraint(c)
+
+    def test_constraint_bad_name(self, client):
+        client.add_template(template_doc("Foo", DENY_ALL))
+        with pytest.raises(ClientError, match="DNS-1123"):
+            client.add_constraint(constraint_doc("Foo", "Bad_Name!"))
+
+    def test_constraint_schema_type_mismatch(self, client):
+        client.add_template(template_doc("Foo", DENY_ALL))
+        c = constraint_doc("Foo", "ph", params={"expected": 42})
+        with pytest.raises(ClientError, match="expected string"):
+            client.add_constraint(c)
+
+    def test_wipe_data(self, client):
+        client.add_template(template_doc("Foo", DENY_ALL))
+        client.add_constraint(constraint_doc("Foo", "ph"))
+        client.add_data({"Name": "Sara", "ForConstraint": "Foo"})
+        assert len(client.audit().results()) == 1
+        client.remove_data(WipeData())
+        assert client.audit().results() == []
+
+    def test_dump(self, client):
+        client.add_template(template_doc("Foo", DENY_ALL))
+        client.add_constraint(constraint_doc("Foo", "ph"))
+        client.add_data({"Name": "Sara", "ForConstraint": "Foo"})
+        d = client.dump()
+        assert "Foo" in d["test.target"]["templates"]
+        assert "Sara" in d["test.target"]["data"]
+
+    def test_reset(self, client):
+        client.add_template(template_doc("Foo", DENY_ALL))
+        client.add_constraint(constraint_doc("Foo", "ph"))
+        client.add_data({"Name": "Sara", "ForConstraint": "Foo"})
+        client.reset()
+        assert client.audit().results() == []
+
+    def test_backend_single_client(self):
+        backend = Backend(LocalDriver())
+        backend.new_client([TestTarget()])
+        with pytest.raises(ClientError, match="one client"):
+            backend.new_client([TestTarget()])

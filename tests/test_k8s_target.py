@@ -1,0 +1,320 @@
+"""K8s target tests (port of pkg/target/target_test.go: TestProcessData
+:339, TestHandleViolation :243, TestValidateConstraint :29 — plus match
+library semantics from the target Rego, target.go:49-255)."""
+
+import pytest
+
+from gatekeeper_tpu.client.client import Backend
+from gatekeeper_tpu.client.local_driver import LocalDriver
+from gatekeeper_tpu.client.types import Result
+from gatekeeper_tpu.errors import ClientError
+from gatekeeper_tpu.store.table import ResourceTable
+from gatekeeper_tpu.target.k8s import (
+    K8sValidationTarget, match_expression_violated, matches_label_selector)
+
+
+def make_obj(kind="Pod", api_version="v1", name="x", namespace=None, labels=None):
+    obj = {"apiVersion": api_version, "kind": kind,
+           "metadata": {"name": name}}
+    if namespace:
+        obj["metadata"]["namespace"] = namespace
+    if labels is not None:
+        obj["metadata"]["labels"] = labels
+    return obj
+
+
+def constraint(kind="Foo", name="c", match=None):
+    c = {"apiVersion": "constraints.gatekeeper.sh/v1alpha1", "kind": kind,
+         "metadata": {"name": name}, "spec": {}}
+    if match is not None:
+        c["spec"]["match"] = match
+    return c
+
+
+class TestProcessData:
+    def setup_method(self):
+        self.h = K8sValidationTarget()
+
+    def test_cluster_scoped(self):
+        key, meta, obj = self.h.process_data(make_obj("Namespace", "v1", "foo"))
+        assert key == "cluster/v1/Namespace/foo"
+        assert meta.kind == "Namespace" and meta.namespace is None
+
+    def test_namespace_scoped(self):
+        key, meta, _ = self.h.process_data(make_obj("Pod", "v1", "p", namespace="ns1"))
+        assert key == "namespace/ns1/v1/Pod/p"
+        assert meta.namespace == "ns1"
+
+    def test_grouped_api_version_escaped(self):
+        key, meta, _ = self.h.process_data(
+            make_obj("Deployment", "apps/v1", "d", namespace="ns1"))
+        # url.PathEscape keeps apiVersion a single path segment (target.go:281-283)
+        assert key == "namespace/ns1/apps%2Fv1/Deployment/d"
+        assert meta.group == "apps" and meta.version == "v1"
+
+    def test_no_version_error(self):
+        with pytest.raises(ClientError, match="version"):
+            self.h.process_data({"kind": "Pod", "metadata": {"name": "x"}})
+
+    def test_no_kind_error(self):
+        with pytest.raises(ClientError, match="kind"):
+            self.h.process_data({"apiVersion": "v1", "metadata": {"name": "x"}})
+
+
+class TestHandleViolation:
+    def test_reconstructs_object(self):
+        h = K8sValidationTarget()
+        r = Result(review={
+            "kind": {"group": "", "version": "v1", "kind": "Pod"},
+            "object": {"metadata": {"name": "p"}, "spec": {}},
+        })
+        h.handle_violation(r)
+        assert r.resource["apiVersion"] == "v1"
+        assert r.resource["kind"] == "Pod"
+        assert r.resource["metadata"]["name"] == "p"
+
+    def test_grouped_api_version(self):
+        h = K8sValidationTarget()
+        r = Result(review={
+            "kind": {"group": "apps", "version": "v1", "kind": "Deployment"},
+            "object": {"metadata": {"name": "d"}},
+        })
+        h.handle_violation(r)
+        assert r.resource["apiVersion"] == "apps/v1"
+
+    def test_missing_object_errors(self):
+        h = K8sValidationTarget()
+        with pytest.raises(ClientError, match="object"):
+            h.handle_violation(Result(review={
+                "kind": {"group": "", "version": "v1", "kind": "Pod"}}))
+
+
+class TestLabelSelector:
+    def test_match_labels(self):
+        assert matches_label_selector({"matchLabels": {"a": "1"}}, {"a": "1", "b": "2"})
+        assert not matches_label_selector({"matchLabels": {"a": "1"}}, {"a": "2"})
+        assert not matches_label_selector({"matchLabels": {"a": "1"}}, {})
+
+    def test_in_missing_key_violates(self):
+        assert match_expression_violated("In", {}, "k", ["v"]) is True
+
+    def test_in_empty_values_disarmed(self):
+        # count(values) > 0 guard (target.go:183): empty In matches when key present
+        assert match_expression_violated("In", {"k": "x"}, "k", []) is False
+
+    def test_notin_missing_key_ok(self):
+        assert match_expression_violated("NotIn", {}, "k", ["v"]) is False
+
+    def test_notin_present_in_values_violates(self):
+        assert match_expression_violated("NotIn", {"k": "v"}, "k", ["v"]) is True
+
+    def test_exists_doesnotexist(self):
+        assert match_expression_violated("Exists", {}, "k", []) is True
+        assert match_expression_violated("Exists", {"k": "1"}, "k", []) is False
+        assert match_expression_violated("DoesNotExist", {"k": "1"}, "k", []) is True
+
+    def test_unknown_operator_no_violation(self):
+        assert match_expression_violated("Blah", {"k": "1"}, "k", ["x"]) is False
+
+
+class TestMatching:
+    def setup_method(self):
+        self.h = K8sValidationTarget()
+        self.table = ResourceTable()
+
+    def add_ns(self, name, labels=None):
+        obj = make_obj("Namespace", "v1", name, labels=labels or {})
+        key, meta, doc = self.h.process_data(obj)
+        self.table.upsert(key, doc, meta)
+
+    def review(self, obj, namespace=None):
+        rev = {"kind": {"group": "", "version": obj["apiVersion"], "kind": obj["kind"]},
+               "name": obj["metadata"]["name"], "operation": "CREATE", "object": obj}
+        if namespace:
+            rev["namespace"] = namespace
+        return rev
+
+    def match_list(self, c, review):
+        return list(self.h.matching_constraints(review, [c], self.table))
+
+    def test_default_match_everything(self):
+        c = constraint()
+        assert self.match_list(c, self.review(make_obj())) == [c]
+
+    def test_kind_selector(self):
+        c = constraint(match={"kinds": [{"apiGroups": [""], "kinds": ["Pod"]}]})
+        assert self.match_list(c, self.review(make_obj("Pod"))) == [c]
+        assert self.match_list(c, self.review(make_obj("Service"))) == []
+
+    def test_kind_selector_wildcards(self):
+        c = constraint(match={"kinds": [{"apiGroups": ["*"], "kinds": ["*"]}]})
+        assert self.match_list(c, self.review(make_obj("Anything"))) == [c]
+
+    def test_namespaces(self):
+        c = constraint(match={"namespaces": ["production"]})
+        pod = make_obj("Pod", namespace="production")
+        assert self.match_list(c, self.review(pod, "production")) == [c]
+        assert self.match_list(c, self.review(pod, "staging")) == []
+        # review without namespace does not match a namespaces list
+        assert self.match_list(c, self.review(make_obj("Pod"))) == []
+
+    def test_label_selector(self):
+        c = constraint(match={"labelSelector": {"matchLabels": {"app": "x"}}})
+        assert self.match_list(c, self.review(make_obj(labels={"app": "x"}))) == [c]
+        assert self.match_list(c, self.review(make_obj(labels={"app": "y"}))) == []
+
+    def test_namespace_selector(self):
+        self.add_ns("prod", labels={"env": "prod"})
+        c = constraint(match={"namespaceSelector": {"matchLabels": {"env": "prod"}}})
+        pod = make_obj("Pod", namespace="prod")
+        assert self.match_list(c, self.review(pod, "prod")) == [c]
+        self.add_ns("dev", labels={"env": "dev"})
+        assert self.match_list(c, self.review(pod, "dev")) == []
+
+    def test_namespace_selector_uncached_no_match_and_autorejects(self):
+        c = constraint(match={"namespaceSelector": {"matchLabels": {"env": "prod"}}})
+        pod = make_obj("Pod", namespace="ghost")
+        rev = self.review(pod, "ghost")
+        assert self.match_list(c, rev) == []
+        rejections = self.h.autoreject_review(rev, [c], self.table)
+        assert len(rejections) == 1
+        assert rejections[0][1] == "Namespace is not cached in OPA."
+
+    def test_autoreject_only_for_nsselector_constraints(self):
+        c = constraint(match={"namespaces": ["x"]})
+        rev = self.review(make_obj("Pod", namespace="ghost"), "ghost")
+        assert self.h.autoreject_review(rev, [c], self.table) == []
+
+
+class TestValidateConstraint:
+    def setup_method(self):
+        self.h = K8sValidationTarget()
+
+    def test_valid(self):
+        self.h.validate_constraint(constraint(match={
+            "labelSelector": {"matchExpressions": [
+                {"key": "k", "operator": "In", "values": ["a"]}]}}))
+
+    def test_bad_operator(self):
+        with pytest.raises(ClientError, match="invalid operator"):
+            self.h.validate_constraint(constraint(match={
+                "labelSelector": {"matchExpressions": [
+                    {"key": "k", "operator": "Blah", "values": ["a"]}]}}))
+
+    def test_in_requires_values(self):
+        with pytest.raises(ClientError, match="non-empty values"):
+            self.h.validate_constraint(constraint(match={
+                "namespaceSelector": {"matchExpressions": [
+                    {"key": "k", "operator": "In", "values": []}]}}))
+
+    def test_exists_forbids_values(self):
+        with pytest.raises(ClientError, match="forbids values"):
+            self.h.validate_constraint(constraint(match={
+                "labelSelector": {"matchExpressions": [
+                    {"key": "k", "operator": "Exists", "values": ["a"]}]}}))
+
+
+class TestFrameworkInjection:
+    """target_test.go:16 TestFrameworkInjection — target registers cleanly."""
+
+    def test_client_with_k8s_target(self):
+        backend = Backend(LocalDriver())
+        client = backend.new_client([K8sValidationTarget()])
+        assert "admission.k8s.gatekeeper.sh" in client.targets
+
+
+class TestEndToEndK8s:
+    """The demo/basic audit loop shape: sync namespaces, one RequiredLabels
+    constraint, audit -> violations for unlabeled namespaces."""
+
+    REQUIRED_LABELS = """package k8srequiredlabels
+violation[{"msg": msg, "details": {"missing_labels": missing}}] {
+  provided := {label | input.review.object.metadata.labels[label]}
+  required := {label | label := input.constraint.spec.parameters.labels[_]}
+  missing := required - provided
+  count(missing) > 0
+  msg := sprintf("you must provide labels: %v", [missing])
+}"""
+
+    def template_doc(self):
+        return {
+            "apiVersion": "templates.gatekeeper.sh/v1alpha1",
+            "kind": "ConstraintTemplate",
+            "metadata": {"name": "k8srequiredlabels"},
+            "spec": {
+                "crd": {"spec": {"names": {"kind": "K8sRequiredLabels"},
+                                 "validation": {"openAPIV3Schema": {"properties": {
+                                     "labels": {"type": "array",
+                                                "items": {"type": "string"}}}}}}},
+                "targets": [{"target": "admission.k8s.gatekeeper.sh",
+                             "rego": self.REQUIRED_LABELS}],
+            },
+        }
+
+    def test_audit_loop(self):
+        backend = Backend(LocalDriver())
+        client = backend.new_client([K8sValidationTarget()])
+        client.add_template(self.template_doc())
+        c = {
+            "apiVersion": "constraints.gatekeeper.sh/v1alpha1",
+            "kind": "K8sRequiredLabels",
+            "metadata": {"name": "ns-must-have-gk"},
+            "spec": {"match": {"kinds": [{"apiGroups": [""], "kinds": ["Namespace"]}]},
+                     "parameters": {"labels": ["gatekeeper"]}},
+        }
+        client.add_constraint(c)
+        client.add_data(make_obj("Namespace", "v1", "good", labels={"gatekeeper": "y"}))
+        client.add_data(make_obj("Namespace", "v1", "bad1"))
+        client.add_data(make_obj("Namespace", "v1", "bad2", labels={"other": "z"}))
+        client.add_data(make_obj("Pod", "v1", "p", namespace="good"))  # kind-filtered
+
+        results = client.audit().results()
+        assert len(results) == 2
+        names = sorted(r.resource["metadata"]["name"] for r in results)
+        assert names == ["bad1", "bad2"]
+        for r in results:
+            assert r.msg == 'you must provide labels: {"gatekeeper"}'
+            assert r.constraint == c
+            assert r.resource["kind"] == "Namespace"
+
+    def test_admission_review(self):
+        backend = Backend(LocalDriver())
+        client = backend.new_client([K8sValidationTarget()])
+        client.add_template(self.template_doc())
+        client.add_constraint({
+            "apiVersion": "constraints.gatekeeper.sh/v1alpha1",
+            "kind": "K8sRequiredLabels",
+            "metadata": {"name": "ns-must-have-gk"},
+            "spec": {"match": {"kinds": [{"apiGroups": [""], "kinds": ["Namespace"]}]},
+                     "parameters": {"labels": ["gatekeeper"]}},
+        })
+        req = {
+            "uid": "123",
+            "kind": {"group": "", "version": "v1", "kind": "Namespace"},
+            "name": "newns",
+            "operation": "CREATE",
+            "object": make_obj("Namespace", "v1", "newns"),
+        }
+        results = client.review(req).results()
+        assert len(results) == 1
+        assert "you must provide labels" in results[0].msg
+        # allowed object
+        req["object"] = make_obj("Namespace", "v1", "newns", labels={"gatekeeper": "1"})
+        assert client.review(req).results() == []
+
+
+class TestEmptyKindsList:
+    def test_explicit_empty_kinds_matches_nothing(self):
+        # reference: kind_selectors[_] over [] never fires -> constraint inert
+        h = K8sValidationTarget()
+        c = constraint(match={"kinds": []})
+        rev = {"kind": {"group": "", "version": "v1", "kind": "Pod"},
+               "name": "p", "object": make_obj()}
+        assert list(h.matching_constraints(rev, [c], ResourceTable())) == []
+
+    def test_null_kinds_matches_nothing(self):
+        h = K8sValidationTarget()
+        c = constraint(match={"kinds": None})
+        rev = {"kind": {"group": "", "version": "v1", "kind": "Pod"},
+               "name": "p", "object": make_obj()}
+        assert list(h.matching_constraints(rev, [c], ResourceTable())) == []

@@ -138,3 +138,32 @@ class TestRegoRepr:
 
     def test_empty_set(self):
         assert rego_repr(frozenset()) == "set()"
+
+
+class TestReviewRegressions2:
+    def test_multiline_call_closing_paren_own_line(self):
+        m = parse_module("""
+package t
+violation[{"msg": "ok"}] {
+  is_string(
+    input.a
+  )
+}
+""")
+        assert len(Interpreter(m).query_set("violation", {"a": "s"}, {})) == 1
+
+    def test_multiline_function_head(self):
+        m = parse_module("""
+package t
+f(
+  a
+) = r {
+  r := a
+}
+violation[{"msg": "ok"}] { f(1) == 1 }
+""")
+        assert len(Interpreter(m).query_set("violation", {}, {})) == 1
+
+    def test_json_marshal_sorted_keys(self):
+        from gatekeeper_tpu.rego.builtins import REGISTRY
+        assert REGISTRY[("json", "marshal")](freeze({"b": 1, "a": 2})) == '{"a":2,"b":1}'

@@ -1,0 +1,189 @@
+"""Store tests: interner, document store path semantics, resource table
+columns (path layout from target.go:271-298; wipe semantics from
+config_controller.go:178-188)."""
+
+import numpy as np
+import pytest
+
+from gatekeeper_tpu.errors import StorageError
+from gatekeeper_tpu.store.columns import ColSpec
+from gatekeeper_tpu.store.docstore import DocStore
+from gatekeeper_tpu.store.interner import Interner, MISSING
+from gatekeeper_tpu.store.table import ResourceMeta, ResourceTable
+
+
+class TestInterner:
+    def test_stable_ids(self):
+        it = Interner()
+        a = it.intern("hello")
+        assert it.intern("hello") == a
+        assert it.string(a) == "hello"
+
+    def test_empty_string_is_zero(self):
+        assert Interner().intern("") == 0
+
+    def test_lookup_no_insert(self):
+        it = Interner()
+        assert it.lookup("nope") == MISSING
+        assert len(it) == 1
+
+    def test_bytes_table(self):
+        it = Interner(max_str_len=8)
+        i = it.intern("abc")
+        mat, lens = it.bytes_table()
+        assert lens[i] == 3
+        assert bytes(mat[i][:3]) == b"abc"
+        assert mat.shape[1] == 8
+
+    def test_truncation_flag(self):
+        it = Interner(max_str_len=4)
+        short = it.intern("ab")
+        long = it.intern("abcdefgh")
+        assert it.is_exact_on_device(short)
+        assert not it.is_exact_on_device(long)
+
+
+class TestDocStore:
+    def test_put_get(self):
+        s = DocStore()
+        s.put("/external/t/cluster/v1/Namespace/foo", {"a": 1})
+        assert s.get("/external/t/cluster/v1/Namespace/foo") == {"a": 1}
+
+    def test_get_missing(self):
+        assert DocStore().get("/nope/x") is None
+
+    def test_delete_subtree_wipe(self):
+        s = DocStore()
+        s.put("/external/t/cluster/v1/NS/a", 1)
+        s.put("/external/t/cluster/v1/NS/b", 2)
+        s.put("/other/keep", 3)
+        assert s.delete_subtree("/external/t")
+        assert s.get("/external/t/cluster/v1/NS/a") is None
+        assert s.get("/other/keep") == 3
+
+    def test_path_conflict(self):
+        s = DocStore()
+        s.put("/a/b", "scalar")
+        with pytest.raises(StorageError, match="conflict"):
+            s.put("/a/b/c", 1)
+
+    def test_walk(self):
+        s = DocStore()
+        s.put("/d/x/1", "one")
+        s.put("/d/y/2", "two")
+        leaves = dict(s.walk("/d"))
+        assert leaves == {"/d/x/1": "one", "/d/y/2": "two"}
+
+
+def pod(name, ns, images, labels=None):
+    return {
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": name, "namespace": ns, "labels": labels or {}},
+        "spec": {"containers": [{"name": f"c{i}", "image": im}
+                                for i, im in enumerate(images)]},
+    }
+
+
+class TestResourceTable:
+    def meta(self, name, ns=None, kind="Pod"):
+        return ResourceMeta(api_version="v1", kind=kind, name=name, namespace=ns)
+
+    def test_upsert_remove(self):
+        t = ResourceTable()
+        t.upsert("k1", pod("a", "ns", ["img"]), self.meta("a", "ns"))
+        assert len(t) == 1
+        assert t.remove("k1")
+        assert len(t) == 0
+        assert not t.remove("k1")
+
+    def test_row_reuse_after_remove(self):
+        t = ResourceTable()
+        r1 = t.upsert("k1", {"x": 1}, self.meta("a"))
+        t.remove("k1")
+        r2 = t.upsert("k2", {"x": 2}, self.meta("b"))
+        assert r2 == r1  # freed row reused
+
+    def test_scalar_column(self):
+        t = ResourceTable()
+        t.upsert("k1", pod("a", "ns1", ["x"]), self.meta("a", "ns1"))
+        t.upsert("k2", {"apiVersion": "v1", "kind": "Pod", "metadata": {}},
+                 self.meta("b"))
+        col = t.column(ColSpec(("metadata", "name"), "str"))
+        assert t.interner.string(col.ids[0]) == "a"
+        assert col.ids[1] == MISSING
+
+    def test_csr_strs_column(self):
+        t = ResourceTable()
+        t.upsert("k1", pod("a", "ns", ["img1", "img2"]), self.meta("a", "ns"))
+        t.upsert("k2", pod("b", "ns", []), self.meta("b", "ns"))
+        col = t.column(ColSpec(("spec", "containers", "*", "image"), "strs"))
+        assert [t.interner.string(i) for i in col.row(0)] == ["img1", "img2"]
+        assert list(col.row(1)) == []
+
+    def test_items_column_sorted_keys(self):
+        t = ResourceTable()
+        t.upsert("k1", pod("a", "ns", [], labels={"b": "2", "a": "1"}),
+                 self.meta("a", "ns"))
+        col = t.column(ColSpec(("metadata", "labels"), "items"))
+        keys = [t.interner.string(i) for i in col.row(0)]
+        assert keys == ["a", "b"]
+
+    def test_column_cache_invalidation(self):
+        t = ResourceTable()
+        t.upsert("k1", pod("a", "ns", ["img"]), self.meta("a", "ns"))
+        col1 = t.column(ColSpec(("metadata", "name"), "str"))
+        col2 = t.column(ColSpec(("metadata", "name"), "str"))
+        assert col1 is col2  # cached
+        t.upsert("k2", pod("b", "ns", []), self.meta("b", "ns"))
+        col3 = t.column(ColSpec(("metadata", "name"), "str"))
+        assert col3 is not col1
+        assert col3.ids.shape[0] == 2
+
+    def test_identity_columns(self):
+        t = ResourceTable()
+        t.upsert("cluster/v1/Namespace/n1", {"apiVersion": "v1", "kind": "Namespace",
+                                             "metadata": {"name": "n1"}},
+                 self.meta("n1", kind="Namespace"))
+        t.upsert("k2", pod("p", "ns1", []), self.meta("p", "ns1"))
+        ident = t.identity()
+        assert ident.alive.all()
+        assert t.interner.string(ident.kind_ids[0]) == "Namespace"
+        assert ident.ns_ids[0] == MISSING
+        assert t.interner.string(ident.ns_ids[1]) == "ns1"
+
+    def test_compact(self):
+        t = ResourceTable()
+        for i in range(10):
+            t.upsert(f"k{i}", {"i": i}, self.meta(f"n{i}"))
+        for i in range(0, 10, 2):
+            t.remove(f"k{i}")
+        t.compact()
+        assert t.n_rows == 5
+        assert len(t) == 5
+        col = t.column(ColSpec(("i",), "num"))
+        assert sorted(col.values.tolist()) == [1, 3, 5, 7, 9]
+
+    def test_wipe(self):
+        t = ResourceTable()
+        t.upsert("k1", {"x": 1}, self.meta("a"))
+        t.wipe()
+        assert len(t) == 0 and t.n_rows == 0
+
+    def test_namespace_label_items(self):
+        t = ResourceTable()
+        t.upsert("cluster/v1/Namespace/prod",
+                 {"apiVersion": "v1", "kind": "Namespace",
+                  "metadata": {"name": "prod", "labels": {"env": "prod"}}},
+                 self.meta("prod", kind="Namespace"))
+        m = t.namespace_label_items()
+        prod_id = t.interner.intern("prod")
+        env_id = t.interner.intern("env")
+        assert m[prod_id] == [(env_id, t.interner.intern("prod"))]
+
+    def test_num_column(self):
+        t = ResourceTable()
+        t.upsert("k1", {"spec": {"replicas": 3}}, self.meta("a"))
+        t.upsert("k2", {"spec": {}}, self.meta("b"))
+        col = t.column(ColSpec(("spec", "replicas"), "num"))
+        assert col.values[0] == 3.0 and col.present[0]
+        assert not col.present[1]
