@@ -1,0 +1,246 @@
+"""JAX evaluation of vectorized programs.
+
+This is the replacement for the reference's hot loop — OPA's recursive
+tree-walking evaluator (opa/topdown/eval.go:156, ``eval/evalExpr/
+biunify``) which runs the whole audit cross-product single-threaded
+inside one query (regolib/src.go:38-52).  Here the same semantics run as
+one jitted tensor expression over the padded ``[n_constraints,
+n_resources(, n_elements)]`` lattice: gathers from host-built tables,
+integer/float compares, boolean algebra, and masked reductions.  XLA
+fuses the whole thing into a handful of kernels; no per-document Python
+or per-rule dispatch survives on the hot path.
+
+Tri-state evaluation: each node yields ``(defined, value)``; a rule
+fires where every conjunct is defined and truthy (only ``false`` and
+undefined fail — rego/interp.py mirrors this exactly).  The element
+axis, when present, is reduced existentially under its presence mask.
+
+Executables are cached by (program structure, shape bucket): growing
+inventories re-enter the same bucket sizes and never recompile — unlike
+the reference, which recompiles every module on any PutModule
+(drivers/local/local.go:65-93).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gatekeeper_tpu.ir.prep import Bindings
+from gatekeeper_tpu.ir.program import Node, Program, RuleSpec
+
+_3D = (1, 1, 1)
+
+
+def _fires(dv: tuple[jax.Array, jax.Array]) -> jax.Array:
+    """defined & truthy; only False and undefined fail in Rego."""
+    d, v = dv
+    if v.dtype == jnp.bool_:
+        return d & v
+    return d
+
+
+def _to3(a: jax.Array, axes: str) -> jax.Array:
+    """Reshape a bound array into the canonical [C, R, E] lattice."""
+    if axes == "c":
+        return a.reshape(a.shape[0], 1, 1)
+    if axes == "r":
+        return a.reshape(1, a.shape[0], 1)
+    if axes == "e":
+        return a.reshape(1, a.shape[0], a.shape[1])
+    raise ValueError(axes)
+
+
+class _Evaluator:
+    def __init__(self, program: Program, arrays: dict[str, jax.Array]):
+        self.p = program
+        self.arrays = arrays
+        self.cache: dict[int, tuple[jax.Array, jax.Array]] = {}
+
+    def node(self, i: int) -> tuple[jax.Array, jax.Array]:
+        hit = self.cache.get(i)
+        if hit is None:
+            hit = self._eval(self.p.nodes[i])
+            self.cache[i] = hit
+        return hit
+
+    def _eval(self, n: Node) -> tuple[jax.Array, jax.Array]:
+        op = n.op
+        if op == "const":
+            value, dtype = n.meta
+            v = jnp.asarray(value, dtype=dtype)
+            return jnp.ones(_3D, dtype=bool), v.reshape(_3D)
+        if op == "input":
+            name, kind = n.meta
+            axes = {"r": "r", "e": "e", "c": "c"}[kind[0]]
+            if kind.endswith("_num"):
+                v = _to3(self.arrays[name + ".v"], axes)
+                d = _to3(self.arrays[name + ".p"], axes)
+                return d, v
+            if kind.endswith("_id"):
+                v = _to3(self.arrays[name], axes)
+                return v >= 0, v
+            v = _to3(self.arrays[name], axes)  # bool
+            return jnp.ones_like(v), v
+        if op == "table":
+            (tname,) = n.meta
+            d_i, idx = self.node(n.args[0])
+            ci = jnp.clip(idx, 0, None)
+            ok = self.arrays[tname + ".ok"][ci]
+            val = self.arrays[tname + ".v"][ci]
+            return d_i & ok, val
+        if op in ("ptable_any", "ptable_all"):
+            tname, _ = n.meta
+            d_i, idx = self.node(n.args[0])
+            tbl = self.arrays[tname]                       # [P, T]
+            pidx = self.arrays[tname + ".idx"]             # [C, K]
+            pval = self.arrays[tname + ".valid"]           # [C, K]
+            by_val = tbl[:, jnp.clip(idx, 0, None)]        # [P, 1|C, R, E]
+            by_val = by_val.reshape(by_val.shape[0], *by_val.shape[-2:])  # [P,R,E]
+            per_k = by_val[pidx]                           # [C, K, R, E]
+            m = pval[:, :, None, None]
+            if op == "ptable_any":
+                v = jnp.any(per_k & m, axis=1)
+            else:
+                v = jnp.all(per_k | ~m, axis=1)
+            return d_i & jnp.ones_like(v), v
+        if op == "cmp":
+            (cop,) = n.meta
+            da, va = self.node(n.args[0])
+            db, vb = self.node(n.args[1])
+            d = da & db
+            if cop == "==":
+                v = va == vb
+            elif cop == "!=":
+                v = va != vb
+            elif cop == "<":
+                v = va < vb
+            elif cop == "<=":
+                v = va <= vb
+            elif cop == ">":
+                v = va > vb
+            else:
+                v = va >= vb
+            return d, v
+        if op == "and":
+            a = _fires(self.node(n.args[0]))
+            b = _fires(self.node(n.args[1]))
+            return jnp.ones_like(a & b), a & b
+        if op == "or":
+            a = _fires(self.node(n.args[0]))
+            b = _fires(self.node(n.args[1]))
+            return jnp.ones_like(a | b), a | b
+        if op == "not":
+            a = _fires(self.node(n.args[0]))
+            return jnp.ones_like(a), ~a
+        if op == "in_cset":
+            (cname,) = n.meta
+            d_i, idx = self.node(n.args[0])
+            # idx must be r/e-axis ([1, R, E]); the lowerer guarantees this
+            ids = self.arrays[cname + ".idx"]              # [C, K] global ids
+            valid = self.arrays[cname + ".valid"]
+            eq = ids[:, :, None, None] == idx              # [C, K, R, E]
+            v = jnp.any(eq & valid[:, :, None, None], axis=1)
+            return d_i & jnp.ones_like(v), v
+        if op == "cset_not_subset_memb":
+            cname, mname = n.meta
+            memb = self.arrays[mname]                      # [L, R]
+            lidx = self.arrays[cname + ".idx"]             # [C, K] local ids
+            valid = self.arrays[cname + ".valid"]
+            present = memb[lidx]                           # [C, K, R]
+            missing = jnp.any(~present & valid[:, :, None], axis=1)  # [C, R]
+            v = missing[:, :, None]
+            return jnp.ones_like(v), v
+        if op == "cset_subset_memb":
+            cname, mname = n.meta
+            memb = self.arrays[mname]
+            lidx = self.arrays[cname + ".idx"]
+            valid = self.arrays[cname + ".valid"]
+            present = memb[lidx]
+            allp = jnp.all(present | ~valid[:, :, None], axis=1)
+            v = allp[:, :, None]
+            return jnp.ones_like(v), v
+        if op in ("any_e", "all_e", "count_e"):
+            (axis,) = n.meta
+            pres = self.arrays[f"__elem__:{axis}"][None]   # [1, R, E]
+            a = _fires(self.node(n.args[0]))
+            if op == "any_e":
+                v = jnp.any(a & pres, axis=2, keepdims=True)
+                return jnp.ones_like(v), v
+            if op == "all_e":
+                v = jnp.all(a | ~pres, axis=2, keepdims=True)
+                return jnp.ones_like(v), v
+            v = jnp.sum((a & pres).astype(jnp.float32), axis=2, keepdims=True)
+            return jnp.ones(v.shape, dtype=bool), v
+        if op == "arith":
+            (aop,) = n.meta
+            da, va = self.node(n.args[0])
+            db, vb = self.node(n.args[1])
+            d = da & db
+            if aop == "+":
+                v = va + vb
+            elif aop == "-":
+                v = va - vb
+            elif aop == "*":
+                v = va * vb
+            else:
+                d = d & (vb != 0)
+                v = va / jnp.where(vb == 0, 1.0, vb)
+            return d, v
+        raise ValueError(f"unknown IR op {op!r}")
+
+
+def _eval_program(program: Program, arrays: dict[str, jax.Array]) -> jax.Array:
+    """-> violation mask [C, R] bool (padded)."""
+    ev = _Evaluator(program, arrays)
+    alive = arrays["__alive__"][None, :, None]
+    cvalid = arrays["__cvalid__"][:, None, None]
+    viol = None
+    for rule in program.rules:
+        total = None
+        for ci in rule.conjuncts:
+            f = _fires(ev.node(ci))
+            total = f if total is None else total & f
+        if total is None:
+            total = jnp.ones(_3D, dtype=bool)
+        total = total & alive & cvalid
+        if rule.elem_axis is not None:
+            pres = arrays[f"__elem__:{rule.elem_axis}"][None]
+            fired = jnp.any(total & pres, axis=2)
+        else:
+            # broadcast may still carry E=1; reduce it
+            fired = jnp.any(total, axis=2)
+        viol = fired if viol is None else viol | fired
+    c_pad = arrays["__cvalid__"].shape[0]
+    r_pad = arrays["__alive__"].shape[0]
+    if viol is None:
+        return jnp.zeros((c_pad, r_pad), dtype=bool)
+    return jnp.broadcast_to(viol, (c_pad, r_pad))
+
+
+class ProgramExecutor:
+    """Jit-cache wrapper: one compiled executable per (program, bucket)."""
+
+    def __init__(self):
+        self._cache: dict[tuple, Any] = {}
+
+    def run(self, program: Program, bindings: Bindings) -> np.ndarray:
+        """Evaluate; returns the violation mask trimmed to live shape
+        [n_constraints, n_resources]."""
+        names = tuple(sorted(bindings.arrays))
+        key = (program.cache_key(),
+               tuple((nm,) + tuple(bindings.arrays[nm].shape)
+                     + (str(bindings.arrays[nm].dtype),) for nm in names))
+        fn = self._cache.get(key)
+        if fn is None:
+            def raw(args: tuple):
+                return _eval_program(program, dict(zip(names, args)))
+            fn = jax.jit(raw)
+            self._cache[key] = fn
+        args = tuple(bindings.arrays[nm] for nm in names)
+        mask = np.asarray(fn(args))
+        return mask[: bindings.n_constraints, : bindings.n_resources]

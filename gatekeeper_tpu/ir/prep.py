@@ -1,0 +1,484 @@
+"""Host-side binding preparation for vectorized programs.
+
+Everything the device program consumes is built here, on host, from the
+``ResourceTable`` and the current constraint set of one template kind:
+
+- **columns**: per-resource (and per-element) field values as int32
+  interner ids / float32 numbers / bools.  This replaces the reference's
+  per-document tree walks over the inmem store (opa/storage/inmem) with
+  one columnar pass that is amortized across every constraint.
+- **tables**: host-evaluated lookup tables over *unique* values.  Any
+  pure subexpression of one string/scalar leaf (``canonify_cpu(x)``,
+  ``re_match(p, x)``...) is evaluated once per distinct value with the
+  scalar oracle/builtins, then becomes a device gather.  Strings never
+  reach the device; the regex/parse work rides the interner.
+- **ptables**: [n_params, n_values] tables for predicates of
+  (leaf value, constraint parameter), e.g. ``startswith(image, repo)``
+  with per-constraint param index sets.
+- **cvals / csets**: per-constraint host evaluation (n_constraints is
+  small; the scalar oracle evaluates constraint-only subexpressions
+  exactly, including through user-defined template functions).
+- **membership matrices**: [n_needed, n_resources] bool for set ops
+  against ragged per-resource key sets (``metadata.labels``).
+
+Bindings are padded to power-of-two shape buckets so the jitted
+executable cache (engine/veval.py) stays warm across inventory growth —
+the reference instead recompiles every module on any change
+(drivers/local/local.go:65-93).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+import numpy as np
+
+from gatekeeper_tpu.store.columns import ColSpec, get_path, iter_path
+from gatekeeper_tpu.store.interner import Interner, MISSING
+from gatekeeper_tpu.store.table import ResourceTable
+
+
+def bucket(n: int, minimum: int = 8) -> int:
+    """Next power-of-two shape bucket (stable jit shapes, SURVEY §7.5)."""
+    b = minimum
+    while b < n:
+        b <<= 1
+    return b
+
+
+# ---------------------------------------------------------------------------
+# prep spec: declarative requests emitted by the lowerer
+
+
+@dataclasses.dataclass(frozen=True)
+class RColReq:
+    """Per-resource scalar column: mode 'str' | 'num' | 'present' | 'bool'."""
+
+    name: str
+    path: tuple[str, ...]
+    mode: str
+
+
+@dataclasses.dataclass(frozen=True)
+class EColReq:
+    """Per-element column along one list axis (``base[*].rel``).
+
+    Unlike store.columns CSR modes, elements are *aligned to the base
+    list*: element i of every rel-column of the same axis refers to the
+    same list entry (absent rel -> MISSING), so multi-field element
+    predicates (image + name + resources) line up.
+    """
+
+    name: str
+    axis: str                 # axis key, ".".join(base_path)
+    base: tuple[str, ...]
+    rel: tuple[str, ...]
+    mode: str                 # 'str' | 'num' | 'present'
+
+
+@dataclasses.dataclass(frozen=True)
+class TableReq:
+    """Unary host table over the distinct values of a source column.
+
+    src names an RColReq/EColReq with mode 'str' (ids).  fn maps the
+    python string -> output; exceptions / UNDEFINED -> undefined.
+    out: 'bool' | 'num' | 'id'.
+    """
+
+    name: str
+    src: str
+    fn: Callable[[str], Any] = dataclasses.field(compare=False, hash=False)
+    out: str = "bool"
+
+
+@dataclasses.dataclass(frozen=True)
+class PTableReq:
+    """Parametric table: fn(value_string, param_string) -> bool, evaluated
+    for every distinct param across the constraint set."""
+
+    name: str
+    src: str
+    cparams: Callable[[dict], list] = dataclasses.field(compare=False, hash=False)
+    fn: Callable[[str, str], Any] = dataclasses.field(compare=False, hash=False)
+
+
+@dataclasses.dataclass(frozen=True)
+class CSetReq:
+    """Per-constraint id set (padded): fn(constraint) -> list of strings,
+    interned to global ids.  Used by in_cset / ptable index sets."""
+
+    name: str
+    fn: Callable[[dict], list] = dataclasses.field(compare=False, hash=False)
+
+
+@dataclasses.dataclass(frozen=True)
+class CValReq:
+    """Per-constraint scalar: fn(constraint) -> value or None (undefined).
+    kind: 'num' | 'str' | 'bool'."""
+
+    name: str
+    kind: str
+    fn: Callable[[dict], Any] = dataclasses.field(compare=False, hash=False)
+
+
+@dataclasses.dataclass(frozen=True)
+class MembReq:
+    """Membership matrix vs a ragged per-resource key set.
+
+    keys_path points at a dict (its keys are the set, e.g.
+    metadata.labels); needed ids come from the paired cset; output is
+    memb[L, R] plus the cset re-indexed into [0, L)."""
+
+    name: str
+    cset: str
+    keys_path: tuple[str, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class PrepSpec:
+    r_cols: tuple[RColReq, ...] = ()
+    e_cols: tuple[EColReq, ...] = ()
+    axes: tuple[tuple[str, tuple[str, ...]], ...] = ()   # (axis key, base path)
+    tables: tuple[TableReq, ...] = ()
+    ptables: tuple[PTableReq, ...] = ()
+    csets: tuple[CSetReq, ...] = ()
+    cvals: tuple[CValReq, ...] = ()
+    membs: tuple[MembReq, ...] = ()
+    # constraint-only conjuncts, folded into one validity vector
+    cvalid_fns: tuple[Callable[[dict], bool], ...] = ()
+
+
+# ---------------------------------------------------------------------------
+# element-aligned column extraction
+
+
+def _elem_rows(obj: Any, base: tuple[str, ...]):
+    v = get_path(obj, base)
+    return v if isinstance(v, list) else []
+
+
+def build_elem_arrays(objs: list, base: tuple[str, ...], rels: list[tuple[tuple[str, ...], str]],
+                      interner: Interner):
+    """One pass over the base list producing aligned CSR columns for every
+    (rel, mode) request plus per-row element counts."""
+    n = len(objs)
+    counts = np.zeros((n,), dtype=np.int32)
+    outs: dict[tuple[tuple[str, ...], str], list] = {rm: [] for rm in rels}
+    for i, o in enumerate(objs):
+        elems = _elem_rows(o, base) if o is not None else []
+        counts[i] = len(elems)
+        for e in elems:
+            for (rel, mode) in rels:
+                col = outs[(rel, mode)]
+                v = get_path(e, rel) if rel else e
+                if mode == "str":
+                    col.append(interner.intern(v) if isinstance(v, str) else MISSING)
+                elif mode == "num":
+                    ok = isinstance(v, (int, float)) and not isinstance(v, bool)
+                    col.append(float(v) if ok else np.nan)
+                elif mode == "present":
+                    present = v is not None if rel and rel[-1] != "" else v is not None
+                    # presence distinguishes "key absent" from any value
+                    if rel:
+                        cur: Any = e
+                        ok = True
+                        for p in rel:
+                            if not isinstance(cur, dict) or p not in cur:
+                                ok = False
+                                break
+                            cur = cur[p]
+                        col.append(ok)
+                    else:
+                        col.append(True)
+                else:
+                    raise ValueError(f"bad elem mode {mode}")
+    return counts, outs
+
+
+# ---------------------------------------------------------------------------
+# bindings
+
+
+@dataclasses.dataclass
+class Bindings:
+    """name -> np.ndarray, plus shape info.  Split into device-bound
+    arrays (``arrays``) and host-only metadata."""
+
+    arrays: dict[str, np.ndarray]
+    n_constraints: int
+    n_resources: int
+    c_pad: int
+    r_pad: int
+    e_pads: dict[str, int]
+
+    def shapes_key(self) -> tuple:
+        return tuple(sorted((k, v.shape, str(v.dtype)) for k, v in self.arrays.items()))
+
+
+def _eval_host(fn, *args):
+    """Host table/cval evaluation: exceptions and UNDEFINED -> None."""
+    from gatekeeper_tpu.rego.builtins import UNDEFINED, BuiltinError
+    try:
+        v = fn(*args)
+    except BuiltinError:
+        return None
+    except (TypeError, ValueError, KeyError, IndexError, ZeroDivisionError):
+        return None
+    if v is UNDEFINED:
+        return None
+    return v
+
+
+def build_bindings(spec: PrepSpec, table: ResourceTable,
+                   constraints: list[dict]) -> Bindings:
+    """Materialize every requested array, padded to shape buckets."""
+    interner = table.interner
+    objs = table._objs
+    n = len(objs)
+    n_con = len(constraints)
+    r_pad = bucket(max(n, 1))
+    c_pad = bucket(max(n_con, 1), minimum=4)
+    out: dict[str, np.ndarray] = {}
+
+    alive = np.zeros((r_pad,), dtype=bool)
+    for i, m in enumerate(table._metas):
+        if m is not None:
+            alive[i] = True
+    out["__alive__"] = alive
+
+    # ---- per-resource scalar columns
+    for rc in spec.r_cols:
+        if rc.mode == "str":
+            col = table.column(ColSpec(rc.path, "str"))
+            ids = np.full((r_pad,), MISSING, dtype=np.int32)
+            ids[:n] = col.ids
+            out[rc.name] = ids
+        elif rc.mode == "num":
+            col = table.column(ColSpec(rc.path, "num"))
+            v = np.zeros((r_pad,), dtype=np.float32)
+            p = np.zeros((r_pad,), dtype=bool)
+            v[:n] = col.values.astype(np.float32)
+            p[:n] = col.present
+            out[rc.name + ".v"] = v
+            out[rc.name + ".p"] = p
+        elif rc.mode in ("present", "bool"):
+            col = table.column(ColSpec(rc.path, "present"))
+            b = np.zeros((r_pad,), dtype=bool)
+            b[:n] = col.present
+            out[rc.name] = b
+        else:
+            raise ValueError(f"bad r_col mode {rc.mode}")
+
+    # ---- element axes (one extraction pass per axis)
+    axis_cols: dict[str, list[EColReq]] = {}
+    for ec in spec.e_cols:
+        axis_cols.setdefault(ec.axis, []).append(ec)
+    axis_base = dict(spec.axes)
+    e_pads: dict[str, int] = {}
+    for axis, base in spec.axes:
+        ecs = axis_cols.get(axis, [])
+        rels = sorted({(ec.rel, ec.mode) for ec in ecs})
+        counts, cols = build_elem_arrays(objs, base, rels, interner)
+        e_max = int(counts.max()) if n else 0
+        e_pad = bucket(max(e_max, 1), minimum=2)
+        e_pads[axis] = e_pad
+        offs = np.zeros((n + 1,), dtype=np.int64)
+        np.cumsum(counts, out=offs[1:])
+        pres = np.zeros((r_pad, e_pad), dtype=bool)
+        idx_r, idx_e = _csr_to_dense_idx(counts, offs)
+        pres[idx_r, idx_e] = True
+        out[f"__elem__:{axis}"] = pres
+        for ec in ecs:
+            flat = cols[(ec.rel, ec.mode)]
+            if ec.mode == "str":
+                arr = np.full((r_pad, e_pad), MISSING, dtype=np.int32)
+                arr[idx_r, idx_e] = np.asarray(flat, dtype=np.int32)
+                out[ec.name] = arr
+            elif ec.mode == "num":
+                fv = np.asarray(flat, dtype=np.float64)
+                v = np.zeros((r_pad, e_pad), dtype=np.float32)
+                p = np.zeros((r_pad, e_pad), dtype=bool)
+                v[idx_r, idx_e] = np.nan_to_num(fv).astype(np.float32)
+                p[idx_r, idx_e] = ~np.isnan(fv)
+                out[ec.name + ".v"] = v
+                out[ec.name + ".p"] = p
+            else:  # present
+                b = np.zeros((r_pad, e_pad), dtype=bool)
+                b[idx_r, idx_e] = np.asarray(flat, dtype=bool)
+                out[ec.name] = b
+
+    # ---- unary tables over distinct column values
+    for tr in spec.tables:
+        src_ids = _src_ids(out, tr.src)
+        uniq = np.unique(src_ids)
+        uniq = uniq[uniq >= 0]
+        t_pad = bucket(len(interner), minimum=8)
+        ok = np.zeros((t_pad,), dtype=bool)
+        if tr.out == "num":
+            vals = np.zeros((t_pad,), dtype=np.float32)
+        elif tr.out == "id":
+            vals = np.full((t_pad,), MISSING, dtype=np.int32)
+        else:
+            vals = np.zeros((t_pad,), dtype=bool)
+        for uid in uniq.tolist():
+            v = _eval_host(tr.fn, interner.string(uid))
+            if v is None:
+                continue
+            if tr.out == "num":
+                if isinstance(v, (int, float)) and not isinstance(v, bool):
+                    ok[uid] = True
+                    vals[uid] = np.float32(v)
+            elif tr.out == "id":
+                if isinstance(v, str):
+                    ok[uid] = True
+                    vals[uid] = interner.intern(v)
+            else:
+                ok[uid] = True
+                vals[uid] = bool(v) if isinstance(v, bool) else True
+        out[tr.name + ".ok"] = ok
+        out[tr.name + ".v"] = vals
+
+    # ---- parametric tables + per-constraint param index sets
+    for pt in spec.ptables:
+        per_con: list[list] = []
+        distinct: dict[str, int] = {}
+        for c in constraints:
+            params = _eval_host(pt.cparams, c)
+            lst = []
+            if isinstance(params, (list, tuple)):
+                for p in params:
+                    if isinstance(p, str):
+                        if p not in distinct:
+                            distinct[p] = len(distinct)
+                        lst.append(distinct[p])
+            per_con.append(lst)
+        src_ids = _src_ids(out, pt.src)
+        uniq = np.unique(src_ids)
+        uniq = uniq[uniq >= 0]
+        p_pad = bucket(max(len(distinct), 1), minimum=2)
+        t_pad = bucket(len(interner), minimum=8)
+        tbl = np.zeros((p_pad, t_pad), dtype=bool)
+        plist = list(distinct)
+        for pi, pstr in enumerate(plist):
+            for uid in uniq.tolist():
+                v = _eval_host(pt.fn, interner.string(uid), pstr)
+                tbl[pi, uid] = bool(v) if v is not None else False
+        out[pt.name] = tbl
+        k_pad = bucket(max((len(x) for x in per_con), default=1), minimum=2)
+        idx = np.full((c_pad, k_pad), 0, dtype=np.int32)
+        valid = np.zeros((c_pad, k_pad), dtype=bool)
+        for ci, lst in enumerate(per_con):
+            for k, pi in enumerate(lst):
+                idx[ci, k] = pi
+                valid[ci, k] = True
+        out[pt.name + ".idx"] = idx
+        out[pt.name + ".valid"] = valid
+
+    # ---- per-constraint id sets
+    memb_by_cset = {m.cset: m for m in spec.membs}
+    for cs in spec.csets:
+        per_con = []
+        for c in constraints:
+            vals = _eval_host(cs.fn, c)
+            lst = []
+            if isinstance(vals, (list, tuple, frozenset, set)):
+                for v in sorted(vals, key=str) if isinstance(vals, (frozenset, set)) else vals:
+                    if isinstance(v, str):
+                        lst.append(interner.intern(v))
+            per_con.append(lst)
+        m = memb_by_cset.get(cs.name)
+        if m is not None:
+            # re-index into a local [0, L) axis + membership matrix
+            needed = sorted({i for lst in per_con for i in lst})
+            local = {gid: li for li, gid in enumerate(needed)}
+            l_pad = bucket(max(len(needed), 1), minimum=2)
+            memb = np.zeros((l_pad, r_pad), dtype=bool)
+            _fill_membership(memb, objs, m.keys_path, needed, local, interner)
+            out[m.name] = memb
+            per_con = [[local[g] for g in lst] for lst in per_con]
+        k_pad = bucket(max((len(x) for x in per_con), default=1), minimum=2)
+        idx = np.full((c_pad, k_pad), 0, dtype=np.int32)
+        valid = np.zeros((c_pad, k_pad), dtype=bool)
+        for ci, lst in enumerate(per_con):
+            for k, gi in enumerate(lst):
+                idx[ci, k] = gi
+                valid[ci, k] = True
+        out[cs.name + ".idx"] = idx
+        out[cs.name + ".valid"] = valid
+
+    # ---- per-constraint scalars
+    for cv in spec.cvals:
+        if cv.kind == "num":
+            v = np.zeros((c_pad,), dtype=np.float32)
+            p = np.zeros((c_pad,), dtype=bool)
+            for ci, c in enumerate(constraints):
+                x = _eval_host(cv.fn, c)
+                if isinstance(x, (int, float)) and not isinstance(x, bool):
+                    v[ci] = np.float32(x)
+                    p[ci] = True
+            out[cv.name + ".v"] = v
+            out[cv.name + ".p"] = p
+        elif cv.kind == "str":
+            ids = np.full((c_pad,), MISSING, dtype=np.int32)
+            for ci, c in enumerate(constraints):
+                x = _eval_host(cv.fn, c)
+                if isinstance(x, str):
+                    ids[ci] = interner.intern(x)
+            out[cv.name] = ids
+        else:  # bool
+            b = np.zeros((c_pad,), dtype=bool)
+            for ci, c in enumerate(constraints):
+                x = _eval_host(cv.fn, c)
+                b[ci] = bool(x) if x is not None else False
+            out[cv.name] = b
+
+    # ---- constraint validity (constraint-only conjuncts)
+    cvalid = np.zeros((c_pad,), dtype=bool)
+    for ci, c in enumerate(constraints):
+        ok = True
+        for fn in spec.cvalid_fns:
+            v = _eval_host(fn, c)
+            if v is None or v is False:
+                ok = False
+                break
+        cvalid[ci] = ok
+    out["__cvalid__"] = cvalid
+
+    return Bindings(arrays=out, n_constraints=n_con, n_resources=n,
+                    c_pad=c_pad, r_pad=r_pad, e_pads=e_pads)
+
+
+def _src_ids(out: dict[str, np.ndarray], src: str) -> np.ndarray:
+    arr = out.get(src)
+    if arr is None:
+        raise KeyError(f"table src column {src!r} not built")
+    return arr.ravel()
+
+
+def _csr_to_dense_idx(counts: np.ndarray, offs: np.ndarray):
+    """(row, slot) indices for scattering CSR entries into dense [R, E]."""
+    total = int(offs[-1])
+    idx_r = np.repeat(np.arange(len(counts), dtype=np.int64), counts)
+    idx_e = np.arange(total, dtype=np.int64) - np.repeat(offs[:-1], counts)
+    return idx_r, idx_e
+
+
+def _fill_membership(memb: np.ndarray, objs: list, keys_path: tuple[str, ...],
+                     needed: list[int], local: dict[int, int],
+                     interner: Interner) -> None:
+    """memb[local_id, row] = key present in the dict at keys_path."""
+    if not needed:
+        return
+    needed_set = set(needed)
+    for row, o in enumerate(objs):
+        if o is None:
+            continue
+        d = get_path(o, keys_path)
+        if not isinstance(d, dict):
+            continue
+        for k in d.keys():
+            if isinstance(k, str):
+                gid = interner.lookup(k)
+                if gid in needed_set:
+                    memb[local[gid], row] = True
