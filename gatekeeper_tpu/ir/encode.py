@@ -1,0 +1,53 @@
+"""Scalar-value encoding for variant ("val") columns.
+
+Rego scalars are dynamically typed: a field may hold a string, number,
+bool, or null, and equality is type-aware (interp._compare/_same_kind —
+``1 != true``, ``5 != "5"``).  Device columns are int32 ids, so variant
+scalars are encoded into a reserved namespace of the global string
+interner: two values get the same id iff they are Rego-equal.  Raw
+string columns (label keys, kinds) intern strings directly; the "\x00"
+prefix guarantees the namespaces never collide (k8s strings are UTF-8
+and never contain NUL).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from gatekeeper_tpu.rego.values import canon_num
+
+_P = "\x00"
+
+
+def encode_value(v: Any) -> str | None:
+    """Scalar -> interner key; None for non-scalars (not encodable)."""
+    if isinstance(v, bool):
+        return _P + ("b:1" if v else "b:0")
+    if isinstance(v, str):
+        return _P + "s:" + v
+    if isinstance(v, (int, float)):
+        return _P + "n:" + repr(canon_num(v))
+    if v is None:
+        return _P + "z"
+    return None
+
+
+def decode_value(key: str) -> Any:
+    """Inverse of encode_value (table builders call the user fn on the
+    decoded python value)."""
+    if not key.startswith(_P):
+        raise ValueError(f"not an encoded value: {key!r}")
+    body = key[1:]
+    if body.startswith("s:"):
+        return body[2:]
+    if body.startswith("n:"):
+        text = body[2:]
+        return int(text) if "." not in text and "e" not in text and "E" not in text \
+            else float(text)
+    if body == "b:1":
+        return True
+    if body == "b:0":
+        return False
+    if body == "z":
+        return None
+    raise ValueError(f"bad encoded value: {key!r}")

@@ -34,6 +34,7 @@ from typing import Any, Callable
 
 import numpy as np
 
+from gatekeeper_tpu.ir.encode import decode_value, encode_value
 from gatekeeper_tpu.store.columns import ColSpec, get_path, iter_path
 from gatekeeper_tpu.store.interner import Interner, MISSING
 from gatekeeper_tpu.store.table import ResourceTable
@@ -53,7 +54,15 @@ def bucket(n: int, minimum: int = 8) -> int:
 
 @dataclasses.dataclass(frozen=True)
 class RColReq:
-    """Per-resource scalar column: mode 'str' | 'num' | 'present' | 'bool'."""
+    """Per-resource scalar column.
+
+    mode: 'str' | 'num' | 'val' | 'present' | 'truthy' | 'len'.
+    A path starting with "$meta" reads review metadata instead of the
+    object (the audit review shape built by make_review,
+    reference target.go:69-107): ("$meta","kind","group"|"version"|
+    "kind"), ("$meta","name"), ("$meta","namespace"),
+    ("$meta","operation") — always str ids, from the identity columns.
+    """
 
     name: str
     path: tuple[str, ...]
@@ -74,22 +83,24 @@ class EColReq:
     axis: str                 # axis key, ".".join(base_path)
     base: tuple[str, ...]
     rel: tuple[str, ...]
-    mode: str                 # 'str' | 'num' | 'present'
+    mode: str                 # 'str' | 'num' | 'val' | 'present' | 'truthy' | 'len'
 
 
 @dataclasses.dataclass(frozen=True)
 class TableReq:
     """Unary host table over the distinct values of a source column.
 
-    src names an RColReq/EColReq with mode 'str' (ids).  fn maps the
-    python string -> output; exceptions / UNDEFINED -> undefined.
-    out: 'bool' | 'num' | 'id'.
+    src names an RColReq/EColReq with mode 'str' or 'val' (ids; src_val
+    marks the encoded-value namespace, decoded before fn).  fn maps the
+    python value -> output; exceptions / UNDEFINED -> undefined.
+    out: 'bool' | 'num' | 'id_str' | 'id_val'.
     """
 
     name: str
     src: str
-    fn: Callable[[str], Any] = dataclasses.field(compare=False, hash=False)
+    fn: Callable[[Any], Any] = dataclasses.field(compare=False, hash=False)
     out: str = "bool"
+    src_val: bool = False
 
 
 @dataclasses.dataclass(frozen=True)
@@ -100,22 +111,30 @@ class PTableReq:
     name: str
     src: str
     cparams: Callable[[dict], list] = dataclasses.field(compare=False, hash=False)
-    fn: Callable[[str, str], Any] = dataclasses.field(compare=False, hash=False)
+    fn: Callable[[Any, Any], Any] = dataclasses.field(compare=False, hash=False)
+    src_val: bool = False
 
 
 @dataclasses.dataclass(frozen=True)
 class CSetReq:
-    """Per-constraint id set (padded): fn(constraint) -> list of strings,
-    interned to global ids.  Used by in_cset / ptable index sets."""
+    """Per-constraint id set (padded): fn(constraint) -> list of scalars.
+
+    encode 'str': strings intern raw (matching raw-string columns like
+    label keys); non-string scalars intern their encoded form — a
+    distinct id that matches no raw string, preserving exact Rego
+    set semantics for heterogeneous parameter lists.
+    encode 'val': every scalar interns encoded (matching val columns).
+    """
 
     name: str
     fn: Callable[[dict], list] = dataclasses.field(compare=False, hash=False)
+    encode: str = "str"
 
 
 @dataclasses.dataclass(frozen=True)
 class CValReq:
     """Per-constraint scalar: fn(constraint) -> value or None (undefined).
-    kind: 'num' | 'str' | 'bool'."""
+    kind: 'num' | 'str' | 'bool' | 'val'."""
 
     name: str
     kind: str
@@ -172,28 +191,36 @@ def build_elem_arrays(objs: list, base: tuple[str, ...], rels: list[tuple[tuple[
             for (rel, mode) in rels:
                 col = outs[(rel, mode)]
                 v = get_path(e, rel) if rel else e
+                has = _rel_has(e, rel)
                 if mode == "str":
                     col.append(interner.intern(v) if isinstance(v, str) else MISSING)
+                elif mode == "val":
+                    key = encode_value(v) if has else None
+                    col.append(interner.intern(key) if key is not None else MISSING)
                 elif mode == "num":
                     ok = isinstance(v, (int, float)) and not isinstance(v, bool)
                     col.append(float(v) if ok else np.nan)
+                elif mode == "len":
+                    ok = isinstance(v, (list, dict, str))
+                    col.append(float(len(v)) if ok else np.nan)
                 elif mode == "present":
-                    present = v is not None if rel and rel[-1] != "" else v is not None
-                    # presence distinguishes "key absent" from any value
-                    if rel:
-                        cur: Any = e
-                        ok = True
-                        for p in rel:
-                            if not isinstance(cur, dict) or p not in cur:
-                                ok = False
-                                break
-                            cur = cur[p]
-                        col.append(ok)
-                    else:
-                        col.append(True)
+                    col.append(has)
+                elif mode == "truthy":
+                    col.append(has and v is not False)
                 else:
                     raise ValueError(f"bad elem mode {mode}")
     return counts, outs
+
+
+def _rel_has(e: Any, rel: tuple[str, ...]) -> bool:
+    if not rel:
+        return True
+    cur = e
+    for p in rel:
+        if not isinstance(cur, dict) or p not in cur:
+            return False
+        cur = cur[p]
+    return True
 
 
 # ---------------------------------------------------------------------------
@@ -249,21 +276,25 @@ def build_bindings(spec: PrepSpec, table: ResourceTable,
 
     # ---- per-resource scalar columns
     for rc in spec.r_cols:
-        if rc.mode == "str":
-            col = table.column(ColSpec(rc.path, "str"))
+        if rc.path and rc.path[0] == "$meta":
+            ids = np.full((r_pad,), MISSING, dtype=np.int32)
+            ids[:n] = _meta_ids(table, rc.path[1:])
+            out[rc.name] = ids
+        elif rc.mode in ("str", "val"):
+            col = table.column(ColSpec(rc.path, rc.mode))
             ids = np.full((r_pad,), MISSING, dtype=np.int32)
             ids[:n] = col.ids
             out[rc.name] = ids
-        elif rc.mode == "num":
-            col = table.column(ColSpec(rc.path, "num"))
+        elif rc.mode in ("num", "len"):
+            col = table.column(ColSpec(rc.path, rc.mode))
             v = np.zeros((r_pad,), dtype=np.float32)
             p = np.zeros((r_pad,), dtype=bool)
             v[:n] = col.values.astype(np.float32)
             p[:n] = col.present
             out[rc.name + ".v"] = v
             out[rc.name + ".p"] = p
-        elif rc.mode in ("present", "bool"):
-            col = table.column(ColSpec(rc.path, "present"))
+        elif rc.mode in ("present", "truthy"):
+            col = table.column(ColSpec(rc.path, rc.mode))
             b = np.zeros((r_pad,), dtype=bool)
             b[:n] = col.present
             out[rc.name] = b
@@ -291,21 +322,24 @@ def build_bindings(spec: PrepSpec, table: ResourceTable,
         out[f"__elem__:{axis}"] = pres
         for ec in ecs:
             flat = cols[(ec.rel, ec.mode)]
-            if ec.mode == "str":
+            if ec.mode in ("str", "val"):
                 arr = np.full((r_pad, e_pad), MISSING, dtype=np.int32)
-                arr[idx_r, idx_e] = np.asarray(flat, dtype=np.int32)
+                if flat:
+                    arr[idx_r, idx_e] = np.asarray(flat, dtype=np.int32)
                 out[ec.name] = arr
-            elif ec.mode == "num":
-                fv = np.asarray(flat, dtype=np.float64)
+            elif ec.mode in ("num", "len"):
+                fv = np.asarray(flat, dtype=np.float64) if flat else np.zeros((0,))
                 v = np.zeros((r_pad, e_pad), dtype=np.float32)
                 p = np.zeros((r_pad, e_pad), dtype=bool)
-                v[idx_r, idx_e] = np.nan_to_num(fv).astype(np.float32)
-                p[idx_r, idx_e] = ~np.isnan(fv)
+                if flat:
+                    v[idx_r, idx_e] = np.nan_to_num(fv).astype(np.float32)
+                    p[idx_r, idx_e] = ~np.isnan(fv)
                 out[ec.name + ".v"] = v
                 out[ec.name + ".p"] = p
-            else:  # present
+            else:  # present / truthy
                 b = np.zeros((r_pad, e_pad), dtype=bool)
-                b[idx_r, idx_e] = np.asarray(flat, dtype=bool)
+                if flat:
+                    b[idx_r, idx_e] = np.asarray(flat, dtype=bool)
                 out[ec.name] = b
 
     # ---- unary tables over distinct column values
@@ -317,22 +351,29 @@ def build_bindings(spec: PrepSpec, table: ResourceTable,
         ok = np.zeros((t_pad,), dtype=bool)
         if tr.out == "num":
             vals = np.zeros((t_pad,), dtype=np.float32)
-        elif tr.out == "id":
+        elif tr.out in ("id_str", "id_val"):
             vals = np.full((t_pad,), MISSING, dtype=np.int32)
         else:
             vals = np.zeros((t_pad,), dtype=bool)
         for uid in uniq.tolist():
-            v = _eval_host(tr.fn, interner.string(uid))
+            key = interner.string(uid)
+            arg = decode_value(key) if tr.src_val else key
+            v = _eval_host(tr.fn, arg)
             if v is None:
                 continue
             if tr.out == "num":
                 if isinstance(v, (int, float)) and not isinstance(v, bool):
                     ok[uid] = True
                     vals[uid] = np.float32(v)
-            elif tr.out == "id":
+            elif tr.out == "id_str":
                 if isinstance(v, str):
                     ok[uid] = True
                     vals[uid] = interner.intern(v)
+            elif tr.out == "id_val":
+                ekey = encode_value(v)
+                if ekey is not None:
+                    ok[uid] = True
+                    vals[uid] = interner.intern(ekey)
             else:
                 ok[uid] = True
                 vals[uid] = bool(v) if isinstance(v, bool) else True
@@ -362,8 +403,10 @@ def build_bindings(spec: PrepSpec, table: ResourceTable,
         plist = list(distinct)
         for pi, pstr in enumerate(plist):
             for uid in uniq.tolist():
-                v = _eval_host(pt.fn, interner.string(uid), pstr)
-                tbl[pi, uid] = bool(v) if v is not None else False
+                key = interner.string(uid)
+                arg = decode_value(key) if pt.src_val else key
+                v = _eval_host(pt.fn, arg, pstr)
+                tbl[pi, uid] = bool(v) if v is not None and v is not False else False
         out[pt.name] = tbl
         k_pad = bucket(max((len(x) for x in per_con), default=1), minimum=2)
         idx = np.full((c_pad, k_pad), 0, dtype=np.int32)
@@ -383,9 +426,14 @@ def build_bindings(spec: PrepSpec, table: ResourceTable,
             vals = _eval_host(cs.fn, c)
             lst = []
             if isinstance(vals, (list, tuple, frozenset, set)):
-                for v in sorted(vals, key=str) if isinstance(vals, (frozenset, set)) else vals:
-                    if isinstance(v, str):
+                seq = sorted(vals, key=repr) if isinstance(vals, (frozenset, set)) else vals
+                for v in seq:
+                    if cs.encode == "str" and isinstance(v, str):
                         lst.append(interner.intern(v))
+                    else:
+                        key = encode_value(v)
+                        if key is not None:
+                            lst.append(interner.intern(key))
             per_con.append(lst)
         m = memb_by_cset.get(cs.name)
         if m is not None:
@@ -426,6 +474,14 @@ def build_bindings(spec: PrepSpec, table: ResourceTable,
                 if isinstance(x, str):
                     ids[ci] = interner.intern(x)
             out[cv.name] = ids
+        elif cv.kind == "val":
+            ids = np.full((c_pad,), MISSING, dtype=np.int32)
+            for ci, c in enumerate(constraints):
+                x = _eval_host(cv.fn, c)
+                key = encode_value(x) if x is not None else None
+                if key is not None:
+                    ids[ci] = interner.intern(key)
+            out[cv.name] = ids
         else:  # bool
             b = np.zeros((c_pad,), dtype=bool)
             for ci, c in enumerate(constraints):
@@ -447,6 +503,31 @@ def build_bindings(spec: PrepSpec, table: ResourceTable,
 
     return Bindings(arrays=out, n_constraints=n_con, n_resources=n,
                     c_pad=c_pad, r_pad=r_pad, e_pads=e_pads)
+
+
+_META_FIELDS = {
+    ("kind", "group"): "group_ids",
+    ("kind", "version"): "version_ids",
+    ("kind", "kind"): "kind_ids",
+    ("name",): "name_ids",
+    ("namespace",): "ns_ids",
+}
+
+
+def _meta_ids(table: ResourceTable, path: tuple[str, ...]) -> np.ndarray:
+    """Review-metadata str columns from the cached identity arrays
+    (make_review fields, reference target.go:69-107)."""
+    ident = table.identity()
+    if path == ("operation",):
+        # audit reviews are always CREATE (target.go make_review)
+        op = table.interner.intern("CREATE")
+        ids = np.full((len(ident.alive),), MISSING, dtype=np.int32)
+        ids[ident.alive] = op
+        return ids
+    attr = _META_FIELDS.get(path)
+    if attr is None:
+        raise KeyError(f"unsupported $meta path {path}")
+    return getattr(ident, attr)
 
 
 def _src_ids(out: dict[str, np.ndarray], src: str) -> np.ndarray:
@@ -477,8 +558,10 @@ def _fill_membership(memb: np.ndarray, objs: list, keys_path: tuple[str, ...],
         d = get_path(o, keys_path)
         if not isinstance(d, dict):
             continue
-        for k in d.keys():
-            if isinstance(k, str):
+        for k, v in d.items():
+            # value `false` is excluded: the oracle's comprehension
+            # statement `labels[k]` fails on a false value (is_truthy)
+            if isinstance(k, str) and v is not False:
                 gid = interner.lookup(k)
                 if gid in needed_set:
                     memb[local[gid], row] = True

@@ -27,7 +27,10 @@ class ColSpec:
     mode:
       'str'     scalar string -> int32 ids (MISSING if absent/non-string)
       'num'     scalar number -> float64 + bool presence
+      'val'     variant scalar -> int32 encoded-value ids (ir/encode.py)
       'present' presence of any value at path -> bool
+      'truthy'  present and not literal false -> bool (Rego statement truth)
+      'len'     count() of list/dict/string at path -> float64 + presence
       'keys'    dict keys at path -> CSR int32 ids
       'items'   dict (key,value-str) at path -> CSR pairs
       'strs'    string leaves (wildcard paths) -> CSR int32 ids
@@ -89,6 +92,15 @@ def get_path(obj: Any, path: tuple[str, ...]) -> Any:
     return obj
 
 
+def _has_path(obj: Any, path: tuple[str, ...]) -> bool:
+    """Distinguishes an explicit null value from an absent key."""
+    for p in path:
+        if not isinstance(obj, dict) or p not in obj:
+            return False
+        obj = obj[p]
+    return True
+
+
 def build_column(spec: ColSpec, objs: list, interner: Interner):
     """objs: list of resource dicts (None rows are tombstones -> absent)."""
     n = len(objs)
@@ -112,6 +124,19 @@ def build_column(spec: ColSpec, objs: list, interner: Interner):
                 vals[i] = float(v)
                 pres[i] = True
         return NumColumn(values=vals, present=pres)
+    if spec.mode == "val":
+        from gatekeeper_tpu.ir.encode import encode_value
+        ids = np.full((n,), MISSING, dtype=np.int32)
+        for i, o in enumerate(objs):
+            if o is None:
+                continue
+            v = get_path(o, spec.path)
+            if v is None and not _has_path(o, spec.path):
+                continue
+            key = encode_value(v)
+            if key is not None:
+                ids[i] = interner.intern(key)
+        return ScalarColumn(ids=ids)
     if spec.mode == "present":
         pres = np.zeros((n,), dtype=bool)
         for i, o in enumerate(objs):
@@ -119,6 +144,25 @@ def build_column(spec: ColSpec, objs: list, interner: Interner):
                 continue
             pres[i] = any(True for _ in iter_path(o, spec.path))
         return PresenceColumn(present=pres)
+    if spec.mode == "truthy":
+        pres = np.zeros((n,), dtype=bool)
+        for i, o in enumerate(objs):
+            if o is None:
+                continue
+            if _has_path(o, spec.path):
+                pres[i] = get_path(o, spec.path) is not False
+        return PresenceColumn(present=pres)
+    if spec.mode == "len":
+        vals = np.zeros((n,), dtype=np.float64)
+        pres = np.zeros((n,), dtype=bool)
+        for i, o in enumerate(objs):
+            if o is None:
+                continue
+            v = get_path(o, spec.path)
+            if isinstance(v, (list, dict, str)):
+                vals[i] = float(len(v))
+                pres[i] = True
+        return NumColumn(values=vals, present=pres)
     if spec.mode in ("keys", "items"):
         koffs = np.zeros((n + 1,), dtype=np.int32)
         kids: list[int] = []

@@ -1,0 +1,222 @@
+"""Lowerer tests: lowered programs must agree with the scalar oracle on
+the violation decision for every (constraint, resource) pair.
+
+The contract is one-sided in general (the device mask may over-
+approximate; host re-evaluation formats only real violations), but for
+the fully-lowerable templates below the masks should be exact.
+"""
+
+import numpy as np
+import pytest
+
+from gatekeeper_tpu.api.templates import compile_target_rego
+from gatekeeper_tpu.engine.veval import ProgramExecutor
+from gatekeeper_tpu.ir.lower import CannotLower, lower_template
+from gatekeeper_tpu.ir.prep import build_bindings
+from gatekeeper_tpu.rego.values import Obj, freeze
+from gatekeeper_tpu.store.table import ResourceMeta, ResourceTable
+
+REQUIRED_LABELS = """package k8srequiredlabels
+violation[{"msg": msg, "details": {"missing_labels": missing}}] {
+  provided := {label | input.review.object.metadata.labels[label]}
+  required := {label | label := input.constraint.spec.parameters.labels[_]}
+  missing := required - provided
+  count(missing) > 0
+  msg := sprintf("you must provide labels: %v", [missing])
+}
+"""
+
+ALLOWED_REPOS = """package k8sallowedrepos
+violation[{"msg": msg}] {
+  container := input.review.object.spec.containers[_]
+  satisfied := [good | repo = input.constraint.spec.parameters.repos[_] ; good = startswith(container.image, repo)]
+  not any(satisfied)
+  msg := sprintf("container <%v> has an invalid image repo <%v>", [container.name, container.image])
+}
+"""
+
+CONTAINER_LIMITS = """package k8scontainerlimits
+missing(obj, field) = true { not obj[field] }
+missing(obj, field) = true { obj[field] == "" }
+
+canonify_cpu(orig) = new { is_number(orig); new := orig * 1000 }
+canonify_cpu(orig) = new {
+  not is_number(orig)
+  endswith(orig, "m")
+  new := to_number(replace(orig, "m", ""))
+}
+canonify_cpu(orig) = new {
+  not is_number(orig)
+  not endswith(orig, "m")
+  re_match("^[0-9]+$", orig)
+  new := to_number(orig) * 1000
+}
+
+violation[{"msg": msg}] {
+  container := input.review.object.spec.containers[_]
+  not container.resources
+  msg := sprintf("container <%v> has no resource limits", [container.name])
+}
+violation[{"msg": msg}] {
+  container := input.review.object.spec.containers[_]
+  not container.resources.limits
+  msg := sprintf("container <%v> has no resource limits", [container.name])
+}
+violation[{"msg": msg}] {
+  container := input.review.object.spec.containers[_]
+  missing(container.resources.limits, "cpu")
+  msg := sprintf("container <%v> has no cpu limit", [container.name])
+}
+violation[{"msg": msg}] {
+  container := input.review.object.spec.containers[_]
+  cpu_orig := container.resources.limits.cpu
+  not canonify_cpu(cpu_orig)
+  msg := sprintf("container <%v> cpu limit <%v> could not be parsed", [container.name, cpu_orig])
+}
+violation[{"msg": msg}] {
+  container := input.review.object.spec.containers[_]
+  cpu_orig := container.resources.limits.cpu
+  cpu := canonify_cpu(cpu_orig)
+  max_cpu_orig := input.constraint.spec.parameters.cpu
+  max_cpu := canonify_cpu(max_cpu_orig)
+  cpu > max_cpu
+  msg := sprintf("container <%v> cpu limit is too high", [container.name])
+}
+"""
+
+
+def _mk_table(objs):
+    t = ResourceTable()
+    for i, o in enumerate(objs):
+        meta = ResourceMeta(api_version=o.get("apiVersion", "v1"),
+                            kind=o.get("kind", "Pod"),
+                            name=o.get("metadata", {}).get("name", f"r{i}"),
+                            namespace=o.get("metadata", {}).get("namespace"))
+        t.upsert(f"k{i}", o, meta)
+    return t
+
+
+def _review(meta, obj):
+    r = {"kind": {"group": meta.group, "version": meta.version, "kind": meta.kind},
+         "name": meta.name, "operation": "CREATE", "object": obj}
+    if meta.namespace is not None:
+        r["namespace"] = meta.namespace
+    return r
+
+
+def oracle_mask(compiled, constraints, table):
+    n = table.n_rows
+    mask = np.zeros((len(constraints), n), dtype=bool)
+    for r in range(n):
+        meta = table.meta_at(r)
+        obj = table.object_at(r)
+        if meta is None:
+            continue
+        review = freeze(_review(meta, obj))
+        for ci, c in enumerate(constraints):
+            inp = Obj({"review": review, "constraint": freeze(c)})
+            res = compiled.interp.query_set("violation", inp, None)
+            mask[ci, r] = len(res) > 0
+    return mask
+
+
+def device_mask(compiled, constraints, table, exact=True):
+    lowered = lower_template(compiled.module, compiled.interp)
+    b = build_bindings(lowered.spec, table, constraints)
+    return ProgramExecutor().run(lowered.program, b)
+
+
+def check(rego, kind, constraints, objs, exact=True):
+    compiled = compile_target_rego(kind, "k8s", rego)
+    table = _mk_table(objs)
+    dev = device_mask(compiled, constraints, table)
+    orc = oracle_mask(compiled, constraints, table)
+    if exact:
+        assert dev.tolist() == orc.tolist(), \
+            f"device:\n{dev}\noracle:\n{orc}"
+    else:  # over-approximation allowed, under-approximation never
+        assert (orc & ~dev).sum() == 0
+
+
+def test_required_labels_lowering():
+    objs = [
+        {"kind": "Namespace", "metadata": {"name": "a", "labels": {"gk": "x", "o": "y"}}},
+        {"kind": "Namespace", "metadata": {"name": "b", "labels": {"other": "y"}}},
+        {"kind": "Namespace", "metadata": {"name": "c"}},
+        {"kind": "Namespace", "metadata": {"name": "d", "labels": {"gk": "z"}}},
+    ]
+    cons = [
+        {"kind": "K8sRequiredLabels", "metadata": {"name": "gk"},
+         "spec": {"parameters": {"labels": ["gk"]}}},
+        {"kind": "K8sRequiredLabels", "metadata": {"name": "both"},
+         "spec": {"parameters": {"labels": ["gk", "o"]}}},
+        {"kind": "K8sRequiredLabels", "metadata": {"name": "none"},
+         "spec": {"parameters": {"labels": []}}},
+        {"kind": "K8sRequiredLabels", "metadata": {"name": "unset"},
+         "spec": {"parameters": {}}},
+    ]
+    check(REQUIRED_LABELS, "K8sRequiredLabels", cons, objs)
+
+
+def test_allowed_repos_lowering():
+    objs = [
+        {"metadata": {"name": "p1"},
+         "spec": {"containers": [{"name": "a", "image": "gcr.io/org/app:1"}]}},
+        {"metadata": {"name": "p2"},
+         "spec": {"containers": [{"name": "a", "image": "gcr.io/x"},
+                                 {"name": "b", "image": "docker.io/evil"}]}},
+        {"metadata": {"name": "p3"}, "spec": {"containers": []}},
+        {"metadata": {"name": "p4"}},  # no spec at all
+        {"metadata": {"name": "p5"},
+         "spec": {"containers": [{"name": "noimg"}]}},
+    ]
+    cons = [
+        {"kind": "K8sAllowedRepos", "metadata": {"name": "gcr"},
+         "spec": {"parameters": {"repos": ["gcr.io/"]}}},
+        {"kind": "K8sAllowedRepos", "metadata": {"name": "both"},
+         "spec": {"parameters": {"repos": ["gcr.io/", "docker.io/"]}}},
+        {"kind": "K8sAllowedRepos", "metadata": {"name": "emptylist"},
+         "spec": {"parameters": {"repos": []}}},
+    ]
+    check(ALLOWED_REPOS, "K8sAllowedRepos", cons, objs)
+
+
+def test_container_limits_lowering():
+    objs = [
+        {"metadata": {"name": "ok"},
+         "spec": {"containers": [
+             {"name": "a", "resources": {"limits": {"cpu": "100m", "memory": "1Gi"}}}]}},
+        {"metadata": {"name": "no-resources"},
+         "spec": {"containers": [{"name": "a"}]}},
+        {"metadata": {"name": "no-limits"},
+         "spec": {"containers": [{"name": "a", "resources": {}}]}},
+        {"metadata": {"name": "no-cpu"},
+         "spec": {"containers": [{"name": "a", "resources": {"limits": {"memory": "1Gi"}}}]}},
+        {"metadata": {"name": "empty-cpu"},
+         "spec": {"containers": [{"name": "a", "resources": {"limits": {"cpu": ""}}}]}},
+        {"metadata": {"name": "bad-cpu"},
+         "spec": {"containers": [{"name": "a", "resources": {"limits": {"cpu": "wat"}}}]}},
+        {"metadata": {"name": "big-cpu"},
+         "spec": {"containers": [{"name": "a", "resources": {"limits": {"cpu": "4"}}}]}},
+        {"metadata": {"name": "num-cpu"},
+         "spec": {"containers": [{"name": "a", "resources": {"limits": {"cpu": 2}}}]}},
+    ]
+    cons = [
+        {"kind": "K8sContainerLimits", "metadata": {"name": "max1"},
+         "spec": {"parameters": {"cpu": "1"}}},
+        {"kind": "K8sContainerLimits", "metadata": {"name": "max3000m"},
+         "spec": {"parameters": {"cpu": "3000m"}}},
+    ]
+    check(CONTAINER_LIMITS, "K8sContainerLimits", cons, objs)
+
+
+def test_unlowerable_falls_back():
+    rego = """package p
+violation[{"msg": "m"}] {
+  other := data.inventory.cluster["v1"]["Service"][_]
+  other.spec.x == input.review.object.spec.x
+}
+"""
+    compiled = compile_target_rego("P", "k8s", rego)
+    with pytest.raises(CannotLower):
+        lower_template(compiled.module, compiled.interp)
