@@ -1,0 +1,200 @@
+"""Vectorized constraint matching: the [n_constraints, n_resources]
+candidate mask.
+
+Native equivalent of the target's Rego matching library
+(reference pkg/target/target.go:49-255 — matching_constraints with
+kinds/apiGroups, namespaces, labelSelector, namespaceSelector), whose
+scalar transcription lives in target/k8s.py.  The audit cross-product
+runs matching once per (constraint, resource) pair inside the topdown
+interpreter (target.go:69-81); here each selector primitive is computed
+once as a column over all resources (numpy vectorized over the ragged
+label CSR), and each constraint combines primitive columns.
+
+The mask gates template evaluation: device violation masks are ANDed
+with it, and the scalar fallback only visits candidate pairs.
+
+Semantics notes mirrored from the scalar matcher:
+- absent `kinds` field -> wildcard; explicit empty list matches nothing;
+- `namespaces`: review.namespace must be listed (cluster-scoped
+  resources have no namespace and never match);
+- labelSelector matchExpressions use *violation* semantics per operator
+  (missing key violates In/Exists, NotIn never violates on missing,
+  empty values disarm In/NotIn) — target.go:178-219;
+- namespaceSelector resolves against the cached v1/Namespace object;
+  an uncached namespace never matches (autoreject is review-path only,
+  target.go:36-47).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from gatekeeper_tpu.store.interner import MISSING
+from gatekeeper_tpu.store.table import ResourceTable
+
+
+class _LabelIndex:
+    """Per-generation vectorized label lookups over the CSR columns."""
+
+    def __init__(self, keys: np.ndarray, vals: np.ndarray,
+                 offsets: np.ndarray, n: int):
+        counts = np.diff(offsets.astype(np.int64))
+        self.row_ids = np.repeat(np.arange(n, dtype=np.int64), counts)
+        self.keys = keys
+        self.vals = vals
+        self.n = n
+        self._value_cache: dict[int, np.ndarray] = {}
+
+    def value_of(self, key_id: int) -> np.ndarray:
+        """int32 [n]: label value id for key, MISSING where absent."""
+        hit = self._value_cache.get(key_id)
+        if hit is not None:
+            return hit
+        out = np.full((self.n,), MISSING, dtype=np.int32)
+        if key_id != MISSING and len(self.keys):
+            sel = self.keys == key_id
+            out[self.row_ids[sel]] = self.vals[sel]
+        self._value_cache[key_id] = out
+        return out
+
+    def has_key(self, key_id: int) -> np.ndarray:
+        return self.value_of(key_id) != MISSING
+
+
+class MatchEngine:
+    def __init__(self, table: ResourceTable):
+        self.table = table
+        self._gen = -1
+        self._ident = None
+        self._labels: _LabelIndex | None = None
+        self._ns_index: tuple | None = None
+
+    # -- columns -------------------------------------------------------
+
+    def _refresh(self) -> None:
+        gen = self.table.generation
+        if gen == self._gen:
+            return
+        self._gen = gen
+        self._ident = self.table.identity()
+        n = len(self._ident.alive)
+        self._labels = _LabelIndex(self._ident.label_keys,
+                                   self._ident.label_vals,
+                                   self._ident.label_offsets, n)
+        self._ns_index = None
+
+    def _namespace_labels(self):
+        """(ns name ids [K], per-resource slot [n] into 0..K or -1,
+        label dicts per slot)."""
+        if self._ns_index is not None:
+            return self._ns_index
+        items = self.table.namespace_label_items()
+        ns_ids = np.asarray(sorted(items), dtype=np.int32)
+        slot_of = {int(i): s for s, i in enumerate(ns_ids)}
+        col = self._ident.ns_ids
+        slots = np.full(col.shape, -1, dtype=np.int32)
+        if len(ns_ids):
+            for i in np.unique(col):
+                if int(i) in slot_of:
+                    slots[col == i] = slot_of[int(i)]
+        dicts = [dict(items[int(i)]) for i in ns_ids]
+        self._ns_index = (ns_ids, slots, dicts)
+        return self._ns_index
+
+    # -- selector primitives -------------------------------------------
+
+    def _selector_ok_obj(self, selector: dict) -> np.ndarray:
+        """matches_label_selector over object labels, vectorized [n]."""
+        it = self.table.interner
+        lab = self._labels
+        ok = np.ones((lab.n,), dtype=bool)
+        for k, v in (selector.get("matchLabels") or {}).items():
+            vid = it.lookup(v) if isinstance(v, str) else MISSING
+            ok &= lab.value_of(it.lookup(k) if isinstance(k, str) else MISSING) == vid \
+                if vid != MISSING else np.zeros((lab.n,), dtype=bool)
+        for expr in selector.get("matchExpressions") or []:
+            ok &= ~self._expr_violated_obj(expr)
+        return ok
+
+    def _expr_violated_obj(self, expr: dict) -> np.ndarray:
+        it = self.table.interner
+        lab = self._labels
+        op = expr.get("operator", "")
+        key = expr.get("key", "")
+        kid = it.lookup(key) if isinstance(key, str) else MISSING
+        values = expr.get("values") or []
+        has = lab.has_key(kid)
+        if op == "Exists":
+            return ~has
+        if op == "DoesNotExist":
+            return has
+        vids = [it.lookup(v) for v in values if isinstance(v, str)]
+        val = lab.value_of(kid)
+        in_vals = np.isin(val, np.asarray(vids, dtype=np.int32)) if vids \
+            else np.zeros((lab.n,), dtype=bool)
+        if op == "In":
+            if not values:
+                return ~has
+            return ~has | (has & ~in_vals)
+        if op == "NotIn":
+            return has & in_vals if values else np.zeros((lab.n,), dtype=bool)
+        return np.zeros((lab.n,), dtype=bool)  # unknown operator: no clause
+
+    def _selector_ok_ns(self, selector: dict) -> np.ndarray:
+        """namespaceSelector: resolve per-namespace then gather; uncached
+        namespace (slot -1) -> False."""
+        from gatekeeper_tpu.target.k8s import matches_label_selector
+        it = self.table.interner
+        ns_ids, slots, dicts = self._namespace_labels()
+        per_ns = np.zeros((len(ns_ids) + 1,), dtype=bool)  # last = uncached
+        for s, d in enumerate(dicts):
+            labels = {it.string(k): (it.string(v) if v != MISSING else None)
+                      for k, v in d.items()}
+            per_ns[s] = matches_label_selector(selector, labels)
+        return per_ns[np.where(slots >= 0, slots, len(ns_ids))] & (slots >= 0)
+
+    # -- the mask ------------------------------------------------------
+
+    def mask(self, constraints: list[dict]) -> np.ndarray:
+        """bool [len(constraints), n_rows]; tombstoned rows are False."""
+        self._refresh()
+        ident = self._ident
+        it = self.table.interner
+        n = len(ident.alive)
+        out = np.zeros((len(constraints), n), dtype=bool)
+        for ci, c in enumerate(constraints):
+            match = (c.get("spec") or {}).get("match") or {}
+            m = ident.alive.copy()
+
+            if "kinds" in match:
+                kinds = match["kinds"] if isinstance(match["kinds"], list) else []
+                km = np.zeros((n,), dtype=bool)
+                for ks in kinds:
+                    groups = ks.get("apiGroups") or []
+                    knames = ks.get("kinds") or []
+                    gm = np.ones((n,), dtype=bool) if "*" in groups else \
+                        np.isin(ident.group_ids, np.asarray(
+                            [it.lookup(g) for g in groups if isinstance(g, str)],
+                            dtype=np.int32))
+                    nm = np.ones((n,), dtype=bool) if "*" in knames else \
+                        np.isin(ident.kind_ids, np.asarray(
+                            [it.lookup(k) for k in knames if isinstance(k, str)],
+                            dtype=np.int32))
+                    km |= gm & nm
+                m &= km
+
+            if "namespaces" in match and match["namespaces"] is not None:
+                nss = [it.lookup(s) for s in match["namespaces"]
+                       if isinstance(s, str)]
+                m &= np.isin(ident.ns_ids, np.asarray(nss, dtype=np.int32)) \
+                    & (ident.ns_ids != MISSING)
+
+            if "namespaceSelector" in match and match["namespaceSelector"] is not None:
+                m &= self._selector_ok_ns(match["namespaceSelector"])
+
+            selector = match.get("labelSelector") or {}
+            if selector:
+                m &= self._selector_ok_obj(selector)
+
+            out[ci] = m
+        return out

@@ -1,0 +1,111 @@
+"""MatchEngine property tests: the vectorized mask must agree with the
+scalar matcher (target/k8s.py, itself a transcription of
+target.go:49-255) on every (constraint, resource) pair."""
+
+import random
+
+import numpy as np
+
+from gatekeeper_tpu.engine.match import MatchEngine
+from gatekeeper_tpu.store.table import ResourceMeta, ResourceTable
+from gatekeeper_tpu.target.k8s import K8sValidationTarget
+
+
+def _rand_resource(rng, i):
+    kinds = [("v1", "Pod"), ("v1", "Namespace"), ("apps/v1", "Deployment"),
+             ("v1", "Service"), ("rbac.authorization.k8s.io/v1", "Role")]
+    api, kind = rng.choice(kinds)
+    ns = rng.choice([None, "default", "kube-system", "prod", "ghost"])
+    if kind == "Namespace":
+        ns = None
+    labels = {}
+    for k in ("app", "env", "tier", "owner"):
+        if rng.random() < 0.5:
+            labels[k] = rng.choice(["a", "b", "c"])
+    obj = {"apiVersion": api, "kind": kind,
+           "metadata": {"name": f"r{i}", "labels": labels}}
+    if ns:
+        obj["metadata"]["namespace"] = ns
+    return obj, ResourceMeta(api_version=api, kind=kind, name=f"r{i}", namespace=ns)
+
+
+def _rand_constraint(rng, i):
+    match = {}
+    r = rng.random()
+    if r < 0.3:
+        match["kinds"] = [{"apiGroups": rng.choice([["*"], [""], ["apps"]]),
+                           "kinds": rng.choice([["*"], ["Pod"], ["Pod", "Deployment"]])}]
+    elif r < 0.4:
+        match["kinds"] = []  # explicit empty: matches nothing
+    if rng.random() < 0.3:
+        match["namespaces"] = rng.sample(["default", "prod", "nosuch"], k=2)
+    if rng.random() < 0.5:
+        sel = {}
+        if rng.random() < 0.7:
+            sel["matchLabels"] = {"app": rng.choice(["a", "b", "zz"])}
+        if rng.random() < 0.5:
+            sel["matchExpressions"] = [{
+                "key": rng.choice(["env", "tier", "nope"]),
+                "operator": rng.choice(["In", "NotIn", "Exists", "DoesNotExist"]),
+                "values": rng.choice([[], ["a"], ["a", "b"]]),
+            }]
+        match["labelSelector"] = sel
+    if rng.random() < 0.3:
+        match["namespaceSelector"] = {
+            "matchLabels": {"team": rng.choice(["x", "y"])}}
+    return {"kind": "K8sTest", "metadata": {"name": f"c{i}"},
+            "spec": {"match": match}}
+
+
+def test_match_engine_agrees_with_scalar():
+    rng = random.Random(7)
+    table = ResourceTable()
+    handler = K8sValidationTarget()
+    # a couple of cached namespaces with labels (for namespaceSelector)
+    for ns, team in (("default", "x"), ("prod", "y")):
+        obj = {"apiVersion": "v1", "kind": "Namespace",
+               "metadata": {"name": ns, "labels": {"team": team}}}
+        key, meta, _ = handler.process_data(obj)
+        table.upsert(key, obj, meta)
+    resources = []
+    for i in range(120):
+        obj, meta = _rand_resource(rng, i)
+        key, m, _ = handler.process_data(obj)
+        table.upsert(key, obj, m)
+    # a tombstone
+    table.remove("cluster/v1/Namespace/prod")
+    constraints = [_rand_constraint(rng, i) for i in range(40)]
+
+    engine = MatchEngine(table)
+    mask = engine.mask(constraints)
+
+    rows = {row: key for key, row in table.rows_items()}
+    for ci, c in enumerate(constraints):
+        for row in range(table.n_rows):
+            meta = table.meta_at(row)
+            if meta is None:
+                assert not mask[ci, row]
+                continue
+            review = handler.make_review(meta, table.object_at(row))
+            expect = handler._matches(c, review, table)
+            assert mask[ci, row] == expect, (
+                f"constraint {c['spec']['match']} row {rows.get(row)} "
+                f"meta {meta}: vector={mask[ci, row]} scalar={expect}")
+
+
+def test_match_engine_generation_cache():
+    table = ResourceTable()
+    handler = K8sValidationTarget()
+    obj = {"apiVersion": "v1", "kind": "Pod",
+           "metadata": {"name": "p", "namespace": "default"}}
+    key, meta, _ = handler.process_data(obj)
+    table.upsert(key, obj, meta)
+    engine = MatchEngine(table)
+    c = {"kind": "K", "metadata": {"name": "c"},
+         "spec": {"match": {"kinds": [{"apiGroups": ["*"], "kinds": ["Pod"]}]}}}
+    assert engine.mask([c]).tolist() == [[True]]
+    obj2 = {"apiVersion": "v1", "kind": "Service",
+            "metadata": {"name": "s", "namespace": "default"}}
+    key2, meta2, _ = handler.process_data(obj2)
+    table.upsert(key2, obj2, meta2)
+    assert engine.mask([c]).tolist() == [[True, False]]
