@@ -64,6 +64,9 @@ class CompiledTemplate:
     # vectorized program attached by the jax driver's lowerer; None = the
     # scalar fallback handles this template entirely
     vectorized: Any = None
+    # does any rule read data.inventory?  If not, drivers skip building
+    # the frozen inventory document for message evaluation
+    uses_inventory: bool = False
 
     def violations(self, input_doc, data_doc, tracer=None) -> list:
         return self.interp.query_set("violation", input_doc, data_doc, tracer=tracer)
@@ -105,5 +108,14 @@ def check_rego_conformance(module: Module) -> None:
 def compile_target_rego(kind: str, target: str, rego_src: str) -> CompiledTemplate:
     module = parse_module(rego_src)  # ParseError propagates with its location
     check_rego_conformance(module)
+    uses_inv = [False]
+
+    def spot_data(t):
+        if isinstance(t, Ref) and isinstance(t.base, Var) and t.base.name == "data":
+            uses_inv[0] = True
+
+    for rule in module.rules:
+        walk_terms(rule, spot_data)
     return CompiledTemplate(kind=kind, target=target, source=rego_src,
-                            module=module, interp=Interpreter(module))
+                            module=module, interp=Interpreter(module),
+                            uses_inventory=uses_inv[0])

@@ -38,6 +38,11 @@ from gatekeeper_tpu.store.table import ResourceMeta
 @dataclasses.dataclass
 class QueryOpts:
     tracing: bool = False  # drivers.Tracing (interface.go:9-19)
+    # audit: stop formatting results after N per constraint (the audit
+    # manager's -constraintViolationsLimit, reference manager.go:35; the
+    # jax driver then only host-formats up to N violating pairs per
+    # constraint while still counting the rest on device)
+    limit_per_constraint: int | None = None
 
 
 class Driver(abc.ABC):

@@ -126,7 +126,9 @@ class LocalDriver(Driver):
         (src.go:19-34): input = {review, constraint}, data.inventory = inv."""
         input_doc = Obj({"review": frozen_review,
                          "constraint": self._frozen_constraint(st, constraint)})
-        inv = st.inventory_doc()
+        # freezing the whole inventory is O(cache size); skip it for
+        # templates that never read data.inventory
+        inv = st.inventory_doc() if compiled.uses_inventory else None
         tracer: list | None = [] if trace is not None else None
         for v in compiled.interp.query_set("violation", input_doc, inv, tracer=tracer):
             if not isinstance(v, Obj) or "msg" not in v:

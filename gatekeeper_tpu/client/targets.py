@@ -68,3 +68,10 @@ class TargetHandler(abc.ABC):
     @abc.abstractmethod
     def make_review(self, meta: ResourceMeta, obj: dict) -> dict:
         """Review payload for a cached resource during audit."""
+
+    def make_match_engine(self, table: ResourceTable):
+        """Optional vectorized matcher: an object with
+        ``mask(constraints) -> bool [n_constraints, n_rows]`` agreeing
+        with matching_constraints.  None -> the jax driver matches
+        scalar-side (generic test targets)."""
+        return None

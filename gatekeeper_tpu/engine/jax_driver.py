@@ -1,0 +1,227 @@
+"""The ``jax`` driver: vectorized device engine with scalar fallback.
+
+This plugs the TPU pipeline into the same Driver seam the reference
+exposes for engines (vendor/.../drivers/interface.go:21-33 — the local
+OPA driver and the remote HTTP driver are the two reference
+implementations; this is the third kind the seam was designed for).
+
+Audit dataflow (replacing the single-threaded topdown cross-product,
+reference client.go:584-607 + regolib/src.go:38-52):
+
+  1. per template kind: lowered program + bindings (columns, host
+     tables, per-constraint tensors) — cached by (table generation,
+     constraint-set version), so steady-state audits re-run only the
+     jitted executable;
+  2. device: violation mask [n_constraints, n_resources], ANDed with
+     the vectorized match mask (engine/match.py);
+  3. host: only the violating pairs are re-evaluated with the scalar
+     oracle to produce exact messages/details (the device mask may
+     over-approximate; over-approximated pairs simply format to
+     nothing).  With a per-constraint limit (the audit manager's cap,
+     reference manager.go:35) the host formats at most
+     limit x n_constraints pairs regardless of inventory size.
+
+Templates outside the lowerable subset (e.g. data.inventory joins) run
+on the scalar oracle restricted to match-mask candidates — same
+results, no silent behavior split (SURVEY §7 hard-part 6).
+
+The review path delegates to the scalar engine: single-review latency
+is interpreter-bound and the reference's semantics (autoreject,
+matching, tracing) are already exact there.  Micro-batched admission
+rides the audit kernels via webhook batching (pkg webhook).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+from gatekeeper_tpu.api.templates import CompiledTemplate
+from gatekeeper_tpu.client.interface import QueryOpts
+from gatekeeper_tpu.client.local_driver import LocalDriver, TargetState
+from gatekeeper_tpu.client.types import Result
+from gatekeeper_tpu.engine.veval import ProgramExecutor
+from gatekeeper_tpu.ir.lower import CannotLower, lower_template
+from gatekeeper_tpu.ir.prep import build_bindings
+from gatekeeper_tpu.rego.values import freeze
+
+
+class JaxTargetState(TargetState):
+    def __init__(self):
+        super().__init__()
+        self.con_version: dict[str, int] = {}      # kind -> bump on change
+        self.bindings_cache: dict[str, tuple] = {}  # kind -> (gen, ver, b)
+        self.mask_cache: dict[str, tuple] = {}
+        self.match_engine = None
+
+    def bump(self, kind: str) -> None:
+        self.con_version[kind] = self.con_version.get(kind, 0) + 1
+
+
+class JaxDriver(LocalDriver):
+    """Driver with device-evaluated audit; construction mirrors
+    local.New (drivers/local/local.go:28) with tracing default."""
+
+    def __init__(self, tracing: bool = False):
+        super().__init__(tracing=tracing)
+        self.executor = ProgramExecutor()
+
+    # ------------------------------------------------------------------
+
+    def init(self, targets) -> None:
+        self.targets = dict(targets)
+        for name in targets:
+            self.state.setdefault(name, JaxTargetState())
+
+    def put_template(self, target: str, kind: str, compiled: CompiledTemplate) -> None:
+        if compiled.vectorized is None:
+            try:
+                compiled.vectorized = lower_template(compiled.module, compiled.interp)
+            except CannotLower:
+                compiled.vectorized = None  # scalar fallback
+        st = self._state(target)
+        st.templates[kind] = compiled
+        st.bump(kind)
+
+    def delete_template(self, target: str, kind: str) -> None:
+        super().delete_template(target, kind)
+        st = self._state(target)
+        st.bump(kind)
+
+    def put_constraint(self, target: str, kind: str, name: str, constraint: dict) -> None:
+        super().put_constraint(target, kind, name, constraint)
+        self._state(target).bump(kind)
+
+    def delete_constraint(self, target: str, kind: str, name: str) -> None:
+        super().delete_constraint(target, kind, name)
+        self._state(target).bump(kind)
+
+    # ------------------------------------------------------------------
+
+    def _match_engine(self, st: JaxTargetState, target: str):
+        if st.match_engine is None:
+            st.match_engine = self.targets[target].make_match_engine(st.table)
+        return st.match_engine
+
+    def _kind_constraints(self, st: TargetState, kind: str) -> list[dict]:
+        return [st.constraints[kind][n] for n in sorted(st.constraints.get(kind, {}))]
+
+    def _kind_mask(self, st: JaxTargetState, target: str, kind: str,
+                   constraints: list[dict]) -> np.ndarray | None:
+        engine = self._match_engine(st, target)
+        if engine is None:
+            return None
+        key = (st.table.generation, st.con_version.get(kind, 0))
+        hit = st.mask_cache.get(kind)
+        if hit is not None and hit[0] == key:
+            return hit[1]
+        mask = engine.mask(constraints)
+        st.mask_cache[kind] = (key, mask)
+        return mask
+
+    def _kind_violations(self, st: JaxTargetState, kind: str,
+                         compiled: CompiledTemplate,
+                         constraints: list[dict]) -> np.ndarray:
+        key = (st.table.generation, st.con_version.get(kind, 0))
+        hit = st.bindings_cache.get(kind)
+        if hit is not None and hit[0] == key:
+            bindings = hit[1]
+        else:
+            bindings = build_bindings(compiled.vectorized.spec, st.table, constraints)
+            st.bindings_cache[kind] = (key, bindings)
+        return self.executor.run(compiled.vectorized.program, bindings)
+
+    # ------------------------------------------------------------------
+
+    def query_audit(self, target: str,
+                    opts: QueryOpts | None = None) -> tuple[list[Result], str | None]:
+        st = self._state(target)
+        if not isinstance(st, JaxTargetState):
+            return super().query_audit(target, opts)
+        handler = self.targets[target]
+        tracing = opts.tracing if opts is not None else self.default_tracing
+        limit = opts.limit_per_constraint if opts is not None else None
+        trace: list | None = [] if tracing else None
+
+        # row ordering matches the scalar driver (sorted cache keys) so
+        # both drivers return identical result lists
+        ordered_rows = [row for _, row in sorted(st.table.rows_items())]
+        row_order = {row: i for i, row in enumerate(ordered_rows)}
+
+        tagged: list[tuple[tuple, Result]] = []
+        for kind in sorted(st.templates):
+            compiled = st.templates[kind]
+            constraints = self._kind_constraints(st, kind)
+            if not constraints:
+                continue
+            mask = self._kind_mask(st, target, kind, constraints)
+            if compiled.vectorized is not None and mask is not None:
+                viol = self._kind_violations(st, kind, compiled, constraints)
+                cand = viol & mask[:, : viol.shape[1]]
+                self._format_pairs(st, target, handler, compiled, constraints,
+                                   cand, row_order, kind, limit, trace, tagged)
+            else:
+                self._scalar_kind(st, target, handler, compiled, constraints,
+                                  mask, ordered_rows, row_order, kind, limit,
+                                  trace, tagged)
+        tagged.sort(key=lambda kv: kv[0])
+        return [r for _, r in tagged], ("\n".join(trace) if trace is not None else None)
+
+    def _format_pairs(self, st, target, handler, compiled, constraints,
+                      cand: np.ndarray, row_order, kind, limit, trace, tagged):
+        """Host-format violating (constraint, resource) pairs via the
+        scalar oracle; over-approximated pairs yield no results."""
+        for ci, c in enumerate(constraints):
+            rows = np.nonzero(cand[ci])[0]
+            # visit rows in the scalar driver's order for identical output
+            rows = sorted((r for r in rows.tolist() if r in row_order),
+                          key=row_order.__getitem__)
+            emitted = 0
+            for row in rows:
+                if limit is not None and emitted >= limit:
+                    break
+                meta = st.table.meta_at(row)
+                if meta is None:
+                    continue
+                review = handler.make_review(meta, st.table.object_at(row))
+                results = list(self._eval_pair(st, target, compiled, review,
+                                               freeze(review), c, trace))
+                for r in results:
+                    tagged.append(((row_order[row], kind,
+                                    (c.get("metadata") or {}).get("name", "")), r))
+                emitted += len(results)
+
+    def _scalar_kind(self, st, target, handler, compiled, constraints,
+                     mask, ordered_rows, row_order, kind, limit, trace, tagged):
+        """Scalar fallback for unlowerable templates, restricted to
+        match-mask candidates when a vector matcher exists."""
+        emitted = {ci: 0 for ci in range(len(constraints))}
+        for row in ordered_rows:
+            meta = st.table.meta_at(row)
+            if meta is None:
+                continue
+            review = None
+            frozen = None
+            for ci, c in enumerate(constraints):
+                if limit is not None and emitted[ci] >= limit:
+                    continue
+                if mask is not None:
+                    if not mask[ci, row]:
+                        continue
+                else:
+                    if review is None:
+                        review = handler.make_review(meta, st.table.object_at(row))
+                    if not any(True for _ in handler.matching_constraints(
+                            review, [c], st.table)):
+                        continue
+                if review is None:
+                    review = handler.make_review(meta, st.table.object_at(row))
+                if frozen is None:
+                    frozen = freeze(review)
+                results = list(self._eval_pair(st, target, compiled, review,
+                                               frozen, c, trace))
+                for r in results:
+                    tagged.append(((row_order[row], kind,
+                                    (c.get("metadata") or {}).get("name", "")), r))
+                emitted[ci] += len(results)

@@ -210,6 +210,10 @@ class K8sValidationTarget(TargetHandler):
                 out.append((c, "Namespace is not cached in OPA.", {}))
         return out
 
+    def make_match_engine(self, table: ResourceTable):
+        from gatekeeper_tpu.engine.match import MatchEngine
+        return MatchEngine(table)
+
     # ------------------------------------------------------------------
     # schema / validation
 

@@ -99,14 +99,14 @@ def constraint_doc(kind: str, name: str, params=None) -> dict:
     return doc
 
 
-DRIVERS = ["local"]
+DRIVERS = ["local", "jax"]
 
 
 def make_driver(name: str):
     if name == "local":
         return LocalDriver()
     if name == "jax":
-        from gatekeeper_tpu.client.jax_driver import JaxDriver
+        from gatekeeper_tpu.engine.jax_driver import JaxDriver
 
         return JaxDriver()
     raise ValueError(name)
