@@ -120,17 +120,15 @@ class JaxDriver(LocalDriver):
         st.mask_cache[kind] = (key, mask)
         return mask
 
-    def _kind_violations(self, st: JaxTargetState, kind: str,
-                         compiled: CompiledTemplate,
-                         constraints: list[dict]) -> np.ndarray:
+    def _kind_bindings(self, st: JaxTargetState, kind: str,
+                       compiled: CompiledTemplate, constraints: list[dict]):
         key = (st.table.generation, st.con_version.get(kind, 0))
         hit = st.bindings_cache.get(kind)
         if hit is not None and hit[0] == key:
-            bindings = hit[1]
-        else:
-            bindings = build_bindings(compiled.vectorized.spec, st.table, constraints)
-            st.bindings_cache[kind] = (key, bindings)
-        return self.executor.run(compiled.vectorized.program, bindings)
+            return hit[1]
+        bindings = build_bindings(compiled.vectorized.spec, st.table, constraints)
+        st.bindings_cache[kind] = (key, bindings)
+        return bindings
 
     # ------------------------------------------------------------------
 
@@ -157,10 +155,16 @@ class JaxDriver(LocalDriver):
                 continue
             mask = self._kind_mask(st, target, kind, constraints)
             if compiled.vectorized is not None and mask is not None:
-                viol = self._kind_violations(st, kind, compiled, constraints)
-                cand = viol & mask[:, : viol.shape[1]]
-                self._format_pairs(st, target, handler, compiled, constraints,
-                                   cand, row_order, kind, limit, trace, tagged)
+                bindings = self._kind_bindings(st, kind, compiled, constraints)
+                prog = compiled.vectorized.program
+                if limit is not None:
+                    self._format_topk(st, target, handler, compiled, constraints,
+                                      prog, bindings, mask, row_order, kind,
+                                      limit, trace, tagged)
+                else:
+                    cand = self.executor.run(prog, bindings, match=mask)
+                    self._format_pairs(st, target, handler, compiled, constraints,
+                                       cand, row_order, kind, limit, trace, tagged)
             else:
                 self._scalar_kind(st, target, handler, compiled, constraints,
                                   mask, ordered_rows, row_order, kind, limit,
@@ -191,6 +195,48 @@ class JaxDriver(LocalDriver):
                     tagged.append(((row_order[row], kind,
                                     (c.get("metadata") or {}).get("name", "")), r))
                 emitted += len(results)
+
+    def _format_topk(self, st, target, handler, compiled, constraints,
+                     prog, bindings, mask, row_order, kind, limit, trace, tagged):
+        """Capped audit: device finds the first-k candidate rows per
+        constraint; the host formats only those.  If over-approximated
+        pairs leave the cap under-filled while more candidates exist,
+        fall back to the full mask for that constraint."""
+        counts, rows, valid = self.executor.run_topk(prog, bindings, limit,
+                                                     match=mask)
+        full_cand = None
+        for ci, c in enumerate(constraints):
+            sel = [int(r) for r, v in zip(rows[ci], valid[ci]) if v]
+            sel = sorted((r for r in sel if r in row_order),
+                         key=row_order.__getitem__)
+            emitted = self._emit_rows(st, target, handler, compiled, c, sel,
+                                      row_order, kind, limit, trace, tagged)
+            if emitted < limit and int(counts[ci]) > len(sel):
+                if full_cand is None:
+                    full_cand = self.executor.run(prog, bindings, match=mask)
+                rest = sorted((int(r) for r in np.nonzero(full_cand[ci])[0]
+                               if int(r) in row_order and int(r) not in set(sel)),
+                              key=row_order.__getitem__)
+                self._emit_rows(st, target, handler, compiled, c, rest,
+                                row_order, kind, limit - emitted, trace, tagged)
+
+    def _emit_rows(self, st, target, handler, compiled, c, rows, row_order,
+                   kind, limit, trace, tagged) -> int:
+        emitted = 0
+        for row in rows:
+            if limit is not None and emitted >= limit:
+                break
+            meta = st.table.meta_at(row)
+            if meta is None:
+                continue
+            review = handler.make_review(meta, st.table.object_at(row))
+            results = list(self._eval_pair(st, target, compiled, review,
+                                           freeze(review), c, trace))
+            for r in results:
+                tagged.append(((row_order[row], kind,
+                                (c.get("metadata") or {}).get("name", "")), r))
+            emitted += len(results)
+        return emitted
 
     def _scalar_kind(self, st, target, handler, compiled, constraints,
                      mask, ordered_rows, row_order, kind, limit, trace, tagged):

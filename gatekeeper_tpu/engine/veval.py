@@ -195,7 +195,9 @@ class _Evaluator:
 
 
 def _eval_program(program: Program, arrays: dict[str, jax.Array]) -> jax.Array:
-    """-> violation mask [C, R] bool (padded)."""
+    """-> violation mask [C, R] bool (padded).  An optional "__match__"
+    input (the vectorized constraint match mask, engine/match.py) gates
+    the result on device."""
     ev = _Evaluator(program, arrays)
     alive = arrays["__alive__"][None, :, None]
     cvalid = arrays["__cvalid__"][:, None, None]
@@ -218,8 +220,27 @@ def _eval_program(program: Program, arrays: dict[str, jax.Array]) -> jax.Array:
     c_pad = arrays["__cvalid__"].shape[0]
     r_pad = arrays["__alive__"].shape[0]
     if viol is None:
-        return jnp.zeros((c_pad, r_pad), dtype=bool)
-    return jnp.broadcast_to(viol, (c_pad, r_pad))
+        viol = jnp.zeros((c_pad, r_pad), dtype=bool)
+    else:
+        viol = jnp.broadcast_to(viol, (c_pad, r_pad))
+    match = arrays.get("__match__")
+    if match is not None:
+        viol = viol & match
+    return viol
+
+
+def topk_reduce(viol: jax.Array, k: int):
+    """First-k violating resource rows per constraint, on device.
+
+    Returns (counts [C] int32, rows [C, k] int32, valid [C, k] bool).
+    Implements the audit manager's per-constraint violation cap
+    (reference manager.go:35,161-199) as a device reduction so the host
+    never materializes the full mask."""
+    c_pad, r_pad = viol.shape
+    counts = jnp.sum(viol, axis=1, dtype=jnp.int32)
+    score = jnp.where(viol, jnp.arange(r_pad, 0, -1, dtype=jnp.int32)[None, :], 0)
+    vals, rows = jax.lax.top_k(score, k)
+    return counts, rows, vals > 0
 
 
 class ProgramExecutor:
@@ -228,19 +249,50 @@ class ProgramExecutor:
     def __init__(self):
         self._cache: dict[tuple, Any] = {}
 
-    def run(self, program: Program, bindings: Bindings) -> np.ndarray:
-        """Evaluate; returns the violation mask trimmed to live shape
-        [n_constraints, n_resources]."""
-        names = tuple(sorted(bindings.arrays))
-        key = (program.cache_key(),
-               tuple((nm,) + tuple(bindings.arrays[nm].shape)
-                     + (str(bindings.arrays[nm].dtype),) for nm in names))
+    def _arrays(self, bindings: Bindings, match: np.ndarray | None):
+        arrays = bindings.arrays
+        if match is not None:
+            padded = np.zeros((bindings.c_pad, bindings.r_pad), dtype=bool)
+            padded[: match.shape[0], : match.shape[1]] = match
+            arrays = dict(arrays)
+            arrays["__match__"] = padded
+        return arrays
+
+    def _compiled(self, program: Program, arrays: dict, topk: int | None):
+        names = tuple(sorted(arrays))
+        key = (program.cache_key(), topk,
+               tuple((nm,) + tuple(arrays[nm].shape)
+                     + (str(arrays[nm].dtype),) for nm in names))
         fn = self._cache.get(key)
         if fn is None:
-            def raw(args: tuple):
-                return _eval_program(program, dict(zip(names, args)))
+            if topk is None:
+                def raw(args: tuple):
+                    return _eval_program(program, dict(zip(names, args)))
+            else:
+                def raw(args: tuple):
+                    viol = _eval_program(program, dict(zip(names, args)))
+                    return topk_reduce(viol, topk)
             fn = jax.jit(raw)
             self._cache[key] = fn
-        args = tuple(bindings.arrays[nm] for nm in names)
-        mask = np.asarray(fn(args))
+        return fn, names
+
+    def run(self, program: Program, bindings: Bindings,
+            match: np.ndarray | None = None) -> np.ndarray:
+        """Evaluate; returns the violation mask trimmed to live shape
+        [n_constraints, n_resources]."""
+        arrays = self._arrays(bindings, match)
+        fn, names = self._compiled(program, arrays, None)
+        mask = np.asarray(fn(tuple(arrays[nm] for nm in names)))
         return mask[: bindings.n_constraints, : bindings.n_resources]
+
+    def run_topk(self, program: Program, bindings: Bindings, k: int,
+                 match: np.ndarray | None = None):
+        """Evaluate + device top-k: (counts [C], rows [C, k], valid
+        [C, k]) trimmed to the live constraint count.  The full mask
+        never leaves the device."""
+        arrays = self._arrays(bindings, match)
+        fn, names = self._compiled(program, arrays, k)
+        counts, rows, valid = fn(tuple(arrays[nm] for nm in names))
+        nc = bindings.n_constraints
+        return (np.asarray(counts)[:nc], np.asarray(rows)[:nc],
+                np.asarray(valid)[:nc])

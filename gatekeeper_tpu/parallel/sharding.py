@@ -1,0 +1,157 @@
+"""Multi-chip sharding of the audit matrix.
+
+The reference's audit is one single-threaded topdown query over the
+whole constraints x resources cross-product (client.go:584-607,
+regolib/src.go:38-52) — zero intra-evaluation parallelism (SURVEY
+§2.4).  Here the matrix shards over a 2-D device mesh:
+
+- axis ``r`` (the long axis): resource columns, element tensors,
+  membership matrices and the match mask shard along resources — the
+  direct analogue of sequence/context parallelism for this workload
+  (SURVEY §5 "long-context"), scaling inventories past one chip's HBM
+  over ICI;
+- axis ``c``: per-constraint tensors (param sets, cvals, match rows)
+  shard along constraints — the tensor-parallel analogue;
+- lookup tables (unique-value predicates) are replicated: they are the
+  small "weights" of this model.
+
+The per-device program is exactly engine/veval.py's program evaluation;
+cross-device reduction is a psum of violation counts over ``r`` plus an
+all_gather + re-top-k for the first-k violating rows per constraint
+(XLA collectives over ICI — no NCCL/MPI analogue needed, the compiler
+inserts the collectives from shardings).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from gatekeeper_tpu.engine.veval import _eval_program, topk_reduce
+from gatekeeper_tpu.ir.prep import Bindings
+from gatekeeper_tpu.ir.program import Program
+
+
+def binding_spec(name: str, arr: np.ndarray) -> P:
+    """PartitionSpec for one bound array, by naming convention
+    (ir/prep.py): resources shard on 'r', constraints on 'c', lookup
+    tables replicate."""
+    base = name.split(".")[0]
+    if name == "__match__":
+        return P("c", "r")
+    if name == "__alive__":
+        return P("r")
+    if name == "__cvalid__":
+        return P("c")
+    if name.startswith("__elem__:") or base.startswith("e:"):
+        return P("r", None)
+    if base.startswith("r:"):
+        return P("r")
+    if base.startswith("m") and base[1:].isdigit():
+        return P(None, "r")                      # memb [L, R]
+    if base.startswith("cs") and base[2:].isdigit():
+        return P("c", None)                      # cset [C, K]
+    if base.startswith("cv") and base[2:].isdigit():
+        return P("c")                            # cval [C]
+    if base.startswith("pt") and base[2:].isdigit():
+        if name.endswith(".idx") or name.endswith(".valid"):
+            return P("c", None)                  # param index sets [C, K]
+        return P(None, None)                     # ptable [P, T] replicated
+    if base.startswith("t") and base[1:].isdigit():
+        return P(None)                           # unary table [T]
+    return P(*([None] * arr.ndim))
+
+
+def pad_bindings_for_mesh(bindings: Bindings, c_shards: int,
+                          r_shards: int) -> Bindings:
+    """Re-pad the c/r dimensions to multiples of the mesh axes."""
+    def up(n, m):
+        return ((n + m - 1) // m) * m
+
+    c_pad2 = up(bindings.c_pad, c_shards)
+    r_pad2 = up(bindings.r_pad, r_shards)
+    if c_pad2 == bindings.c_pad and r_pad2 == bindings.r_pad:
+        return bindings
+    out = {}
+    for name, arr in bindings.arrays.items():
+        spec = binding_spec(name, arr)
+        pads = []
+        for d, ax in enumerate(spec):
+            if ax == "r" and arr.shape[d] == bindings.r_pad:
+                pads.append((0, r_pad2 - bindings.r_pad))
+            elif ax == "c" and arr.shape[d] == bindings.c_pad:
+                pads.append((0, c_pad2 - bindings.c_pad))
+            else:
+                pads.append((0, 0))
+        while len(pads) < arr.ndim:
+            pads.append((0, 0))
+        fill = -1 if arr.dtype == np.int32 and not name.endswith(".idx") else 0
+        out[name] = np.pad(arr, pads, constant_values=fill)
+    return Bindings(arrays=out, n_constraints=bindings.n_constraints,
+                    n_resources=bindings.n_resources, c_pad=c_pad2,
+                    r_pad=r_pad2, e_pads=bindings.e_pads)
+
+
+def make_mesh(n_devices: int | None = None) -> Mesh:
+    """2-D (c, r) mesh: r gets the larger factor (the long axis)."""
+    devices = jax.devices()
+    n = n_devices or len(devices)
+    devices = np.asarray(devices[:n])
+    c = 1
+    for cand in (2, 4):
+        if n % cand == 0 and n // cand >= 2:
+            c = cand
+            break
+    return Mesh(devices.reshape(c, n // c), axis_names=("c", "r"))
+
+
+def make_sharded_audit_fn(program: Program, names: tuple[str, ...],
+                          specs: dict[str, P], mesh: Mesh, k: int,
+                          r_pad: int):
+    """Jitted multi-chip audit step: args (in `names` order, sharded per
+    `specs`) -> (counts [C], rows [C, k], valid [C, k]), replicated over
+    r, sharded over c."""
+    r_shards = mesh.shape["r"]
+    r_local = r_pad // r_shards
+
+    def local_step(*args):
+        arrays = dict(zip(names, args))
+        viol = _eval_program(program, arrays)           # [C/c, R/r]
+        counts = jax.lax.psum(jnp.sum(viol, axis=1, dtype=jnp.int32), "r")
+        # local first-k, re-ranked globally after an all_gather over r
+        base = jax.lax.axis_index("r") * r_local
+        score = jnp.where(viol,
+                          (r_pad - base) - jnp.arange(r_local, dtype=jnp.int32)[None, :],
+                          0)
+        vals, rows_local = jax.lax.top_k(score, k)
+        rows_global = rows_local + base
+        g_vals = jax.lax.all_gather(vals, "r", axis=1, tiled=True)        # [C, r*k]
+        g_rows = jax.lax.all_gather(rows_global, "r", axis=1, tiled=True)
+        top_vals, top_idx = jax.lax.top_k(g_vals, k)
+        rows = jnp.take_along_axis(g_rows, top_idx, axis=1)
+        return counts, rows, top_vals > 0
+
+    in_specs = tuple(specs[nm] for nm in names)
+    out_specs = (P("c"), P("c", None), P("c", None))
+    stepped = shard_map(local_step, mesh=mesh, in_specs=in_specs,
+                        out_specs=out_specs, check_vma=False)
+    return jax.jit(stepped)
+
+
+def run_sharded_audit(program: Program, bindings: Bindings, mesh: Mesh,
+                      k: int = 20):
+    """Convenience wrapper: pad, shard, run one audit step."""
+    b = pad_bindings_for_mesh(bindings, mesh.shape["c"], mesh.shape["r"])
+    names = tuple(sorted(b.arrays))
+    specs = {nm: binding_spec(nm, b.arrays[nm]) for nm in names}
+    fn = make_sharded_audit_fn(program, names, specs, mesh, k, b.r_pad)
+    with mesh:
+        counts, rows, valid = fn(*(b.arrays[nm] for nm in names))
+    nc = bindings.n_constraints
+    return (np.asarray(counts)[:nc], np.asarray(rows)[:nc],
+            np.asarray(valid)[:nc])

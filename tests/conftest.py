@@ -1,16 +1,20 @@
 """Test configuration.
 
-Tests run JAX on a virtual 8-device CPU mesh (the driver separately
-dry-run-compiles the multi-chip path; real-TPU benchmarking happens via
-bench.py).  Env vars must be set before jax is imported anywhere.
+Tests run JAX on a virtual 8-device CPU mesh; real-TPU benchmarking
+happens via bench.py (which leaves the platform alone) and the driver
+separately dry-run-compiles the multi-chip path via __graft_entry__.
+
+The environment pre-sets JAX_PLATFORMS to the TPU plugin, and the
+plugin re-asserts itself during import, so env vars alone don't stick -
+jax.config.update after import is authoritative.
 """
 
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-prev = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in prev:
-    os.environ["XLA_FLAGS"] = (prev + " --xla_force_host_platform_device_count=8").strip()
-
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
