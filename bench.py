@@ -1,0 +1,189 @@
+"""Benchmark: steady-state audit throughput (constraint-evals/sec).
+
+Workload (BASELINE.md config family): N mixed resources x C constraints
+across three template kinds (K8sRequiredLabels, K8sAllowedRepos,
+K8sContainerLimits), audited with the per-constraint violation cap of
+20 (the reference audit manager's default, pkg/audit/manager.go:35).
+
+- measured engine: the jax driver's device pipeline (lowered programs +
+  match masks + device top-k), steady state (columns/tables cached by
+  generation, executables cached by shape bucket);
+- baseline: the scalar oracle driver (the reference-semantics CPU
+  engine, standing in for OPA's single-threaded topdown audit) on a
+  subsample, extrapolated linearly to N.
+
+Prints ONE JSON line:
+  {"metric": "audit_constraint_evals_per_sec", "value": ...,
+   "unit": "evals/s", "vs_baseline": <speedup x over CPU oracle>}
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from gatekeeper_tpu.client.client import Backend
+from gatekeeper_tpu.client.interface import QueryOpts
+from gatekeeper_tpu.client.local_driver import LocalDriver
+from gatekeeper_tpu.engine.jax_driver import JaxDriver
+from gatekeeper_tpu.target.k8s import K8sValidationTarget, TARGET_NAME
+
+N = int(os.environ.get("GATEKEEPER_BENCH_N", 200_000))
+C_PER_KIND = int(os.environ.get("GATEKEEPER_BENCH_C", 8))
+BASELINE_N = int(os.environ.get("GATEKEEPER_BENCH_BASELINE_N", 2_000))
+CAP = 20
+
+REQUIRED_LABELS = """package k8srequiredlabels
+violation[{"msg": msg, "details": {"missing_labels": missing}}] {
+  provided := {label | input.review.object.metadata.labels[label]}
+  required := {label | label := input.constraint.spec.parameters.labels[_]}
+  missing := required - provided
+  count(missing) > 0
+  msg := sprintf("you must provide labels: %v", [missing])
+}
+"""
+
+ALLOWED_REPOS = """package k8sallowedrepos
+violation[{"msg": msg}] {
+  container := input.review.object.spec.containers[_]
+  satisfied := [good | repo = input.constraint.spec.parameters.repos[_] ; good = startswith(container.image, repo)]
+  not any(satisfied)
+  msg := sprintf("container <%v> has an invalid image repo <%v>", [container.name, container.image])
+}
+"""
+
+CONTAINER_LIMITS = """package k8scontainerlimits
+canonify_cpu(orig) = new { is_number(orig); new := orig * 1000 }
+canonify_cpu(orig) = new {
+  not is_number(orig)
+  endswith(orig, "m")
+  new := to_number(replace(orig, "m", ""))
+}
+canonify_cpu(orig) = new {
+  not is_number(orig)
+  not endswith(orig, "m")
+  re_match("^[0-9]+$", orig)
+  new := to_number(orig) * 1000
+}
+violation[{"msg": msg}] {
+  container := input.review.object.spec.containers[_]
+  cpu_orig := container.resources.limits.cpu
+  cpu := canonify_cpu(cpu_orig)
+  max_cpu := canonify_cpu(input.constraint.spec.parameters.cpu)
+  cpu > max_cpu
+  msg := sprintf("container <%v> cpu limit is too high", [container.name])
+}
+"""
+
+
+def template_doc(kind, rego):
+    return {"apiVersion": "templates.gatekeeper.sh/v1alpha1",
+            "kind": "ConstraintTemplate", "metadata": {"name": kind.lower()},
+            "spec": {"crd": {"spec": {"names": {"kind": kind}}},
+                     "targets": [{"target": TARGET_NAME, "rego": rego}]}}
+
+
+def constraint_doc(kind, name, params):
+    return {"apiVersion": "constraints.gatekeeper.sh/v1alpha1", "kind": kind,
+            "metadata": {"name": name}, "spec": {"parameters": params}}
+
+
+def make_resources(n, rng):
+    label_pool = [f"l{j}" for j in range(10)]
+    repos = ["gcr.io/org/", "docker.io/", "quay.io/team/", "ghcr.io/x/"]
+    out = []
+    for i in range(n):
+        labels = {k: "v" for k in label_pool if rng.random() < 0.35}
+        containers = [{
+            "name": f"c{j}",
+            "image": rng.choice(repos) + f"app{rng.randrange(50)}:{rng.randrange(9)}",
+            "resources": {"limits": {
+                "cpu": rng.choice(["100m", "250m", "1", "2", "4000m"]),
+                "memory": "1Gi"}},
+        } for j in range(rng.randint(1, 3))]
+        out.append({"apiVersion": "v1", "kind": "Pod",
+                    "metadata": {"name": f"pod{i:07d}",
+                                 "namespace": f"ns{i % 50}", "labels": labels},
+                    "spec": {"containers": containers}})
+    return out
+
+
+def setup_client(driver, resources, rng):
+    client = Backend(driver).new_client([K8sValidationTarget()])
+    client.add_template(template_doc("K8sRequiredLabels", REQUIRED_LABELS))
+    client.add_template(template_doc("K8sAllowedRepos", ALLOWED_REPOS))
+    client.add_template(template_doc("K8sContainerLimits", CONTAINER_LIMITS))
+    for j in range(C_PER_KIND):
+        client.add_constraint(constraint_doc(
+            "K8sRequiredLabels", f"labels-{j}",
+            {"labels": rng.sample([f"l{x}" for x in range(10)], k=2)}))
+        client.add_constraint(constraint_doc(
+            "K8sAllowedRepos", f"repos-{j}",
+            {"repos": rng.sample(["gcr.io/", "docker.io/", "quay.io/",
+                                  "ghcr.io/"], k=2)}))
+        client.add_constraint(constraint_doc(
+            "K8sContainerLimits", f"cpu-{j}",
+            {"cpu": rng.choice(["500m", "1", "2"])}))
+    for obj in resources:
+        client.add_data(obj)
+    return client
+
+
+def timed_audit(driver, reps=3):
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        results, _ = driver.query_audit(TARGET_NAME,
+                                        QueryOpts(limit_per_constraint=CAP))
+        dt = time.perf_counter() - t0
+        best = min(best, dt)
+    return best, len(results)
+
+
+def main():
+    rng = random.Random(42)
+    n_constraints = 3 * C_PER_KIND
+    print(f"building workload: {N} resources x {n_constraints} constraints",
+          file=sys.stderr)
+    resources = make_resources(N, rng)
+
+    jd = JaxDriver()
+    t0 = time.perf_counter()
+    setup_client(jd, resources, random.Random(7))
+    print(f"ingest: {time.perf_counter() - t0:.2f}s", file=sys.stderr)
+
+    t0 = time.perf_counter()
+    jd.query_audit(TARGET_NAME, QueryOpts(limit_per_constraint=CAP))
+    print(f"first audit (cold: columns+tables+compile): "
+          f"{time.perf_counter() - t0:.2f}s", file=sys.stderr)
+
+    t_tpu, n_results = timed_audit(jd)
+    evals = N * n_constraints
+    print(f"steady-state audit: {t_tpu * 1e3:.1f}ms, {n_results} capped results",
+          file=sys.stderr)
+
+    # CPU oracle baseline on a subsample, linearly extrapolated
+    ld = LocalDriver()
+    sub = resources[:BASELINE_N]
+    setup_client(ld, sub, random.Random(7))
+    t0 = time.perf_counter()
+    ld.query_audit(TARGET_NAME, QueryOpts())
+    t_cpu_sub = time.perf_counter() - t0
+    t_cpu = t_cpu_sub * (N / max(len(sub), 1))
+    print(f"cpu oracle: {t_cpu_sub:.2f}s for {len(sub)} -> "
+          f"extrapolated {t_cpu:.1f}s for {N}", file=sys.stderr)
+
+    value = evals / t_tpu
+    vs = t_cpu / t_tpu
+    print(json.dumps({"metric": "audit_constraint_evals_per_sec",
+                      "value": round(value, 1), "unit": "evals/s",
+                      "vs_baseline": round(vs, 2)}))
+
+
+if __name__ == "__main__":
+    main()
