@@ -101,6 +101,12 @@ def make_mesh(n_devices: int | None = None) -> Mesh:
     """2-D (c, r) mesh: r gets the larger factor (the long axis)."""
     devices = jax.devices()
     n = n_devices or len(devices)
+    if len(devices) < n:
+        raise ValueError(
+            f"make_mesh needs {n} devices but jax.devices() has only "
+            f"{len(devices)} ({devices[0].platform}); for a virtual mesh "
+            f"set jax_platforms=cpu + jax_num_cpu_devices before any jax "
+            f"use (see __graft_entry__.dryrun_multichip)")
     devices = np.asarray(devices[:n])
     c = 1
     for cand in (2, 4):
