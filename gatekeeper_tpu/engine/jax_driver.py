@@ -53,6 +53,7 @@ class JaxTargetState(TargetState):
         self.con_version: dict[str, int] = {}      # kind -> bump on change
         self.bindings_cache: dict[str, tuple] = {}  # kind -> (gen, ver, b)
         self.mask_cache: dict[str, tuple] = {}
+        self.rank_cache: tuple | None = None       # (generation, rank arr)
         self.match_engine = None
 
     def bump(self, kind: str) -> None:
@@ -146,6 +147,7 @@ class JaxDriver(LocalDriver):
         # both drivers return identical result lists
         ordered_rows = [row for _, row in sorted(st.table.rows_items())]
         row_order = {row: i for i, row in enumerate(ordered_rows)}
+        rank = self._row_rank(st, row_order)
 
         tagged: list[tuple[tuple, Result]] = []
         for kind in sorted(st.templates):
@@ -159,8 +161,8 @@ class JaxDriver(LocalDriver):
                 prog = compiled.vectorized.program
                 if limit is not None:
                     self._format_topk(st, target, handler, compiled, constraints,
-                                      prog, bindings, mask, row_order, kind,
-                                      limit, trace, tagged)
+                                      prog, bindings, mask, rank, row_order,
+                                      kind, limit, trace, tagged)
                 else:
                     cand = self.executor.run(prog, bindings, match=mask)
                     self._format_pairs(st, target, handler, compiled, constraints,
@@ -196,14 +198,32 @@ class JaxDriver(LocalDriver):
                                     (c.get("metadata") or {}).get("name", "")), r))
                 emitted += len(results)
 
+    def _row_rank(self, st: JaxTargetState, row_order: dict) -> np.ndarray:
+        """[n_rows] int32: row -> sorted-cache-key rank.  The device
+        top-k scores by this rank so the capped subset matches the
+        scalar driver's cap order (not raw table row order, which
+        diverges after deletes/re-inserts).  Cached per generation so
+        steady-state sweeps reuse one array instance (device cache)."""
+        gen = st.table.generation
+        if st.rank_cache is not None and st.rank_cache[0] == gen:
+            return st.rank_cache[1]
+        n = st.table.n_rows
+        rank = np.full((n,), n - 1, dtype=np.int32)
+        for row, i in row_order.items():
+            rank[row] = i
+        st.rank_cache = (gen, rank)
+        return rank
+
     def _format_topk(self, st, target, handler, compiled, constraints,
-                     prog, bindings, mask, row_order, kind, limit, trace, tagged):
+                     prog, bindings, mask, rank, row_order, kind, limit,
+                     trace, tagged):
         """Capped audit: device finds the first-k candidate rows per
-        constraint; the host formats only those.  If over-approximated
-        pairs leave the cap under-filled while more candidates exist,
-        fall back to the full mask for that constraint."""
+        constraint (in scalar cap order, via rank); the host formats
+        only those.  If over-approximated pairs leave the cap
+        under-filled while more candidates exist, fall back to the full
+        mask for that constraint."""
         counts, rows, valid = self.executor.run_topk(prog, bindings, limit,
-                                                     match=mask)
+                                                     match=mask, rank=rank)
         full_cand = None
         for ci, c in enumerate(constraints):
             sel = [int(r) for r, v in zip(rows[ci], valid[ci]) if v]
@@ -213,9 +233,11 @@ class JaxDriver(LocalDriver):
                                       row_order, kind, limit, trace, tagged)
             if emitted < limit and int(counts[ci]) > len(sel):
                 if full_cand is None:
-                    full_cand = self.executor.run(prog, bindings, match=mask)
-                rest = sorted((int(r) for r in np.nonzero(full_cand[ci])[0]
-                               if int(r) in row_order and int(r) not in set(sel)),
+                    full_cand = self.executor.run(prog, bindings, match=mask,
+                                                  rank=rank)
+                sel_set = set(sel)
+                rest = sorted((ri for ri in map(int, np.nonzero(full_cand[ci])[0])
+                               if ri in row_order and ri not in sel_set),
                               key=row_order.__getitem__)
                 self._emit_rows(st, target, handler, compiled, c, rest,
                                 row_order, kind, limit - emitted, trace, tagged)

@@ -229,17 +229,41 @@ def _eval_program(program: Program, arrays: dict[str, jax.Array]) -> jax.Array:
     return viol
 
 
-def topk_reduce(viol: jax.Array, k: int):
+def pad_rank(rank: np.ndarray, r_pad: int) -> np.ndarray:
+    """Pad a [n_rows] rank array to [r_pad].  The fill must stay within
+    [live-rank, r_pad) so padded rows can never outscore live ones in
+    the ``r_pad - rank`` top-k score (shared by the single-device and
+    sharded capped paths)."""
+    pr = np.full((r_pad,), r_pad - 1, dtype=np.int32)
+    pr[: rank.shape[0]] = rank
+    return pr
+
+
+def topk_reduce(viol: jax.Array, k: int, rank: jax.Array | None = None):
     """First-k violating resource rows per constraint, on device.
 
     Returns (counts [C] int32, rows [C, k] int32, valid [C, k] bool).
     Implements the audit manager's per-constraint violation cap
     (reference manager.go:35,161-199) as a device reduction so the host
-    never materializes the full mask."""
+    never materializes the full mask.
+
+    `rank` ([r_pad] int32, lower = earlier) orders the capped subset;
+    the driver passes the sorted-cache-key rank so the capped device
+    subset matches the scalar driver's cap order exactly (after
+    deletes/re-inserts, raw row index and cache-key order diverge).
+    Default: raw row order.  k is clamped to r_pad (lax.top_k requires
+    k <= axis size; callers may cap at 20 with fewer padded rows) and
+    the outputs are padded back to width k."""
     c_pad, r_pad = viol.shape
+    k_eff = min(k, r_pad)
     counts = jnp.sum(viol, axis=1, dtype=jnp.int32)
-    score = jnp.where(viol, jnp.arange(r_pad, 0, -1, dtype=jnp.int32)[None, :], 0)
-    vals, rows = jax.lax.top_k(score, k)
+    if rank is None:
+        rank = jnp.arange(r_pad, dtype=jnp.int32)
+    score = jnp.where(viol, r_pad - rank, 0)
+    vals, rows = jax.lax.top_k(score, k_eff)
+    if k_eff < k:
+        vals = jnp.pad(vals, ((0, 0), (0, k - k_eff)))
+        rows = jnp.pad(rows, ((0, 0), (0, k - k_eff)))
     return counts, rows, vals > 0
 
 
@@ -249,22 +273,25 @@ class ProgramExecutor:
     def __init__(self):
         self._cache: dict[tuple, Any] = {}
 
-    def _arrays(self, bindings: Bindings, match: np.ndarray | None):
+    def _arrays(self, bindings: Bindings, match: np.ndarray | None,
+                rank: np.ndarray | None = None):
         """Device-resident view of the bindings, memoized on the
         Bindings instance: steady-state audits (unchanged generation)
         re-run the executable without re-uploading columns."""
         cache = bindings.__dict__.setdefault("_device_cache", {})
-        key = id(match)
+        key = (id(match), id(rank))
         hit = cache.get(key)
-        if hit is not None and hit[0] is match:
-            return hit[1]
+        if hit is not None and hit[0] is match and hit[1] is rank:
+            return hit[2]
         arrays = {k: jax.device_put(v) for k, v in bindings.arrays.items()}
         if match is not None:
             padded = np.zeros((bindings.c_pad, bindings.r_pad), dtype=bool)
             padded[: match.shape[0], : match.shape[1]] = match
             arrays["__match__"] = jax.device_put(padded)
-        cache.clear()  # one live (bindings, match) pairing at a time
-        cache[key] = (match, arrays)
+        if rank is not None:
+            arrays["__rank__"] = jax.device_put(pad_rank(rank, bindings.r_pad))
+        cache.clear()  # one live (bindings, match, rank) triple at a time
+        cache[key] = (match, rank, arrays)
         return arrays
 
     def _compiled(self, program: Program, arrays: dict, topk: int | None):
@@ -279,27 +306,36 @@ class ProgramExecutor:
                     return _eval_program(program, dict(zip(names, args)))
             else:
                 def raw(args: tuple):
-                    viol = _eval_program(program, dict(zip(names, args)))
-                    return topk_reduce(viol, topk)
+                    d = dict(zip(names, args))
+                    viol = _eval_program(program, d)
+                    return topk_reduce(viol, topk, d.get("__rank__"))
             fn = jax.jit(raw)
             self._cache[key] = fn
         return fn, names
 
     def run(self, program: Program, bindings: Bindings,
-            match: np.ndarray | None = None) -> np.ndarray:
+            match: np.ndarray | None = None,
+            rank: np.ndarray | None = None) -> np.ndarray:
         """Evaluate; returns the violation mask trimmed to live shape
-        [n_constraints, n_resources]."""
-        arrays = self._arrays(bindings, match)
+        [n_constraints, n_resources].  `rank` is unused by the full-mask
+        evaluation but participates in the device-array cache key — a
+        caller alternating run_topk/run on the same bindings (the capped
+        audit's under-fill fallback) must pass the same rank instance to
+        keep the single-slot device cache hot."""
+        arrays = self._arrays(bindings, match, rank)
         fn, names = self._compiled(program, arrays, None)
         mask = np.asarray(fn(tuple(arrays[nm] for nm in names)))
         return mask[: bindings.n_constraints, : bindings.n_resources]
 
     def run_topk(self, program: Program, bindings: Bindings, k: int,
-                 match: np.ndarray | None = None):
+                 match: np.ndarray | None = None,
+                 rank: np.ndarray | None = None):
         """Evaluate + device top-k: (counts [C], rows [C, k], valid
         [C, k]) trimmed to the live constraint count.  The full mask
-        never leaves the device."""
-        arrays = self._arrays(bindings, match)
+        never leaves the device.  `rank` (see topk_reduce) orders the
+        capped subset; callers must reuse the same array instance across
+        steady-state sweeps to keep the device cache warm."""
+        arrays = self._arrays(bindings, match, rank)
         fn, names = self._compiled(program, arrays, k)
         counts, rows, valid = fn(tuple(arrays[nm] for nm in names))
         nc = bindings.n_constraints

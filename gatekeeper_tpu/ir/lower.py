@@ -112,6 +112,15 @@ class SCIter(Sym):
 class SNode(Sym):
     nid: int
     kind: str                 # 'bool' | 'num' | 'id_val' | 'id_str'
+    # exact=False marks an over-approximation (an inlined user function
+    # whose clauses have computed head values fires even where the head
+    # would evaluate to `false`; host re-eval filters the false
+    # positives).  Negating an inexact node would flip the
+    # over-approximation into an under-approximation — silently dropped
+    # violations — so _as_conjunct raises CannotLower instead
+    # (soundness contract: anything that could under-approximate must
+    # fall back to the scalar path).
+    exact: bool = True
 
 
 @dataclasses.dataclass(frozen=True)
@@ -314,6 +323,10 @@ class Lowerer:
         self.elem: tuple[str, tuple[str, ...]] | None = None
         self.conjuncts: list[int] = []
         self._inline_depth = 0
+        # set by _inline_function when the subtree being lowered contains
+        # an inexact (over-approximating) inlined call, so exactness
+        # propagates through nested inlining
+        self._subtree_inexact = False
 
     # -- entry ---------------------------------------------------------
 
@@ -577,14 +590,21 @@ class Lowerer:
 
     # -- constraint-side host evaluation -------------------------------
 
-    def _ceval_env(self, constraint_frozen, env_vars: tuple[str, ...]) -> dict | None:
+    def _ceval_env(self, constraint_frozen, env_vars: tuple[str, ...],
+                   env_map: dict[str, Sym]) -> dict | None:
+        """env_map is the *rule-scope env snapshot captured when the
+        closure was created* — never self.env, which at build-bindings
+        time holds whatever rule lowered last (a var name reused across
+        rules would silently resolve to the wrong definition, or crash
+        for names absent from the final rule)."""
         out: dict = {}
         for v in env_vars:
-            sym = self.env.get(v)
+            sym = env_map.get(v)
             if isinstance(sym, SConst):
                 out[v] = freeze(sym.value)
             elif isinstance(sym, SCTerm):
-                val = self._ceval_term(constraint_frozen, sym.term, sym.env_vars)
+                val = self._ceval_term(constraint_frozen, sym.term,
+                                       sym.env_vars, env_map)
                 if val is UNDEFINED:
                     return None
                 out[v] = val
@@ -593,8 +613,8 @@ class Lowerer:
         return out
 
     def _ceval_term(self, constraint_frozen, term: Term,
-                    env_vars: tuple[str, ...]):
-        env = self._ceval_env(constraint_frozen, env_vars)
+                    env_vars: tuple[str, ...], env_map: dict[str, Sym]):
+        env = self._ceval_env(constraint_frozen, env_vars, env_map)
         if env is None:
             return UNDEFINED
         ctx = self.interp._ctx(constraint_frozen, None, None)
@@ -603,8 +623,8 @@ class Lowerer:
         return UNDEFINED
 
     def _ceval_iter(self, constraint_frozen, term: Term,
-                    env_vars: tuple[str, ...]) -> list:
-        env = self._ceval_env(constraint_frozen, env_vars)
+                    env_vars: tuple[str, ...], env_map: dict[str, Sym]) -> list:
+        env = self._ceval_env(constraint_frozen, env_vars, env_map)
         if env is None:
             return []
         ctx = self.interp._ctx(constraint_frozen, None, None)
@@ -616,10 +636,16 @@ class Lowerer:
     def _make_cval(self, sym: SCTerm, kind: str) -> str:
         name = f"cv{next(self.serial)}"
         term, env_vars = sym.term, sym.env_vars
+        env_map = dict(self.env)
 
-        def fn(c, _t=term, _ev=env_vars):
-            v = self._ceval_term(self._cinput(c), _t, _ev)
-            return None if v is UNDEFINED else _thaw_scalar(v)
+        def fn(c, _t=term, _ev=env_vars, _k=kind, _em=env_map):
+            v = self._ceval_term(self._cinput(c), _t, _ev, _em)
+            if v is UNDEFINED:
+                return None
+            # 'val' keeps compounds (frozen) — ir/encode.py interns a
+            # canonical serialization so compound equality stays exact;
+            # num/str/bool kinds are scalar-typed by construction
+            return v if _k == "val" else _thaw_scalar(v)
 
         self.cvals.append(CValReq(name, kind, fn))
         return name
@@ -627,12 +653,13 @@ class Lowerer:
     def _make_cset(self, term: Term, env_vars: tuple[str, ...],
                    iterate: bool, encode: str) -> str:
         name = f"cs{next(self.serial)}"
+        env_map = dict(self.env)
 
-        def fn(c, _t=term, _ev=env_vars, _it=iterate):
+        def fn(c, _t=term, _ev=env_vars, _it=iterate, _em=env_map):
             if _it:
-                vals = self._ceval_iter(self._cinput(c), _t, _ev)
+                vals = self._ceval_iter(self._cinput(c), _t, _ev, _em)
             else:
-                v = self._ceval_term(self._cinput(c), _t, _ev)
+                v = self._ceval_term(self._cinput(c), _t, _ev, _em)
                 if v is UNDEFINED:
                     return None
                 vals = list(v) if isinstance(v, (frozenset, tuple)) else None
@@ -640,7 +667,10 @@ class Lowerer:
                     return None
                 if isinstance(v, frozenset):
                     vals = sorted(vals, key=repr)
-            return [_thaw_scalar(x) for x in vals]
+            # elements stay frozen: prep's encode_value handles scalars
+            # and compounds alike (a compound element must match only
+            # equal compounds, never null)
+            return list(vals)
 
         self.csets.append(CSetReq(name, fn, encode=encode))
         return name
@@ -671,7 +701,10 @@ class Lowerer:
                         return True
                 return None
             for v, _ in interp._eval_term(ctx, _t, env):
-                return _thaw_scalar(v)
+                # frozen pass-through: prep type-checks per `out` ('num'
+                # wants numbers, 'id_str' strings, 'id_val' any value —
+                # compounds included via the canonical encoding)
+                return v
             return None
 
         self.tables.append(TableReq(tname, src, fn, out=out, src_val=True))
@@ -684,10 +717,11 @@ class Lowerer:
         src = self._leaf_col_name(leaf, "val")
         tname = f"pt{next(self.serial)}"
         interp = self.interp
+        env_map = dict(self.env)
 
-        def cparams(c, _t=iter_term, _ev=iter_env):
+        def cparams(c, _t=iter_term, _ev=iter_env, _em=env_map):
             return [_thaw_scalar(v) for v in
-                    self._ceval_iter(self._cinput(c), _t, _ev)]
+                    self._ceval_iter(self._cinput(c), _t, _ev, _em)]
 
         def fn(value, param, _t=pred_term, _pv=pvar):
             env = {"__leaf0__": freeze(value), _pv: freeze(param)}
@@ -777,6 +811,18 @@ class Lowerer:
         if isinstance(sym, SLeaf):
             nid = self._emit_leaf(sym.leaf, "truthy")
         elif isinstance(sym, SNode):
+            if negated and not sym.exact:
+                raise CannotLower(
+                    "negation of an over-approximating inlined function "
+                    "(clauses with computed head values)")
+            if not sym.exact:
+                # positive use keeps the over-approximation (host re-eval
+                # filters), but any enclosing inlined function is now
+                # over-approximating too — without this, an inexact node
+                # laundered through an env var into a wrapper function
+                # (x := f(...); g uses x) would mark g exact and let
+                # `not g(x)` under-approximate
+                self._subtree_inexact = True
             nid = sym.nid
         elif isinstance(sym, SLeafExpr):
             nid = self._table_node(sym, "bool")
@@ -846,9 +892,10 @@ class Lowerer:
         failing this rule's condition)."""
         name = f"cb{next(self.serial)}"
         interp = self.interp
+        env_map = dict(self.env)
 
-        def fn(c, _lit=lit, _ev=env_vars):
-            env = self._ceval_env(self._cinput(c), _ev)
+        def fn(c, _lit=lit, _ev=env_vars, _em=env_map):
+            env = self._ceval_env(self._cinput(c), _ev, _em)
             if env is None:
                 # an earlier constraint-only assignment was undefined: the
                 # rule cannot fire for this constraint
@@ -992,7 +1039,9 @@ class Lowerer:
         for v in list(d.env_vars):
             d.merge(self._sym_deps(self.env[v]))
         if d.const_only:
-            v = self._ceval_term(freeze({}), term, tuple(sorted(d.env_vars)))
+            # lower-time evaluation: the current rule env is the right scope
+            v = self._ceval_term(freeze({}), term, tuple(sorted(d.env_vars)),
+                                 self.env)
             if v is UNDEFINED:
                 raise _RuleNeverFires()
             sv = _thaw_scalar(v)
@@ -1126,6 +1175,8 @@ class Lowerer:
         if not rules:
             raise CannotLower(f"no matching clauses for {fname}")
         self._inline_depth += 1
+        outer_inexact = self._subtree_inexact
+        self._subtree_inexact = False
         try:
             clause_nodes: list[int] = []
             for rule in rules:
@@ -1147,12 +1198,21 @@ class Lowerer:
                     clause_nodes.append(nid)
             if not clause_nodes:
                 raise _RuleNeverFires()
+            # a clause with a computed head value fires even where the
+            # head would be `false` — over-approximation
+            own_inexact = any(
+                r.value is not None
+                and not (isinstance(r.value, Scalar) and r.value.value is True)
+                for r in rules)
+            inexact = own_inexact or self._subtree_inexact
+            self._subtree_inexact = inexact   # propagate to enclosing inline
             out = clause_nodes[0]
             for nid in clause_nodes[1:]:
                 out = self._emit("or", (out, nid))
-            return SNode(out, "bool")
+            return SNode(out, "bool", exact=not inexact)
         finally:
             self._inline_depth -= 1
+            self._subtree_inexact = outer_inexact or self._subtree_inexact
 
     def _inline_clause(self, rule: Rule, mapping: dict,
                        guards: list[tuple[Term, Term]]) -> int | None:

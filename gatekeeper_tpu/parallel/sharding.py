@@ -32,19 +32,21 @@ import numpy as np
 from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
-from gatekeeper_tpu.engine.veval import _eval_program, topk_reduce
+from gatekeeper_tpu.engine.veval import _eval_program, pad_rank, topk_reduce
 from gatekeeper_tpu.ir.prep import Bindings
 from gatekeeper_tpu.ir.program import Program
 
 
 def binding_spec(name: str, arr: np.ndarray) -> P:
-    """PartitionSpec for one bound array, by naming convention
-    (ir/prep.py): resources shard on 'r', constraints on 'c', lookup
-    tables replicate."""
+    """PartitionSpec for one bound array, by the prep naming convention
+    (ir/prep.py emits every kind listed here): resources shard on 'r',
+    constraints on 'c', lookup tables replicate.  Unknown names raise —
+    a new binding kind silently replicated would broadcast-crash (or
+    worse, silently mis-shard) inside shard_map."""
     base = name.split(".")[0]
     if name == "__match__":
         return P("c", "r")
-    if name == "__alive__":
+    if name in ("__alive__", "__rank__"):
         return P("r")
     if name == "__cvalid__":
         return P("c")
@@ -57,14 +59,17 @@ def binding_spec(name: str, arr: np.ndarray) -> P:
     if base.startswith("cs") and base[2:].isdigit():
         return P("c", None)                      # cset [C, K]
     if base.startswith("cv") and base[2:].isdigit():
-        return P("c")                            # cval [C]
+        return P("c")                            # cval [C] (.v/.p too)
+    if base.startswith("cb") and base[2:].isdigit():
+        return P("c")                            # per-constraint bool [C]
     if base.startswith("pt") and base[2:].isdigit():
         if name.endswith(".idx") or name.endswith(".valid"):
             return P("c", None)                  # param index sets [C, K]
         return P(None, None)                     # ptable [P, T] replicated
     if base.startswith("t") and base[1:].isdigit():
         return P(None)                           # unary table [T]
-    return P(*([None] * arr.ndim))
+    raise ValueError(f"binding_spec: unrecognized binding {name!r} "
+                     f"(shape {arr.shape}); add its sharding rule here")
 
 
 def pad_bindings_for_mesh(bindings: Bindings, c_shards: int,
@@ -124,6 +129,7 @@ def make_sharded_audit_fn(program: Program, names: tuple[str, ...],
     r, sharded over c."""
     r_shards = mesh.shape["r"]
     r_local = r_pad // r_shards
+    k_local = min(k, r_local)     # lax.top_k needs k <= axis size
 
     def local_step(*args):
         arrays = dict(zip(names, args))
@@ -131,15 +137,25 @@ def make_sharded_audit_fn(program: Program, names: tuple[str, ...],
         counts = jax.lax.psum(jnp.sum(viol, axis=1, dtype=jnp.int32), "r")
         # local first-k, re-ranked globally after an all_gather over r
         base = jax.lax.axis_index("r") * r_local
-        score = jnp.where(viol,
-                          (r_pad - base) - jnp.arange(r_local, dtype=jnp.int32)[None, :],
-                          0)
-        vals, rows_local = jax.lax.top_k(score, k)
+        rank_local = arrays.get("__rank__")
+        if rank_local is not None:
+            # caller-supplied global order (sorted-cache-key rank from
+            # the driver) — matches the single-device capped subset
+            score = jnp.where(viol, r_pad - rank_local[None, :], 0)
+        else:
+            score = jnp.where(viol,
+                              (r_pad - base) - jnp.arange(r_local, dtype=jnp.int32)[None, :],
+                              0)
+        vals, rows_local = jax.lax.top_k(score, k_local)
         rows_global = rows_local + base
-        g_vals = jax.lax.all_gather(vals, "r", axis=1, tiled=True)        # [C, r*k]
+        g_vals = jax.lax.all_gather(vals, "r", axis=1, tiled=True)        # [C, r*k_local]
         g_rows = jax.lax.all_gather(rows_global, "r", axis=1, tiled=True)
-        top_vals, top_idx = jax.lax.top_k(g_vals, k)
+        k_final = min(k, g_vals.shape[1])
+        top_vals, top_idx = jax.lax.top_k(g_vals, k_final)
         rows = jnp.take_along_axis(g_rows, top_idx, axis=1)
+        if k_final < k:
+            top_vals = jnp.pad(top_vals, ((0, 0), (0, k - k_final)))
+            rows = jnp.pad(rows, ((0, 0), (0, k - k_final)))
         return counts, rows, top_vals > 0
 
     in_specs = tuple(specs[nm] for nm in names)
@@ -150,8 +166,17 @@ def make_sharded_audit_fn(program: Program, names: tuple[str, ...],
 
 
 def run_sharded_audit(program: Program, bindings: Bindings, mesh: Mesh,
-                      k: int = 20):
-    """Convenience wrapper: pad, shard, run one audit step."""
+                      k: int = 20, rank: np.ndarray | None = None):
+    """Convenience wrapper: pad, shard, run one audit step.  `rank`
+    ([n_rows] int32, see veval.topk_reduce) orders the capped subset to
+    match the scalar driver; default is raw row order."""
+    if rank is not None:
+        arrays = dict(bindings.arrays)
+        arrays["__rank__"] = pad_rank(rank, bindings.r_pad)
+        bindings = Bindings(arrays=arrays, n_constraints=bindings.n_constraints,
+                            n_resources=bindings.n_resources,
+                            c_pad=bindings.c_pad, r_pad=bindings.r_pad,
+                            e_pads=bindings.e_pads)
     b = pad_bindings_for_mesh(bindings, mesh.shape["c"], mesh.shape["r"])
     names = tuple(sorted(b.arrays))
     specs = {nm: binding_spec(nm, b.arrays[nm]) for nm in names}
