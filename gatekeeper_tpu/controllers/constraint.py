@@ -1,0 +1,72 @@
+"""Per-constraint-kind reconciler.
+
+Reference: pkg/controller/constraint/constraint_controller.go:97-158.
+Instantiated per constraint kind as the template controller registrar's
+addFn (constrainttemplate_controller.go:76-79): finalizer, clear
+``status.byPod[].errors``, AddConstraint into the engine, set
+``status.byPod[].enforced``; deletion removes the constraint and strips
+the finalizer.
+"""
+
+from __future__ import annotations
+
+from gatekeeper_tpu.api.config import GVK
+from gatekeeper_tpu.client.client import Client
+from gatekeeper_tpu.cluster.fake import FakeCluster
+from gatekeeper_tpu.controllers.runtime import (DONE, REQUEUE, ReconcileResult,
+                                                Reconciler, Request)
+from gatekeeper_tpu.errors import ApiConflictError, ClientError, NotFoundError
+from gatekeeper_tpu.utils.ha_status import get_ha_status, set_ha_status
+
+FINALIZER = "finalizers.gatekeeper.sh/constraint"
+
+
+class ReconcileConstraint(Reconciler):
+    def __init__(self, cluster: FakeCluster, client: Client, gvk: GVK):
+        self.cluster = cluster
+        self.client = client
+        self.gvk = gvk
+        self.name = f"constraint-controller[{gvk.kind}]"
+
+    def reconcile(self, request: Request) -> ReconcileResult:
+        instance = self.cluster.try_get(self.gvk, request.name,
+                                        request.namespace)
+        if instance is None:
+            return DONE
+        meta = instance.setdefault("metadata", {})
+        if not meta.get("deletionTimestamp"):
+            if FINALIZER not in (meta.get("finalizers") or []):
+                meta.setdefault("finalizers", []).append(FINALIZER)
+                result = self._update(instance)
+                if result.requeue:
+                    return result
+            status = get_ha_status(instance)
+            status.pop("errors", None)
+            set_ha_status(instance, status)
+            try:
+                self.client.add_constraint(instance)
+            except ClientError as err:
+                status.setdefault("errors", []).append(
+                    {"code": "add_error", "message": str(err)})
+                set_ha_status(instance, status)
+                self._update(instance)
+                return DONE
+            status["enforced"] = True
+            set_ha_status(instance, status)
+            return self._update(instance)
+        # deletion (:139-152)
+        if FINALIZER in (meta.get("finalizers") or []):
+            self.client.remove_constraint(instance)
+            meta["finalizers"] = [f for f in meta.get("finalizers") or []
+                                  if f != FINALIZER]
+            return self._update(instance)
+        return DONE
+
+    def _update(self, instance: dict) -> ReconcileResult:
+        try:
+            self.cluster.update(instance)
+        except ApiConflictError:
+            return REQUEUE
+        except NotFoundError:
+            pass
+        return DONE

@@ -1,0 +1,161 @@
+"""ConstraintTemplate reconciler.
+
+Reference: pkg/controller/constrainttemplate/constrainttemplate_controller.go:124-331.
+Lifecycle: validate + build the constraint-kind CRD (CreateCRD), record
+parse errors in ``status.byPod[].errors``, load the template into the
+engine (AddTemplate), register the constraint kind with the watch
+registrar, create/update the CRD object in-cluster, and on delete tear
+all of that down behind a finalizer with requeue-based deadlock
+recovery.
+"""
+
+from __future__ import annotations
+
+from gatekeeper_tpu.api.config import GVK
+from gatekeeper_tpu.client.client import Client
+from gatekeeper_tpu.cluster.fake import FakeCluster
+from gatekeeper_tpu.controllers.runtime import (DONE, REQUEUE, ReconcileResult,
+                                                Reconciler, Request)
+from gatekeeper_tpu.errors import (AlreadyExistsError, ApiConflictError,
+                                   ClientError, NotFoundError, RegoError)
+from gatekeeper_tpu.utils.ha_status import get_ha_status, set_ha_status
+from gatekeeper_tpu.watch.manager import Registrar
+
+TEMPLATE_GVK = GVK("templates.gatekeeper.sh", "v1alpha1", "ConstraintTemplate")
+CRD_GVK = GVK("apiextensions.k8s.io", "v1beta1", "CustomResourceDefinition")
+FINALIZER = "constrainttemplate.finalizers.gatekeeper.sh"
+
+
+def make_constraint_gvk(kind: str) -> GVK:
+    """makeGvk (:306-312): constraints are always
+    constraints.gatekeeper.sh/v1alpha1/<Kind>."""
+    return GVK("constraints.gatekeeper.sh", "v1alpha1", kind)
+
+
+def _template_kind(instance: dict) -> str:
+    spec = instance.get("spec") or {}
+    names = (((spec.get("crd") or {}).get("spec") or {}).get("names") or {})
+    return names.get("kind", "")
+
+
+class ReconcileConstraintTemplate(Reconciler):
+    name = "constrainttemplate-controller"
+
+    def __init__(self, cluster: FakeCluster, client: Client,
+                 watcher: Registrar):
+        self.cluster = cluster
+        self.client = client
+        self.watcher = watcher
+
+    def reconcile(self, request: Request) -> ReconcileResult:
+        instance = self.cluster.try_get(TEMPLATE_GVK, request.name)
+        if instance is None:
+            return DONE
+
+        status = get_ha_status(instance)
+        status.pop("errors", None)
+        try:
+            crd = self.client.create_crd(instance)
+        except (RegoError, ClientError) as err:
+            # parse/validation errors land in status.byPod[].errors
+            # (:143-158) and the template is otherwise left alone
+            entry = {"code": getattr(err, "code", "create_error"),
+                     "message": getattr(err, "message", str(err))}
+            loc = getattr(err, "location", None)
+            if loc is not None:
+                entry["location"] = str(loc)
+            status.setdefault("errors", []).append(entry)
+            set_ha_status(instance, status)
+            return self._update(instance, requeue_on_conflict=True)
+        set_ha_status(instance, status)
+
+        if not (instance.get("metadata") or {}).get("deletionTimestamp"):
+            crd_name = (crd.get("metadata") or {}).get("name", "")
+            found = self.cluster.try_get(CRD_GVK, crd_name)
+            if found is None:
+                return self._handle_create(instance, crd)
+            return self._handle_update(instance, crd, found)
+        return self._handle_delete(instance, crd)
+
+    # ------------------------------------------------------------------
+
+    def _handle_create(self, instance: dict, crd: dict) -> ReconcileResult:
+        """:184-230 handleCreate."""
+        meta = instance.setdefault("metadata", {})
+        if FINALIZER not in (meta.get("finalizers") or []):
+            meta.setdefault("finalizers", []).append(FINALIZER)
+            result = self._update(instance, requeue_on_conflict=True)
+            if result.requeue:
+                return result
+        if not self._add_template(instance):
+            return DONE
+        self.watcher.add_watch(make_constraint_gvk(_template_kind(instance)))
+        try:
+            self.cluster.create(crd)
+        except AlreadyExistsError:
+            pass  # another replica won the create race (HA note at :210)
+        instance.setdefault("status", {})["created"] = True
+        return self._update(instance, requeue_on_conflict=True)
+
+    def _handle_update(self, instance: dict, crd: dict,
+                       found: dict) -> ReconcileResult:
+        """:233-266 handleUpdate: engine reload is unconditional (the
+        engine may have restarted and needs code re-loaded)."""
+        if not self._add_template(instance):
+            return DONE
+        self.watcher.add_watch(make_constraint_gvk(_template_kind(instance)))
+        if crd.get("spec") != found.get("spec"):
+            found["spec"] = crd["spec"]
+            try:
+                self.cluster.update(found)
+            except ApiConflictError:
+                return REQUEUE
+        instance.setdefault("status", {})["created"] = True
+        return self._update(instance, requeue_on_conflict=True)
+
+    def _handle_delete(self, instance: dict, crd: dict) -> ReconcileResult:
+        """:269-304 handleDelete: CRD delete → wait for it to vanish
+        (re-adding the watch first recovers an offline finalizer
+        deadlock) → remove watch → remove template → drop finalizer."""
+        meta = instance.setdefault("metadata", {})
+        if FINALIZER not in (meta.get("finalizers") or []):
+            return DONE
+        crd_name = (crd.get("metadata") or {}).get("name", "")
+        try:
+            self.cluster.delete(CRD_GVK, crd_name)
+        except NotFoundError:
+            pass
+        if self.cluster.try_get(CRD_GVK, crd_name) is not None:
+            self.watcher.add_watch(make_constraint_gvk(_template_kind(instance)))
+            return REQUEUE
+        self.watcher.remove_watch(make_constraint_gvk(_template_kind(instance)))
+        self.client.remove_template(instance)
+        meta["finalizers"] = [f for f in meta.get("finalizers") or []
+                              if f != FINALIZER]
+        return self._update(instance, requeue_on_conflict=True)
+
+    # ------------------------------------------------------------------
+
+    def _add_template(self, instance: dict) -> bool:
+        """AddTemplate with update_error status reporting (:198-205)."""
+        try:
+            self.client.add_template(instance)
+            return True
+        except (RegoError, ClientError) as err:
+            status = get_ha_status(instance)
+            status.setdefault("errors", []).append(
+                {"code": "update_error",
+                 "message": f"Could not update CRD: {err}"})
+            set_ha_status(instance, status)
+            self._update(instance, requeue_on_conflict=False)
+            return False
+
+    def _update(self, instance: dict,
+                requeue_on_conflict: bool) -> ReconcileResult:
+        try:
+            self.cluster.update(instance)
+        except ApiConflictError:
+            return REQUEUE if requeue_on_conflict else DONE
+        except NotFoundError:
+            return DONE
+        return DONE

@@ -1,0 +1,66 @@
+"""Per-synced-GVK data-ingest reconciler.
+
+Reference: pkg/controller/sync/sync_controller.go:99-176.  Instantiated
+per synced GVK as the config controller registrar's addFn
+(config_controller.go:83-86).  Upsert: add the sync finalizer then
+AddData; delete: RemoveData then strip the finalizer.  This is the
+resource-cache ingest path feeding the engine's columnar store.
+"""
+
+from __future__ import annotations
+
+from gatekeeper_tpu.api.config import GVK
+from gatekeeper_tpu.client.client import Client
+from gatekeeper_tpu.cluster.fake import FakeCluster, gvk_of
+from gatekeeper_tpu.controllers.runtime import (DONE, REQUEUE, ReconcileResult,
+                                                Reconciler, Request)
+from gatekeeper_tpu.errors import ApiConflictError, NotFoundError
+
+FINALIZER = "finalizers.gatekeeper.sh/sync"
+
+
+def has_finalizer(obj: dict) -> bool:
+    return FINALIZER in ((obj.get("metadata") or {}).get("finalizers") or [])
+
+
+def remove_finalizer(cluster: FakeCluster, obj: dict) -> None:
+    meta = obj.setdefault("metadata", {})
+    meta["finalizers"] = [f for f in meta.get("finalizers") or []
+                          if f != FINALIZER]
+    cluster.update(obj)
+
+
+class ReconcileSync(Reconciler):
+    def __init__(self, cluster: FakeCluster, client: Client, gvk: GVK):
+        self.cluster = cluster
+        self.client = client
+        self.gvk = gvk
+        self.name = f"sync-controller[{gvk.kind}]"
+
+    def reconcile(self, request: Request) -> ReconcileResult:
+        instance = self.cluster.try_get(self.gvk, request.name,
+                                        request.namespace)
+        if instance is None:
+            return DONE
+        if gvk_of(instance) != self.gvk:
+            return DONE  # unexpected data (:113-116)
+        meta = instance.setdefault("metadata", {})
+        if not meta.get("deletionTimestamp"):
+            if FINALIZER not in (meta.get("finalizers") or []):
+                meta.setdefault("finalizers", []).append(FINALIZER)
+                try:
+                    self.cluster.update(instance)
+                except ApiConflictError:
+                    return REQUEUE
+                except NotFoundError:
+                    return DONE
+            self.client.add_data(instance)
+        elif has_finalizer(instance):
+            self.client.remove_data(instance)
+            try:
+                remove_finalizer(self.cluster, instance)
+            except ApiConflictError:
+                return REQUEUE
+            except NotFoundError:
+                pass
+        return DONE

@@ -71,3 +71,20 @@ class StorageError(GatekeeperError):
 
 class ClientError(GatekeeperError):
     """Constraint-framework client errors (bad template/constraint, etc.)."""
+
+
+class ApiError(GatekeeperError):
+    """Cluster API errors (the k8s apierrors analogue)."""
+
+
+class NotFoundError(ApiError):
+    """Object does not exist (apierrors.IsNotFound)."""
+
+
+class AlreadyExistsError(ApiError):
+    """Create of an existing object (apierrors.IsAlreadyExists)."""
+
+
+class ApiConflictError(ApiError):
+    """Optimistic-concurrency conflict on update (apierrors.IsConflict) —
+    drives the controllers' Requeue paths."""

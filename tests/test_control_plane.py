@@ -1,0 +1,383 @@
+"""Control-plane tests: fake cluster, HA status, watch manager, and the
+controllers driving the SURVEY §7 minimum end-to-end slice.
+
+Models the reference's test strategy: fake-interface unit tests for the
+watch manager (manager_test.go:89-134) and envtest-style integration
+through real reconcilers (constrainttemplate_controller_test.go:56-252),
+with the in-memory cluster standing in for etcd+apiserver.
+"""
+
+import pytest
+
+from gatekeeper_tpu.api.config import GVK, empty_config_object
+from gatekeeper_tpu.client.client import Backend
+from gatekeeper_tpu.client.local_driver import LocalDriver
+from gatekeeper_tpu.cluster.fake import ADDED, DELETED, MODIFIED, FakeCluster
+from gatekeeper_tpu.controllers.config import CONFIG_GVK
+from gatekeeper_tpu.controllers.constrainttemplate import (CRD_GVK,
+                                                           TEMPLATE_GVK)
+from gatekeeper_tpu.controllers.registry import add_to_manager
+from gatekeeper_tpu.controllers.runtime import ControllerManager
+from gatekeeper_tpu.engine.jax_driver import JaxDriver
+from gatekeeper_tpu.errors import (AlreadyExistsError, ApiConflictError,
+                                   NotFoundError)
+from gatekeeper_tpu.target.k8s import K8sValidationTarget
+from gatekeeper_tpu.utils.ha_status import get_ha_status, set_ha_status
+from gatekeeper_tpu.watch.manager import WatchManager
+
+NS_GVK = GVK("", "v1", "Namespace")
+
+REQUIRED_LABELS_REGO = """package k8srequiredlabels
+violation[{"msg": msg, "details": {"missing_labels": missing}}] {
+  provided := {label | input.review.object.metadata.labels[label]}
+  required := {label | label := input.constraint.spec.parameters.labels[_]}
+  missing := required - provided
+  count(missing) > 0
+  msg := sprintf("you must provide labels: %v", [missing])
+}
+"""
+
+
+def ns_obj(name, labels=None):
+    obj = {"apiVersion": "v1", "kind": "Namespace",
+           "metadata": {"name": name}}
+    if labels:
+        obj["metadata"]["labels"] = labels
+    return obj
+
+
+def template_obj(kind="K8sRequiredLabels", rego=REQUIRED_LABELS_REGO):
+    return {
+        "apiVersion": "templates.gatekeeper.sh/v1alpha1",
+        "kind": "ConstraintTemplate",
+        "metadata": {"name": kind.lower()},
+        "spec": {
+            "crd": {"spec": {
+                "names": {"kind": kind},
+                "validation": {"openAPIV3Schema": {"properties": {
+                    "labels": {"type": "array",
+                               "items": {"type": "string"}}}}},
+            }},
+            "targets": [{"target": "admission.k8s.gatekeeper.sh",
+                         "rego": rego}],
+        },
+    }
+
+
+def constraint_obj(kind="K8sRequiredLabels", name="ns-must-have-gk",
+                   labels=("gatekeeper",)):
+    return {
+        "apiVersion": "constraints.gatekeeper.sh/v1alpha1",
+        "kind": kind,
+        "metadata": {"name": name},
+        "spec": {"match": {"kinds": [{"apiGroups": [""],
+                                      "kinds": ["Namespace"]}]},
+                 "parameters": {"labels": list(labels)}},
+    }
+
+
+# ---------------------------------------------------------------------------
+# fake cluster semantics
+
+
+class TestFakeCluster:
+    def test_crud_roundtrip(self):
+        c = FakeCluster()
+        c.create(ns_obj("a", {"x": "1"}))
+        got = c.get(NS_GVK, "a")
+        assert got["metadata"]["labels"] == {"x": "1"}
+        assert got["metadata"]["resourceVersion"]
+        with pytest.raises(AlreadyExistsError):
+            c.create(ns_obj("a"))
+        with pytest.raises(NotFoundError):
+            c.get(NS_GVK, "zzz")
+
+    def test_update_conflict_and_noop(self):
+        c = FakeCluster()
+        created = c.create(ns_obj("a"))
+        stale = dict(created)
+        fresh = c.update({**created, "metadata": {
+            **created["metadata"], "labels": {"k": "v"}}})
+        assert fresh["metadata"]["resourceVersion"] != \
+            created["metadata"]["resourceVersion"]
+        with pytest.raises(ApiConflictError):
+            c.update({**stale, "metadata": {**stale["metadata"],
+                                            "labels": {"other": "w"}}})
+        # no-op update: same content -> same resourceVersion, no event
+        events = []
+        c.watch(NS_GVK, events.append)
+        again = c.update(fresh)
+        assert again["metadata"]["resourceVersion"] == \
+            fresh["metadata"]["resourceVersion"]
+        assert events == []
+
+    def test_finalizer_semantics(self):
+        c = FakeCluster()
+        obj = ns_obj("a")
+        obj["metadata"]["finalizers"] = ["f1"]
+        c.create(obj)
+        events = []
+        c.watch(NS_GVK, events.append)
+        c.delete(NS_GVK, "a")
+        # finalizer present -> terminating, not deleted
+        got = c.get(NS_GVK, "a")
+        assert got["metadata"]["deletionTimestamp"]
+        assert events[-1].type == MODIFIED
+        # stripping the last finalizer removes the object
+        got["metadata"]["finalizers"] = []
+        c.update(got)
+        assert events[-1].type == DELETED
+        with pytest.raises(NotFoundError):
+            c.get(NS_GVK, "a")
+
+    def test_crd_registers_discovery(self):
+        c = FakeCluster()
+        with pytest.raises(NotFoundError):
+            c.server_resources_for_group_version("constraints.gatekeeper.sh/v1alpha1")
+        crd = {"apiVersion": "apiextensions.k8s.io/v1beta1",
+               "kind": "CustomResourceDefinition",
+               "metadata": {"name": "k8srequiredlabels.constraints.gatekeeper.sh"},
+               "spec": {"group": "constraints.gatekeeper.sh",
+                        "version": "v1alpha1",
+                        "names": {"kind": "K8sRequiredLabels",
+                                  "plural": "k8srequiredlabels"}}}
+        c.create(crd)
+        kinds = c.server_resources_for_group_version("constraints.gatekeeper.sh/v1alpha1")
+        assert kinds == [{"kind": "K8sRequiredLabels",
+                          "name": "k8srequiredlabels"}]
+        assert c.kind_served(GVK("constraints.gatekeeper.sh", "v1alpha1",
+                                 "K8sRequiredLabels"))
+        c.delete(CRD_GVK, "k8srequiredlabels.constraints.gatekeeper.sh")
+        with pytest.raises(NotFoundError):
+            c.server_resources_for_group_version("constraints.gatekeeper.sh/v1alpha1")
+
+    def test_watch_events(self):
+        c = FakeCluster()
+        events = []
+        unsub = c.watch(NS_GVK, events.append)
+        c.create(ns_obj("a"))
+        assert [e.type for e in events] == [ADDED]
+        unsub()
+        c.create(ns_obj("b"))
+        assert len(events) == 1
+
+
+# ---------------------------------------------------------------------------
+# ha_status
+
+
+class TestHAStatus:
+    def test_roundtrip_two_pods(self):
+        obj = {}
+        s1 = get_ha_status(obj, "pod-1")
+        assert s1 == {"id": "pod-1"}
+        s1["enforced"] = True
+        set_ha_status(obj, s1, "pod-1")
+        set_ha_status(obj, {"enforced": False}, "pod-2")
+        assert get_ha_status(obj, "pod-1")["enforced"] is True
+        assert get_ha_status(obj, "pod-2")["enforced"] is False
+        # replace keeps one slot per pod
+        set_ha_status(obj, {"enforced": False}, "pod-1")
+        by_pod = obj["status"]["byPod"]
+        assert [s["id"] for s in by_pod] == ["pod-1", "pod-2"]
+        assert get_ha_status(obj, "pod-1")["enforced"] is False
+
+
+# ---------------------------------------------------------------------------
+# watch manager
+
+
+def make_client(driver=None):
+    driver = driver or LocalDriver()
+    return Backend(driver).new_client([K8sValidationTarget()])
+
+
+class TestWatchManager:
+    def test_roster_and_pending(self):
+        cluster = FakeCluster()
+        mgr = ControllerManager(cluster)
+        wm = WatchManager(cluster, mgr)
+        seen = []
+
+        class Rec:
+            name = "rec"
+
+            def __init__(self, gvk):
+                self.gvk = gvk
+
+            def reconcile(self, request):
+                seen.append((self.gvk.kind, request.name))
+                from gatekeeper_tpu.controllers.runtime import DONE
+                return DONE
+
+        reg = wm.new_registrar("r", Rec)
+        gvk = GVK("g.example.com", "v1", "Widget")
+        reg.add_watch(gvk)
+        # not served by discovery yet -> pending
+        assert wm.pending_gvks() == {gvk}
+        assert wm.watched_gvks() == set()
+        cluster.register_kind(gvk, "widgets")
+        cluster.create({"apiVersion": "g.example.com/v1", "kind": "Widget",
+                        "metadata": {"name": "w1"}})
+        wm.poll_once()
+        assert wm.watched_gvks() == {gvk}
+        mgr.run_until_idle()
+        assert ("Widget", "w1") in seen  # initial list replayed
+
+        # replace to empty roster stops the watch
+        reg.replace_watch([])
+        assert wm.watched_gvks() == set()
+
+    def test_pause_unpause_resyncs(self):
+        cluster = FakeCluster()
+        mgr = ControllerManager(cluster)
+        wm = WatchManager(cluster, mgr)
+        hits = []
+
+        class Rec:
+            name = "rec"
+
+            def __init__(self, gvk):
+                pass
+
+            def reconcile(self, request):
+                hits.append(request.name)
+                from gatekeeper_tpu.controllers.runtime import DONE
+                return DONE
+
+        reg = wm.new_registrar("r", Rec)
+        gvk = GVK("", "v1", "Namespace")
+        cluster.register_kind(gvk, "namespaces")
+        cluster.create(ns_obj("a"))
+        reg.add_watch(gvk)
+        mgr.run_until_idle()
+        assert hits == ["a"]
+        wm.pause()
+        cluster.create(ns_obj("b"))  # dropped while paused
+        mgr.run_until_idle()
+        assert hits == ["a"]
+        wm.unpause()
+        mgr.run_until_idle()
+        assert sorted(hits[1:]) == ["a", "b"]  # resync re-lists everything
+
+
+# ---------------------------------------------------------------------------
+# controllers: the minimum end-to-end slice
+
+
+@pytest.fixture(params=["local", "jax"])
+def plane(request):
+    cluster = FakeCluster()
+    cluster.register_kind(TEMPLATE_GVK, "constrainttemplates")
+    cluster.register_kind(CONFIG_GVK, "configs")
+    cluster.register_kind(NS_GVK, "namespaces")
+    driver = LocalDriver() if request.param == "local" else JaxDriver()
+    client = make_client(driver)
+    return add_to_manager(cluster, client)
+
+
+class TestControllersEndToEnd:
+    def test_minimum_slice(self, plane):
+        cluster, client = plane.cluster, plane.client
+
+        # 1. Config with syncOnly [v1/Namespace] -> sync watch ingests
+        cfg = empty_config_object()
+        cfg["spec"] = {"sync": {"syncOnly": [
+            {"group": "", "version": "v1", "kind": "Namespace"}]}}
+        cluster.create(cfg)
+        for i in range(10):
+            labels = {"gatekeeper": "on"} if i % 2 else None
+            cluster.create(ns_obj(f"ns{i}", labels))
+        plane.run_until_idle()
+
+        # namespaces got the sync finalizer and are cached in the engine
+        ns0 = cluster.get(NS_GVK, "ns0")
+        assert "finalizers.gatekeeper.sh/sync" in ns0["metadata"]["finalizers"]
+
+        # 2. template -> engine + constraint CRD + discovery + watch
+        cluster.create(template_obj())
+        plane.run_until_idle()
+        tmpl = cluster.get(TEMPLATE_GVK, "k8srequiredlabels")
+        assert tmpl["status"]["created"] is True
+        crd = cluster.get(CRD_GVK, "k8srequiredlabels.constraints.gatekeeper.sh")
+        assert crd["spec"]["names"]["kind"] == "K8sRequiredLabels"
+        con_gvk = GVK("constraints.gatekeeper.sh", "v1alpha1",
+                      "K8sRequiredLabels")
+        assert con_gvk in plane.watch_manager.watched_gvks()
+
+        # 3. constraint -> engine, status enforced
+        cluster.create(constraint_obj())
+        plane.run_until_idle()
+        con = cluster.get(con_gvk, "ns-must-have-gk")
+        st = get_ha_status(con)
+        assert st.get("enforced") is True
+
+        # 4. audit matches the expected violations (5 unlabeled namespaces)
+        resp = client.audit()
+        results = resp.results()
+        assert len(results) == 5
+        assert {r.resource["metadata"]["name"] for r in results} == \
+            {f"ns{i}" for i in range(10) if i % 2 == 0}
+        assert all("you must provide labels" in r.msg for r in results)
+
+        # 5. review path: a namespace without the label is denied
+        req = {"kind": {"group": "", "version": "v1", "kind": "Namespace"},
+               "operation": "CREATE", "name": "bad",
+               "object": ns_obj("bad")}
+        resp = client.review(req)
+        assert len(resp.results()) == 1
+
+        # 6. delete the template: CRD gone, engine cleared, finalizer freed
+        cluster.delete(TEMPLATE_GVK, "k8srequiredlabels")
+        plane.run_until_idle()
+        assert cluster.try_get(TEMPLATE_GVK, "k8srequiredlabels") is None
+        assert cluster.try_get(
+            CRD_GVK, "k8srequiredlabels.constraints.gatekeeper.sh") is None
+        assert client.audit().results() == []
+        assert con_gvk not in plane.watch_manager.watched_gvks()
+
+    def test_template_rego_error_lands_in_status(self, plane):
+        cluster = plane.cluster
+        bad = template_obj(rego="package foo\nthis is not rego")
+        cluster.create(bad)
+        plane.run_until_idle()
+        tmpl = cluster.get(TEMPLATE_GVK, "k8srequiredlabels")
+        errors = get_ha_status(tmpl).get("errors")
+        assert errors and errors[0]["code"] == "rego_parse_error"
+        assert not tmpl.get("status", {}).get("created")
+
+    def test_config_change_wipes_and_cleans_finalizers(self, plane):
+        cluster, client = plane.cluster, plane.client
+        cfg = empty_config_object()
+        cfg["spec"] = {"sync": {"syncOnly": [
+            {"group": "", "version": "v1", "kind": "Namespace"}]}}
+        cluster.create(cfg)
+        cluster.create(ns_obj("a"))
+        plane.run_until_idle()
+        assert "finalizers.gatekeeper.sh/sync" in \
+            cluster.get(NS_GVK, "a")["metadata"]["finalizers"]
+        assert client.dump()["admission.k8s.gatekeeper.sh"]["data"]
+
+        # drop Namespace from syncOnly -> data wiped + finalizers cleaned
+        cfg = cluster.get(CONFIG_GVK, "config", "gatekeeper-system")
+        cfg["spec"] = {"sync": {"syncOnly": []}}
+        cluster.update(cfg)
+        plane.run_until_idle()
+        assert not cluster.get(NS_GVK, "a")["metadata"].get("finalizers")
+        assert not client.dump()["admission.k8s.gatekeeper.sh"]["data"]
+        cfg = cluster.get(CONFIG_GVK, "config", "gatekeeper-system")
+        assert get_ha_status(cfg).get("allFinalizers") in ([], None)
+
+    def test_constraint_delete_via_finalizer(self, plane):
+        cluster, client = plane.cluster, plane.client
+        cluster.create(template_obj())
+        plane.run_until_idle()
+        cluster.create(constraint_obj())
+        plane.run_until_idle()
+        con_gvk = GVK("constraints.gatekeeper.sh", "v1alpha1",
+                      "K8sRequiredLabels")
+        cluster.delete(con_gvk, "ns-must-have-gk")
+        plane.run_until_idle()
+        assert cluster.try_get(con_gvk, "ns-must-have-gk") is None
+        # engine no longer has the constraint
+        assert client.constraints.get("K8sRequiredLabels") == {}
