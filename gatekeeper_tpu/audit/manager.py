@@ -1,0 +1,225 @@
+"""Audit sweep manager.
+
+Reference: pkg/audit/manager.go.  The loop that makes the engine a
+product: every ``interval`` seconds run one sweep (:84-119) —
+
+1. don't audit until the ConstraintTemplate CRD exists (:148-151);
+2. ``client.audit()`` — the full cross-product evaluation (the
+   north-star hot spot; here it runs on the jax driver's device path,
+   with the per-constraint cap pushed down as a device top-k instead of
+   the reference's format-everything-then-truncate);
+3. group results per constraint selfLink capped at
+   ``constraint_violations_limit`` (default 20, :35,161-199), truncating
+   messages to 256 chars (:27-31,302-311);
+4. discover all constraint kinds on constraints.gatekeeper.sh/v1alpha1
+   (:153-159);
+5. write ``status.violations`` + ``status.auditTimestamp`` on every
+   constraint with exponential-backoff retry (:201-248,313-379);
+   constraints with no violations get their stale ``status.violations``
+   removed (:267-283).
+
+Sweep observability (SURVEY §5 asks the build to beat the reference's
+zero metrics): every sweep records device/host timings, result counts
+and per-phase durations into ``last_sweep`` and the cumulative
+``metrics`` registry.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable
+
+from gatekeeper_tpu.client.client import Client
+from gatekeeper_tpu.cluster.fake import FakeCluster
+from gatekeeper_tpu.api.config import GVK
+from gatekeeper_tpu.errors import ApiError, NotFoundError
+from gatekeeper_tpu.utils.metrics import Metrics
+
+CRD_NAME = "constrainttemplates.templates.gatekeeper.sh"
+CRD_GVK = GVK("apiextensions.k8s.io", "v1beta1", "CustomResourceDefinition")
+CONSTRAINTS_GV = "constraints.gatekeeper.sh/v1alpha1"
+MSG_SIZE = 256
+
+DEFAULT_AUDIT_INTERVAL = 60           # -auditInterval (manager.go:34)
+DEFAULT_VIOLATIONS_LIMIT = 20         # -constraintViolationsLimit (:35)
+
+
+def truncate_message(msg: str, size: int = MSG_SIZE) -> str:
+    """manager.go:302-311 truncateString."""
+    if len(msg) <= size:
+        return msg
+    if size > 3:
+        size -= 3
+    return msg[:size] + "..."
+
+
+class AuditManager:
+    def __init__(self, cluster: FakeCluster, client: Client,
+                 interval: int = DEFAULT_AUDIT_INTERVAL,
+                 violations_limit: int = DEFAULT_VIOLATIONS_LIMIT,
+                 sleep: Callable[[float], None] = time.sleep,
+                 now: Callable[[], float] = time.time,
+                 metrics: Metrics | None = None):
+        self.cluster = cluster
+        self.client = client
+        self.interval = interval
+        self.violations_limit = violations_limit
+        self._sleep = sleep
+        self._now = now
+        self.metrics = metrics if metrics is not None else Metrics()
+        self.last_sweep: dict = {}
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # ------------------------------------------------------------------
+    # one sweep
+
+    def audit_once(self) -> dict:
+        """One audit() sweep (manager.go:84-119).  Returns the sweep
+        report (also stored as ``last_sweep``)."""
+        t0 = self._now()
+        report = self._sweep(t0)
+        if not report["skipped"]:
+            report.setdefault("total_seconds", self._now() - t0)
+            self.metrics.counter("audit_sweeps").inc()
+            self.metrics.counter("audit_violations").inc(report["violations"])
+            self.metrics.timer("audit_sweep_seconds").observe(
+                report["total_seconds"])
+        self.last_sweep = report
+        return report
+
+    def _sweep(self, t0: float) -> dict:
+        timestamp = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+        report = {"timestamp": timestamp, "skipped": False,
+                  "violations": 0, "constraints_updated": 0}
+
+        # don't audit anything until the template CRD is deployed
+        if self.cluster.try_get(CRD_GVK, CRD_NAME) is None:
+            report["skipped"] = True
+            return report
+
+        t_eval = self._now()
+        resp = self.client.audit(limit_per_constraint=self.violations_limit)
+        results = resp.results()
+        report["eval_seconds"] = self._now() - t_eval
+        report["violations"] = len(results)
+
+        update_lists = self._update_lists(results)
+
+        # discovery: constraint kinds under constraints.gatekeeper.sh/v1alpha1
+        try:
+            kinds = self.cluster.server_resources_for_group_version(
+                CONSTRAINTS_GV)
+        except NotFoundError:
+            # no constraint kind exists yet -> nothing to write (:111-115)
+            return report
+
+        t_write = self._now()
+        updated = self._write_audit_results(kinds, update_lists, timestamp)
+        report["write_seconds"] = self._now() - t_write
+        report["constraints_updated"] = updated
+        return report
+
+    def _update_lists(self, results) -> dict[str, list[dict]]:
+        """Group results per constraint selfLink with cap + truncation
+        (getUpdateListsFromAuditResponses, :161-199)."""
+        out: dict[str, list[dict]] = {}
+        for r in results:
+            constraint = r.constraint or {}
+            meta = constraint.get("metadata") or {}
+            self_link = meta.get("selfLink") or \
+                f"{constraint.get('kind', '')}/{meta.get('name', '')}"
+            bucket = out.setdefault(self_link, [])
+            if len(bucket) == self.violations_limit:
+                continue
+            resource = r.resource or {}
+            rmeta = resource.get("metadata") or {}
+            entry = {
+                "kind": resource.get("kind", ""),
+                "name": rmeta.get("name", ""),
+                "message": truncate_message(r.msg),
+                "enforcementAction": r.enforcement_action or "deny",
+            }
+            if rmeta.get("namespace"):
+                entry["namespace"] = rmeta["namespace"]
+            bucket.append(entry)
+        return out
+
+    def _write_audit_results(self, kinds: list[dict],
+                             update_lists: dict[str, list[dict]],
+                             timestamp: str) -> int:
+        """writeAuditResults + updateConstraintLoop (:201-248,313-379):
+        list every constraint of every kind and write its status with
+        exponential-backoff retry; constraints without violations get
+        stale status.violations removed."""
+        pending: dict[str, dict] = {}
+        for res in kinds:
+            gvk = GVK("constraints.gatekeeper.sh", "v1alpha1", res["kind"])
+            for item in self.cluster.list(gvk):
+                link = (item.get("metadata") or {}).get("selfLink", "")
+                pending[link] = item
+
+        updated = 0
+        delay = 1.0
+        for _ in range(5):  # wait.Backoff{Duration:1s, Factor:2, Steps:5}
+            for link, item in list(pending.items()):
+                try:
+                    latest = self.cluster.get(
+                        gvk_of_constraint(item),
+                        (item.get("metadata") or {}).get("name", ""),
+                        (item.get("metadata") or {}).get("namespace"))
+                    self._update_constraint_status(
+                        latest, update_lists.get(link, []), timestamp)
+                except ApiError:
+                    continue  # retried next backoff round
+                del pending[link]
+                updated += 1
+            if not pending:
+                break
+            self._sleep(delay)
+            delay *= 2
+        return updated
+
+    def _update_constraint_status(self, instance: dict,
+                                  violations: list[dict],
+                                  timestamp: str) -> None:
+        """updateConstraintStatus (:250-300)."""
+        status = instance.setdefault("status", {})
+        status["auditTimestamp"] = timestamp
+        if violations:
+            status["violations"] = violations
+        else:
+            status.pop("violations", None)
+        self.cluster.update(instance)
+
+    # ------------------------------------------------------------------
+    # loop (auditManagerLoop, :120-146)
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="audit-manager")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            if self._stop.wait(timeout=self.interval):
+                return
+            try:
+                self.audit_once()
+            except Exception:  # log-and-continue (:130-133)
+                self.metrics.counter("audit_errors").inc()
+
+
+def gvk_of_constraint(obj: dict) -> GVK:
+    return GVK.from_api_version(obj.get("apiVersion", ""),
+                                obj.get("kind", ""))

@@ -187,14 +187,28 @@ class Client:
             responses.handled[name] = True
         return responses
 
-    def audit(self, tracing: bool = False) -> Responses:
+    def review_batch(self, objs: list, tracing: bool = False) -> list[Responses]:
+        """Review a micro-batch under one lock acquisition / constraint
+        snapshot (the webhook batcher's engine pass)."""
         with self._lock:
-            return self._audit_locked(tracing)
+            return [self._review_locked(obj, tracing) for obj in objs]
 
-    def _audit_locked(self, tracing: bool) -> Responses:
+    def audit(self, tracing: bool = False,
+              limit_per_constraint: int | None = None) -> Responses:
+        """Full cross-product audit.  ``limit_per_constraint`` pushes the
+        audit manager's violations cap (reference manager.go:35) down to
+        the driver, where the jax engine turns it into a device top-k
+        instead of formatting everything and truncating on the host."""
+        with self._lock:
+            return self._audit_locked(tracing, limit_per_constraint)
+
+    def _audit_locked(self, tracing: bool,
+                      limit_per_constraint: int | None = None) -> Responses:
         responses = Responses()
         for name, handler in self.targets.items():
-            results, trace = self.driver.query_audit(name, QueryOpts(tracing=tracing))
+            results, trace = self.driver.query_audit(
+                name, QueryOpts(tracing=tracing,
+                                limit_per_constraint=limit_per_constraint))
             for r in results:
                 handler.handle_violation(r)
             responses.by_target[name] = Response(target=name, results=results,

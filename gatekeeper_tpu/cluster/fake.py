@@ -150,18 +150,22 @@ class FakeCluster:
             smeta["selfLink"] = current["metadata"].get("selfLink")
             # finalizer semantics: a terminating object whose finalizers
             # have all been stripped is removed by this update
+            events: list[tuple[GVK, Event]] = []
             if smeta.get("deletionTimestamp") and not smeta.get("finalizers"):
                 del store[key]
                 self._maybe_register_crd(stored, deleted=True)
-                event = Event(DELETED, copy.deepcopy(stored))
+                events.append((gvk, Event(DELETED, copy.deepcopy(stored))))
+                events += self._finish_crd_cleanup(gvk)
             else:
                 store[key] = stored
-                event = Event(MODIFIED, copy.deepcopy(stored))
+                events.append((gvk, Event(MODIFIED, copy.deepcopy(stored))))
             out = copy.deepcopy(stored)
-        self._notify(gvk, event)
+        for egvk, event in events:
+            self._notify(egvk, event)
         return out
 
     def delete(self, gvk: GVK, name: str, namespace: str | None = None) -> None:
+        events: list[tuple[GVK, Event]] = []
         with self._lock:
             store = self._objects.setdefault(gvk, {})
             key = (namespace, name)
@@ -169,18 +173,78 @@ class FakeCluster:
             if current is None:
                 raise NotFoundError(f"{gvk.kind} {key} not found")
             meta = current["metadata"]
+            # apiextensions semantics: deleting a CRD cascades to its
+            # custom resources; the CRD stays terminating until every CR
+            # is finalized (the template controller's delete flow waits
+            # on exactly this, constrainttemplate_controller.go:281-288)
+            events += self._cascade_crd_delete(current)
+            served = self._crd_served_gvk(current)
+            blocked = served is not None and bool(self._objects.get(served))
+            if meta.get("finalizers") or blocked:
+                if not meta.get("deletionTimestamp"):
+                    meta["deletionTimestamp"] = f"T{next(self._ts):08d}"
+                    meta["resourceVersion"] = str(next(self._rv))
+                    events.append((gvk, Event(MODIFIED, copy.deepcopy(current))))
+            else:
+                del store[key]
+                self._maybe_register_crd(current, deleted=True)
+                events.append((gvk, Event(DELETED, copy.deepcopy(current))))
+                events += self._finish_crd_cleanup(gvk)
+        for egvk, event in events:
+            self._notify(egvk, event)
+
+    def _crd_served_gvk(self, obj: dict) -> GVK | None:
+        if obj.get("kind") != "CustomResourceDefinition":
+            return None
+        spec = obj.get("spec") or {}
+        names = spec.get("names") or {}
+        if not names.get("kind"):
+            return None
+        return GVK(group=spec.get("group", ""),
+                   version=spec.get("version", ""), kind=names["kind"])
+
+    def _cascade_crd_delete(self, crd: dict) -> list[tuple[GVK, Event]]:
+        """Issue deletes for every CR of a CRD being deleted (with lock
+        held; per-CR finalizer semantics apply individually)."""
+        served = self._crd_served_gvk(crd)
+        if served is None or crd["metadata"].get("deletionTimestamp"):
+            return []
+        events: list[tuple[GVK, Event]] = []
+        store = self._objects.get(served, {})
+        for key in list(store):
+            cr = store[key]
+            meta = cr["metadata"]
             if meta.get("finalizers"):
                 if not meta.get("deletionTimestamp"):
                     meta["deletionTimestamp"] = f"T{next(self._ts):08d}"
                     meta["resourceVersion"] = str(next(self._rv))
-                    event = Event(MODIFIED, copy.deepcopy(current))
-                else:
-                    return  # already terminating
+                    events.append((served, Event(MODIFIED, copy.deepcopy(cr))))
             else:
                 del store[key]
-                self._maybe_register_crd(current, deleted=True)
-                event = Event(DELETED, copy.deepcopy(current))
-        self._notify(gvk, event)
+                events.append((served, Event(DELETED, copy.deepcopy(cr))))
+        return events
+
+    def _finish_crd_cleanup(self, removed_gvk: GVK) -> list[tuple[GVK, Event]]:
+        """When the last CR of a terminating CRD is finalized, remove the
+        CRD itself (with lock held)."""
+        if self._objects.get(removed_gvk):
+            return []
+        crd_gvk = GVK("apiextensions.k8s.io", "v1beta1",
+                      "CustomResourceDefinition")
+        events: list[tuple[GVK, Event]] = []
+        store = self._objects.get(crd_gvk, {})
+        for key in list(store):
+            crd = store[key]
+            if not crd["metadata"].get("deletionTimestamp"):
+                continue
+            if crd["metadata"].get("finalizers"):
+                continue
+            if self._crd_served_gvk(crd) != removed_gvk:
+                continue
+            del store[key]
+            self._maybe_register_crd(crd, deleted=True)
+            events.append((crd_gvk, Event(DELETED, copy.deepcopy(crd))))
+        return events
 
     def get(self, gvk: GVK, name: str, namespace: str | None = None) -> dict:
         with self._lock:

@@ -1,0 +1,200 @@
+"""Process entry point — driver → backend → client → controllers →
+webhook → audit wiring.
+
+Reference: cmd/manager/main.go:35-103.  Same wiring order: construct the
+engine driver (tracing on, main.go:68), the Backend + Client with the
+K8s target (main.go:69-74), add controllers (controller.AddToManager,
+main.go:81), webhook (main.go:87) and audit manager (main.go:93), then
+start everything and block (main.go:100).
+
+Flags mirror the reference's flag set (audit/manager.go:34-35,
+webhook/policy.go:47-49).  The cluster is this build's in-memory
+apiserver (a real deployment would swap in an adapter with the same
+surface); ``--demo`` seeds the demo/basic scenario (1k namespaces +
+required-labels template) and runs one audit sweep so the whole stack
+is observable end-to-end.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import signal
+import sys
+import threading
+
+from gatekeeper_tpu.api.config import GVK, empty_config_object
+from gatekeeper_tpu.audit.manager import (CRD_NAME, AuditManager,
+                                          DEFAULT_AUDIT_INTERVAL,
+                                          DEFAULT_VIOLATIONS_LIMIT)
+from gatekeeper_tpu.client.client import Backend
+from gatekeeper_tpu.cluster.fake import FakeCluster
+from gatekeeper_tpu.controllers.config import CONFIG_GVK
+from gatekeeper_tpu.controllers.constrainttemplate import TEMPLATE_GVK
+from gatekeeper_tpu.controllers.registry import ControlPlane, add_to_manager
+from gatekeeper_tpu.engine.jax_driver import JaxDriver
+from gatekeeper_tpu.target.k8s import K8sValidationTarget
+from gatekeeper_tpu.utils.metrics import Metrics
+from gatekeeper_tpu.webhook.batcher import MicroBatcher
+from gatekeeper_tpu.webhook.policy import ValidationHandler
+from gatekeeper_tpu.webhook.server import DEFAULT_PORT, WebhookServer
+
+NS_GVK = GVK("", "v1", "Namespace")
+
+
+def bootstrap_cluster(cluster: FakeCluster) -> None:
+    """Install what deploy/gatekeeper.yaml installs: the base CRDs /
+    served kinds the controllers and audit manager expect."""
+    cluster.register_kind(TEMPLATE_GVK, "constrainttemplates")
+    cluster.register_kind(CONFIG_GVK, "configs")
+    cluster.register_kind(NS_GVK, "namespaces")
+    if cluster.try_get(GVK("apiextensions.k8s.io", "v1beta1",
+                           "CustomResourceDefinition"), CRD_NAME) is None:
+        cluster.create({
+            "apiVersion": "apiextensions.k8s.io/v1beta1",
+            "kind": "CustomResourceDefinition",
+            "metadata": {"name": CRD_NAME},
+            "spec": {"group": "templates.gatekeeper.sh",
+                     "version": "v1alpha1",
+                     "names": {"kind": "ConstraintTemplate",
+                               "plural": "constrainttemplates"}}})
+
+
+class Manager:
+    """Everything main() builds, held together for tests and the demo."""
+
+    def __init__(self, args: argparse.Namespace,
+                 cluster: FakeCluster | None = None):
+        self.metrics = Metrics()
+        self.cluster = cluster if cluster is not None else FakeCluster()
+        bootstrap_cluster(self.cluster)
+        driver = JaxDriver(tracing=False)
+        self.client = Backend(driver).new_client([K8sValidationTarget()])
+        self.plane: ControlPlane = add_to_manager(self.cluster, self.client)
+        self.batcher = MicroBatcher(
+            lambda reqs: self.client.review_batch(reqs),
+            max_batch=args.max_batch, max_wait=args.batch_window_ms / 1000.0,
+            metrics=self.metrics)
+        self.handler = ValidationHandler(self.client, cluster=self.cluster,
+                                         batcher=self.batcher,
+                                         metrics=self.metrics,
+                                         log=lambda m: print(m, file=sys.stderr))
+        self.webhook = WebhookServer(self.handler, port=args.port) \
+            if args.port >= 0 else None
+        self.audit = AuditManager(self.cluster, self.client,
+                                  interval=args.audit_interval,
+                                  violations_limit=args.constraint_violations_limit,
+                                  metrics=self.metrics)
+
+    def start(self) -> None:
+        self.plane.mgr.start()
+        self.batcher.start()
+        if self.webhook is not None:
+            self.webhook.start()
+        self.audit.start()
+
+    def stop(self) -> None:
+        self.audit.stop()
+        if self.webhook is not None:
+            self.webhook.stop()
+        self.batcher.stop()
+        self.plane.mgr.stop()
+
+
+def parse_args(argv=None) -> argparse.Namespace:
+    p = argparse.ArgumentParser(prog="gatekeeper-tpu-manager")
+    p.add_argument("--audit-interval", type=int,
+                   default=DEFAULT_AUDIT_INTERVAL,
+                   help="interval to run audit in seconds (manager.go:34)")
+    p.add_argument("--constraint-violations-limit", type=int,
+                   default=DEFAULT_VIOLATIONS_LIMIT,
+                   help="violations reported per constraint (manager.go:35)")
+    p.add_argument("--port", type=int, default=DEFAULT_PORT,
+                   help="webhook port; -1 disables (policy.go:48)")
+    p.add_argument("--batch-window-ms", type=float, default=2.0,
+                   help="admission micro-batch window")
+    p.add_argument("--max-batch", type=int, default=64,
+                   help="admission micro-batch size cap")
+    p.add_argument("--demo", action="store_true",
+                   help="seed demo/basic (1k namespaces + required-labels) "
+                        "and run one audit sweep")
+    return p.parse_args(argv)
+
+
+def run_demo(mgr: Manager, n_namespaces: int = 1000) -> dict:
+    """The demo/basic flow (reference demo/basic/demo.sh): sync config →
+    template → constraint → resources → one audit sweep → statuses."""
+    cluster = mgr.cluster
+    cfg = empty_config_object()
+    cfg["spec"] = {"sync": {"syncOnly": [
+        {"group": "", "version": "v1", "kind": "Namespace"}]}}
+    cluster.create(cfg)
+    for i in range(n_namespaces):
+        obj = {"apiVersion": "v1", "kind": "Namespace",
+               "metadata": {"name": f"ns-{i:04d}"}}
+        if i % 2:
+            obj["metadata"]["labels"] = {"gatekeeper": "true"}
+        cluster.create(obj)
+    cluster.create({
+        "apiVersion": "templates.gatekeeper.sh/v1alpha1",
+        "kind": "ConstraintTemplate",
+        "metadata": {"name": "k8srequiredlabels"},
+        "spec": {
+            "crd": {"spec": {"names": {"kind": "K8sRequiredLabels"},
+                             "validation": {"openAPIV3Schema": {"properties": {
+                                 "labels": {"type": "array",
+                                            "items": {"type": "string"}}}}}}},
+            "targets": [{
+                "target": "admission.k8s.gatekeeper.sh",
+                "rego": 'package k8srequiredlabels\n'
+                        'violation[{"msg": msg, "details": '
+                        '{"missing_labels": missing}}] {\n'
+                        '  provided := {label | '
+                        'input.review.object.metadata.labels[label]}\n'
+                        '  required := {label | label := '
+                        'input.constraint.spec.parameters.labels[_]}\n'
+                        '  missing := required - provided\n'
+                        '  count(missing) > 0\n'
+                        '  msg := sprintf("you must provide labels: %v", '
+                        '[missing])\n}\n'}]},
+    })
+    mgr.plane.run_until_idle()
+    cluster.create({
+        "apiVersion": "constraints.gatekeeper.sh/v1alpha1",
+        "kind": "K8sRequiredLabels",
+        "metadata": {"name": "ns-must-have-gk"},
+        "spec": {"match": {"kinds": [{"apiGroups": [""],
+                                      "kinds": ["Namespace"]}]},
+                 "parameters": {"labels": ["gatekeeper"]}},
+    })
+    mgr.plane.run_until_idle()
+    report = mgr.audit.audit_once()
+    con = cluster.get(GVK("constraints.gatekeeper.sh", "v1alpha1",
+                          "K8sRequiredLabels"), "ns-must-have-gk")
+    return {"sweep": report,
+            "status_violations": len((con.get("status") or {})
+                                     .get("violations") or []),
+            "audit_timestamp": (con.get("status") or {}).get("auditTimestamp")}
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    mgr = Manager(args)
+    if args.demo:
+        out = run_demo(mgr)
+        print(json.dumps(out, indent=2, default=str))
+        return 0
+    mgr.start()
+    print(f"gatekeeper-tpu manager up "
+          f"(webhook :{mgr.webhook.port if mgr.webhook else 'off'}, "
+          f"audit every {args.audit_interval}s)", file=sys.stderr)
+    stop = threading.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        signal.signal(sig, lambda *_: stop.set())
+    stop.wait()
+    mgr.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

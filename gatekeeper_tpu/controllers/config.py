@@ -23,8 +23,10 @@ from gatekeeper_tpu.client.targets import WipeData
 from gatekeeper_tpu.cluster.fake import FakeCluster
 from gatekeeper_tpu.controllers.runtime import (DONE, REQUEUE, ReconcileResult,
                                                 Reconciler, Request)
-from gatekeeper_tpu.controllers.sync import has_finalizer, remove_finalizer
+from gatekeeper_tpu.controllers.sync import (has_sync_finalizer,
+                                             remove_sync_finalizer)
 from gatekeeper_tpu.errors import ApiConflictError, ApiError, NotFoundError
+from gatekeeper_tpu.utils.finalizers import add_finalizer, strip_finalizer
 from gatekeeper_tpu.utils.ha_status import get_ha_status, set_ha_status
 from gatekeeper_tpu.watch.manager import Registrar
 
@@ -51,10 +53,10 @@ class ReconcileConfig(Reconciler):
             return DONE
 
         meta = instance.setdefault("metadata", {})
+        terminating = bool(meta.get("deletionTimestamp"))
         new_sync_only: set[GVK] = set()
-        if not meta.get("deletionTimestamp"):
-            if FINALIZER not in (meta.get("finalizers") or []):
-                meta.setdefault("finalizers", []).append(FINALIZER)
+        if not terminating:
+            if add_finalizer(instance, FINALIZER):
                 try:
                     instance = self.cluster.update(instance)
                     meta = instance["metadata"]
@@ -63,9 +65,6 @@ class ReconcileConfig(Reconciler):
                 except NotFoundError:
                     return DONE
             new_sync_only = set(Config.from_dict(instance).spec.sync_only)
-        else:
-            meta["finalizers"] = [f for f in meta.get("finalizers") or []
-                                  if f != FINALIZER]
 
         status = get_ha_status(instance)
         to_clean = {GVK.from_dict(g)
@@ -86,6 +85,11 @@ class ReconcileConfig(Reconciler):
 
             self.watcher.replace_watch(sorted(new_sync_only))
 
+            # only release the config's own finalizer once every stale
+            # sync finalizer is cleaned — otherwise the allFinalizers
+            # record (the durable cleanup intent) dies with the object
+            if terminating and not failed:
+                strip_finalizer(instance, FINALIZER)
             set_ha_status(instance, status)
             try:
                 self.cluster.update(instance)
@@ -108,10 +112,10 @@ class ReconcileConfig(Reconciler):
         for gvk in sorted(gvks):
             ok = True
             for obj in self.cluster.list(gvk):
-                if not has_finalizer(obj):
+                if not has_sync_finalizer(obj):
                     continue
                 try:
-                    remove_finalizer(self.cluster, obj)
+                    remove_sync_finalizer(self.cluster, obj)
                 except ApiError:
                     ok = False
             if ok:

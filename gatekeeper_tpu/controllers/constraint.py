@@ -16,6 +16,8 @@ from gatekeeper_tpu.cluster.fake import FakeCluster
 from gatekeeper_tpu.controllers.runtime import (DONE, REQUEUE, ReconcileResult,
                                                 Reconciler, Request)
 from gatekeeper_tpu.errors import ApiConflictError, ClientError, NotFoundError
+from gatekeeper_tpu.utils.finalizers import (add_finalizer, has_finalizer,
+                                             strip_finalizer)
 from gatekeeper_tpu.utils.ha_status import get_ha_status, set_ha_status
 
 FINALIZER = "finalizers.gatekeeper.sh/constraint"
@@ -33,12 +35,10 @@ class ReconcileConstraint(Reconciler):
                                         request.namespace)
         if instance is None:
             return DONE
-        meta = instance.setdefault("metadata", {})
-        if not meta.get("deletionTimestamp"):
-            if FINALIZER not in (meta.get("finalizers") or []):
-                meta.setdefault("finalizers", []).append(FINALIZER)
-                result = self._update(instance)
-                if result.requeue:
+        if not (instance.get("metadata") or {}).get("deletionTimestamp"):
+            if add_finalizer(instance, FINALIZER):
+                instance, result = self._update(instance)
+                if instance is None:
                     return result
             status = get_ha_status(instance)
             status.pop("errors", None)
@@ -53,20 +53,20 @@ class ReconcileConstraint(Reconciler):
                 return DONE
             status["enforced"] = True
             set_ha_status(instance, status)
-            return self._update(instance)
+            _, result = self._update(instance)
+            return result
         # deletion (:139-152)
-        if FINALIZER in (meta.get("finalizers") or []):
+        if has_finalizer(instance, FINALIZER):
             self.client.remove_constraint(instance)
-            meta["finalizers"] = [f for f in meta.get("finalizers") or []
-                                  if f != FINALIZER]
-            return self._update(instance)
+            strip_finalizer(instance, FINALIZER)
+            _, result = self._update(instance)
+            return result
         return DONE
 
-    def _update(self, instance: dict) -> ReconcileResult:
+    def _update(self, instance: dict) -> tuple[dict | None, ReconcileResult]:
         try:
-            self.cluster.update(instance)
+            return self.cluster.update(instance), DONE
         except ApiConflictError:
-            return REQUEUE
+            return None, REQUEUE
         except NotFoundError:
-            pass
-        return DONE
+            return None, DONE

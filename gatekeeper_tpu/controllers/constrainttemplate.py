@@ -7,6 +7,11 @@ engine (AddTemplate), register the constraint kind with the watch
 registrar, create/update the CRD object in-cluster, and on delete tear
 all of that down behind a finalizer with requeue-based deadlock
 recovery.
+
+Deviation (fixes a reference bug): a terminating template whose Rego no
+longer compiles still tears down — the reference returns after the
+CreateCRD error and would leak the finalizer forever; here deletion
+proceeds with the CRD identity derived from the template kind alone.
 """
 
 from __future__ import annotations
@@ -18,18 +23,21 @@ from gatekeeper_tpu.controllers.runtime import (DONE, REQUEUE, ReconcileResult,
                                                 Reconciler, Request)
 from gatekeeper_tpu.errors import (AlreadyExistsError, ApiConflictError,
                                    ClientError, NotFoundError, RegoError)
+from gatekeeper_tpu.utils.finalizers import (add_finalizer, has_finalizer,
+                                             strip_finalizer)
 from gatekeeper_tpu.utils.ha_status import get_ha_status, set_ha_status
 from gatekeeper_tpu.watch.manager import Registrar
 
 TEMPLATE_GVK = GVK("templates.gatekeeper.sh", "v1alpha1", "ConstraintTemplate")
 CRD_GVK = GVK("apiextensions.k8s.io", "v1beta1", "CustomResourceDefinition")
+CONSTRAINT_GROUP = "constraints.gatekeeper.sh"
 FINALIZER = "constrainttemplate.finalizers.gatekeeper.sh"
 
 
 def make_constraint_gvk(kind: str) -> GVK:
     """makeGvk (:306-312): constraints are always
     constraints.gatekeeper.sh/v1alpha1/<Kind>."""
-    return GVK("constraints.gatekeeper.sh", "v1alpha1", kind)
+    return GVK(CONSTRAINT_GROUP, "v1alpha1", kind)
 
 
 def _template_kind(instance: dict) -> str:
@@ -51,12 +59,20 @@ class ReconcileConstraintTemplate(Reconciler):
         instance = self.cluster.try_get(TEMPLATE_GVK, request.name)
         if instance is None:
             return DONE
+        terminating = bool((instance.get("metadata") or {})
+                           .get("deletionTimestamp"))
 
         status = get_ha_status(instance)
         status.pop("errors", None)
         try:
             crd = self.client.create_crd(instance)
         except (RegoError, ClientError) as err:
+            if terminating:
+                # tear down anyway: CRD identity from the kind alone
+                kind = _template_kind(instance)
+                crd = {"metadata": {
+                    "name": f"{kind.lower()}.{CONSTRAINT_GROUP}"}}
+                return self._handle_delete(instance, crd)
             # parse/validation errors land in status.byPod[].errors
             # (:143-158) and the template is otherwise left alone
             entry = {"code": getattr(err, "code", "create_error"),
@@ -66,26 +82,25 @@ class ReconcileConstraintTemplate(Reconciler):
                 entry["location"] = str(loc)
             status.setdefault("errors", []).append(entry)
             set_ha_status(instance, status)
-            return self._update(instance, requeue_on_conflict=True)
+            _, result = self._update(instance)
+            return result
         set_ha_status(instance, status)
 
-        if not (instance.get("metadata") or {}).get("deletionTimestamp"):
-            crd_name = (crd.get("metadata") or {}).get("name", "")
-            found = self.cluster.try_get(CRD_GVK, crd_name)
-            if found is None:
-                return self._handle_create(instance, crd)
-            return self._handle_update(instance, crd, found)
-        return self._handle_delete(instance, crd)
+        if terminating:
+            return self._handle_delete(instance, crd)
+        crd_name = (crd.get("metadata") or {}).get("name", "")
+        found = self.cluster.try_get(CRD_GVK, crd_name)
+        if found is None:
+            return self._handle_create(instance, crd)
+        return self._handle_update(instance, crd, found)
 
     # ------------------------------------------------------------------
 
     def _handle_create(self, instance: dict, crd: dict) -> ReconcileResult:
         """:184-230 handleCreate."""
-        meta = instance.setdefault("metadata", {})
-        if FINALIZER not in (meta.get("finalizers") or []):
-            meta.setdefault("finalizers", []).append(FINALIZER)
-            result = self._update(instance, requeue_on_conflict=True)
-            if result.requeue:
+        if add_finalizer(instance, FINALIZER):
+            instance, result = self._update(instance)
+            if instance is None:
                 return result
         if not self._add_template(instance):
             return DONE
@@ -95,7 +110,8 @@ class ReconcileConstraintTemplate(Reconciler):
         except AlreadyExistsError:
             pass  # another replica won the create race (HA note at :210)
         instance.setdefault("status", {})["created"] = True
-        return self._update(instance, requeue_on_conflict=True)
+        _, result = self._update(instance)
+        return result
 
     def _handle_update(self, instance: dict, crd: dict,
                        found: dict) -> ReconcileResult:
@@ -111,14 +127,14 @@ class ReconcileConstraintTemplate(Reconciler):
             except ApiConflictError:
                 return REQUEUE
         instance.setdefault("status", {})["created"] = True
-        return self._update(instance, requeue_on_conflict=True)
+        _, result = self._update(instance)
+        return result
 
     def _handle_delete(self, instance: dict, crd: dict) -> ReconcileResult:
         """:269-304 handleDelete: CRD delete → wait for it to vanish
         (re-adding the watch first recovers an offline finalizer
         deadlock) → remove watch → remove template → drop finalizer."""
-        meta = instance.setdefault("metadata", {})
-        if FINALIZER not in (meta.get("finalizers") or []):
+        if not has_finalizer(instance, FINALIZER):
             return DONE
         crd_name = (crd.get("metadata") or {}).get("name", "")
         try:
@@ -126,13 +142,15 @@ class ReconcileConstraintTemplate(Reconciler):
         except NotFoundError:
             pass
         if self.cluster.try_get(CRD_GVK, crd_name) is not None:
+            # child CRD not gone yet (constraints still finalizing):
+            # keep their watch alive and requeue
             self.watcher.add_watch(make_constraint_gvk(_template_kind(instance)))
             return REQUEUE
         self.watcher.remove_watch(make_constraint_gvk(_template_kind(instance)))
         self.client.remove_template(instance)
-        meta["finalizers"] = [f for f in meta.get("finalizers") or []
-                              if f != FINALIZER]
-        return self._update(instance, requeue_on_conflict=True)
+        strip_finalizer(instance, FINALIZER)
+        _, result = self._update(instance)
+        return result
 
     # ------------------------------------------------------------------
 
@@ -147,15 +165,16 @@ class ReconcileConstraintTemplate(Reconciler):
                 {"code": "update_error",
                  "message": f"Could not update CRD: {err}"})
             set_ha_status(instance, status)
-            self._update(instance, requeue_on_conflict=False)
+            self._update(instance)
             return False
 
-    def _update(self, instance: dict,
-                requeue_on_conflict: bool) -> ReconcileResult:
+    def _update(self, instance: dict) -> tuple[dict | None, ReconcileResult]:
+        """Persist; returns (updated object | None, result).  The caller
+        must continue with the returned object — the stored
+        resourceVersion advances on success."""
         try:
-            self.cluster.update(instance)
+            return self.cluster.update(instance), DONE
         except ApiConflictError:
-            return REQUEUE if requeue_on_conflict else DONE
+            return None, REQUEUE
         except NotFoundError:
-            return DONE
-        return DONE
+            return None, DONE

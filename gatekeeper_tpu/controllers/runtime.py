@@ -23,7 +23,6 @@ from typing import Callable
 
 from gatekeeper_tpu.api.config import GVK
 from gatekeeper_tpu.cluster.fake import Event, FakeCluster
-from gatekeeper_tpu.errors import ApiError
 
 
 @dataclasses.dataclass(frozen=True)
@@ -111,9 +110,10 @@ class ControllerManager:
         try:
             result = reconciler.reconcile(request)
             failed = False
-        except ApiError as e:
-            # transient cluster errors requeue, like controller-runtime's
-            # error-result requeue path
+        except Exception as e:
+            # any reconcile error requeues (controller-runtime requeues on
+            # error-result; a raising reconciler must never kill the
+            # worker loop)
             self.errors.append((reconciler.name, request, e))
             result, failed = REQUEUE, True
         if result is not None and result.requeue:

@@ -15,18 +15,18 @@ from gatekeeper_tpu.cluster.fake import FakeCluster, gvk_of
 from gatekeeper_tpu.controllers.runtime import (DONE, REQUEUE, ReconcileResult,
                                                 Reconciler, Request)
 from gatekeeper_tpu.errors import ApiConflictError, NotFoundError
+from gatekeeper_tpu.utils.finalizers import (add_finalizer, has_finalizer,
+                                             strip_finalizer)
 
 FINALIZER = "finalizers.gatekeeper.sh/sync"
 
 
-def has_finalizer(obj: dict) -> bool:
-    return FINALIZER in ((obj.get("metadata") or {}).get("finalizers") or [])
+def has_sync_finalizer(obj: dict) -> bool:
+    return has_finalizer(obj, FINALIZER)
 
 
-def remove_finalizer(cluster: FakeCluster, obj: dict) -> None:
-    meta = obj.setdefault("metadata", {})
-    meta["finalizers"] = [f for f in meta.get("finalizers") or []
-                          if f != FINALIZER]
+def remove_sync_finalizer(cluster: FakeCluster, obj: dict) -> None:
+    strip_finalizer(obj, FINALIZER)
     cluster.update(obj)
 
 
@@ -46,19 +46,18 @@ class ReconcileSync(Reconciler):
             return DONE  # unexpected data (:113-116)
         meta = instance.setdefault("metadata", {})
         if not meta.get("deletionTimestamp"):
-            if FINALIZER not in (meta.get("finalizers") or []):
-                meta.setdefault("finalizers", []).append(FINALIZER)
+            if add_finalizer(instance, FINALIZER):
                 try:
-                    self.cluster.update(instance)
+                    instance = self.cluster.update(instance)
                 except ApiConflictError:
                     return REQUEUE
                 except NotFoundError:
                     return DONE
             self.client.add_data(instance)
-        elif has_finalizer(instance):
+        elif has_sync_finalizer(instance):
             self.client.remove_data(instance)
             try:
-                remove_finalizer(self.cluster, instance)
+                remove_sync_finalizer(self.cluster, instance)
             except ApiConflictError:
                 return REQUEUE
             except NotFoundError:

@@ -1,0 +1,99 @@
+"""Metrics registry — counters, gauges, timers.
+
+The reference vendors OPA's metrics registry
+(vendor/.../opa/metrics/metrics.go:30-44) but never surfaces it;
+SURVEY §5 asks this build to do better.  This registry backs the audit
+manager's per-sweep counters, the jax driver's device/host timing
+breakdown, and the webhook's latency percentiles, and snapshots to a
+plain dict for bench output.
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self.value = 0
+
+    def inc(self, n: int = 1) -> None:
+        self.value += n
+
+
+class Gauge:
+    def __init__(self):
+        self.value = 0.0
+
+    def set(self, v: float) -> None:
+        self.value = v
+
+
+class Timer:
+    """Accumulates observations; exposes count/total/mean/min/max and
+    percentiles over a bounded reservoir."""
+
+    RESERVOIR = 4096
+
+    def __init__(self):
+        self.count = 0
+        self.total = 0.0
+        self.min = None
+        self.max = None
+        self._samples: list[float] = []
+
+    def observe(self, seconds: float) -> None:
+        self.count += 1
+        self.total += seconds
+        self.min = seconds if self.min is None else min(self.min, seconds)
+        self.max = seconds if self.max is None else max(self.max, seconds)
+        if len(self._samples) < self.RESERVOIR:
+            self._samples.append(seconds)
+        else:  # reservoir is full: overwrite deterministically
+            self._samples[self.count % self.RESERVOIR] = seconds
+
+    def percentile(self, p: float) -> float | None:
+        if not self._samples:
+            return None
+        s = sorted(self._samples)
+        idx = min(len(s) - 1, int(p / 100.0 * len(s)))
+        return s[idx]
+
+    @property
+    def mean(self) -> float | None:
+        return self.total / self.count if self.count else None
+
+
+class Metrics:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: dict[str, Counter] = {}
+        self._gauges: dict[str, Gauge] = {}
+        self._timers: dict[str, Timer] = {}
+
+    def counter(self, name: str) -> Counter:
+        with self._lock:
+            return self._counters.setdefault(name, Counter())
+
+    def gauge(self, name: str) -> Gauge:
+        with self._lock:
+            return self._gauges.setdefault(name, Gauge())
+
+    def timer(self, name: str) -> Timer:
+        with self._lock:
+            return self._timers.setdefault(name, Timer())
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            out: dict = {}
+            for name, c in self._counters.items():
+                out[name] = c.value
+            for name, g in self._gauges.items():
+                out[name] = g.value
+            for name, t in self._timers.items():
+                out[name] = {
+                    "count": t.count, "total_seconds": round(t.total, 6),
+                    "mean_seconds": round(t.mean, 6) if t.mean else None,
+                    "p50": t.percentile(50), "p99": t.percentile(99),
+                }
+            return out

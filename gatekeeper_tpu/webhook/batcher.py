@@ -1,0 +1,108 @@
+"""Admission micro-batcher.
+
+SURVEY §7 step 7: collect concurrent AdmissionReviews for up to
+``max_wait`` seconds (or ``max_batch`` requests), then run the whole
+batch through the engine in one pass.  The reference has nothing like
+this — every HTTPS callback runs its own single-threaded topdown query —
+but the TPU-shaped engine wants batches: one pass amortizes the client
+lock, the constraint-set snapshot, and (for the device path) the kernel
+dispatch.
+
+Callers block in ``submit`` until their batch is evaluated; a dedicated
+worker thread owns batch formation, so latency is bounded by
+``max_wait + evaluation``.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable
+
+from gatekeeper_tpu.utils.metrics import Metrics
+
+
+class _Pending:
+    __slots__ = ("request", "event", "response", "error")
+
+    def __init__(self, request):
+        self.request = request
+        self.event = threading.Event()
+        self.response = None
+        self.error: Exception | None = None
+
+
+class MicroBatcher:
+    def __init__(self, evaluate_batch: Callable[[list[dict]], list],
+                 max_batch: int = 64, max_wait: float = 0.002,
+                 metrics: Metrics | None = None):
+        self.evaluate_batch = evaluate_batch
+        self.max_batch = max_batch
+        self.max_wait = max_wait
+        self.metrics = metrics if metrics is not None else Metrics()
+        self._queue: list[_Pending] = []
+        self._lock = threading.Lock()
+        self._wake = threading.Condition(self._lock)
+        self._stop = False
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> None:
+        if self._thread is None:
+            self._stop = False
+            self._thread = threading.Thread(target=self._run, daemon=True,
+                                            name="admission-batcher")
+            self._thread.start()
+
+    def stop(self) -> None:
+        with self._wake:
+            self._stop = True
+            self._wake.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+
+    # ------------------------------------------------------------------
+
+    def submit(self, request: dict):
+        """Block until the batch containing this request is evaluated."""
+        if self._thread is None:
+            # no worker: degrade to a single-request batch inline
+            return self.evaluate_batch([request])[0]
+        p = _Pending(request)
+        with self._wake:
+            self._queue.append(p)
+            self._wake.notify()
+        p.event.wait()
+        if p.error is not None:
+            raise p.error
+        return p.response
+
+    def _run(self) -> None:
+        while True:
+            with self._wake:
+                while not self._queue and not self._stop:
+                    self._wake.wait(timeout=0.5)
+                if self._stop:
+                    for p in self._queue:
+                        p.error = RuntimeError("batcher stopped")
+                        p.event.set()
+                    self._queue.clear()
+                    return
+            # batch window: let more requests coalesce
+            if self.max_wait > 0:
+                threading.Event().wait(self.max_wait)
+            with self._wake:
+                batch, self._queue = (self._queue[:self.max_batch],
+                                      self._queue[self.max_batch:])
+            if not batch:
+                continue
+            self.metrics.counter("admission_batches").inc()
+            self.metrics.timer("admission_batch_size").observe(len(batch))
+            try:
+                responses = self.evaluate_batch([p.request for p in batch])
+                for p, r in zip(batch, responses):
+                    p.response = r
+            except Exception as e:
+                for p in batch:
+                    p.error = e
+            for p in batch:
+                p.event.set()

@@ -1,0 +1,160 @@
+"""Validating admission webhook — Handle semantics.
+
+Reference: pkg/webhook/policy.go:125-277.  The latency-critical serving
+path:
+
+- requests from Gatekeeper's own service account are allowed through
+  ("Gatekeeper does not self-manage", :127,199-207);
+- DELETE reviews validate ``oldObject`` (apiservers ≥1.15 send it;
+  otherwise error 500, :131-147);
+- writes of ConstraintTemplate / constraint kinds are validated
+  synchronously (CreateCRD / ValidateConstraint, :149,211-241) — user
+  errors deny with 422, internal errors with 500;
+- everything else is reviewed against the engine with per-user/kind
+  trace toggles from the Config CR (:244-277); violations deny with 403
+  and one ``[denied by <constraint>] <msg>`` line per result (:173-184).
+
+Requests ride the micro-batcher when one is attached (SURVEY §7 step 7):
+concurrent Handle calls coalesce into one engine pass per batch window.
+"""
+
+from __future__ import annotations
+
+import time
+
+from gatekeeper_tpu.api.config import (CONFIG_NAME, CONFIG_NAMESPACE, Config,
+                                       GVK)
+from gatekeeper_tpu.client.client import Client
+from gatekeeper_tpu.controllers.config import CONFIG_GVK
+from gatekeeper_tpu.errors import ClientError, GatekeeperError, RegoError
+from gatekeeper_tpu.utils.metrics import Metrics
+
+NAMESPACE = "gatekeeper-system"
+TEMPLATE_GROUP = "templates.gatekeeper.sh"
+CONSTRAINT_GROUP = "constraints.gatekeeper.sh"
+
+
+def allow(message: str = "") -> dict:
+    return {"allowed": True, "status": {"code": 200, "message": message}}
+
+
+def deny(code: int, message: str) -> dict:
+    return {"allowed": False, "status": {"code": code, "message": message}}
+
+
+def is_gk_service_account(user_info: dict) -> bool:
+    """policy.go:199-207: group system:serviceaccounts:gatekeeper-system."""
+    groups = (user_info or {}).get("groups") or []
+    return f"system:serviceaccounts:{NAMESPACE}" in groups
+
+
+class ValidationHandler:
+    def __init__(self, client: Client, cluster=None, injected_config=None,
+                 batcher=None, metrics: Metrics | None = None,
+                 log=lambda *_: None):
+        self.client = client
+        self.cluster = cluster
+        self.injected_config = injected_config  # test hook (policy.go:121)
+        self.batcher = batcher
+        self.metrics = metrics if metrics is not None else Metrics()
+        self.log = log
+
+    # ------------------------------------------------------------------
+
+    def handle(self, request: dict) -> dict:
+        """AdmissionRequest dict -> AdmissionResponse dict."""
+        t0 = time.perf_counter()
+        try:
+            return self._handle(request)
+        finally:
+            self.metrics.timer("admission_seconds").observe(
+                time.perf_counter() - t0)
+            self.metrics.counter("admission_requests").inc()
+
+    def _handle(self, request: dict) -> dict:
+        if is_gk_service_account(request.get("userInfo") or {}):
+            return allow("Gatekeeper does not self-manage")
+
+        if request.get("operation") == "DELETE":
+            if request.get("oldObject") is None:
+                return deny(500, "For admission webhooks registered for "
+                                 "DELETE operations, please use Kubernetes "
+                                 "v1.15.0+.")
+            request = dict(request)
+            request["object"] = request["oldObject"]
+
+        user_err, err = self._validate_gatekeeper_resources(request)
+        if err is not None:
+            return deny(422 if user_err else 500, err)
+
+        try:
+            resp = self._review(request)
+        except GatekeeperError as e:
+            return deny(500, str(e))
+        results = resp.results()
+        if results:
+            msgs = [f"[denied by {(r.constraint.get('metadata') or {}).get('name', '')}] "
+                    f"{r.msg}" for r in results]
+            self.metrics.counter("admission_denied").inc()
+            return deny(403, "\n".join(msgs))
+        return allow()
+
+    # ------------------------------------------------------------------
+
+    def _validate_gatekeeper_resources(self, request) -> tuple[bool, str | None]:
+        """policy.go:211-241: (user_error, message)."""
+        kind = request.get("kind") or {}
+        obj = request.get("object")
+        if kind.get("group") == TEMPLATE_GROUP and \
+                kind.get("kind") == "ConstraintTemplate":
+            try:
+                self.client.create_crd(obj)
+            except (RegoError, ClientError) as e:
+                return True, str(e)
+            return False, None
+        if kind.get("group") == CONSTRAINT_GROUP:
+            try:
+                self.client.validate_constraint(obj)
+            except ClientError as e:
+                return True, str(e)
+            return False, None
+        return False, None
+
+    def _get_config(self) -> Config:
+        """policy.go:188-197 getConfig (injected test hook first)."""
+        if self.injected_config is not None:
+            return Config.from_dict(self.injected_config)
+        if self.cluster is not None:
+            obj = self.cluster.try_get(CONFIG_GVK, CONFIG_NAME,
+                                       CONFIG_NAMESPACE)
+            if obj is not None:
+                return Config.from_dict(obj)
+        return Config()
+
+    def _trace_switch(self, request: dict) -> tuple[bool, bool]:
+        cfg = self._get_config()
+        kind = request.get("kind") or {}
+        gvk = GVK(kind.get("group", ""), kind.get("version", ""),
+                  kind.get("kind", ""))
+        username = (request.get("userInfo") or {}).get("username", "")
+        enabled = dump = False
+        for trace in cfg.spec.traces:
+            if trace.user != username or trace.kind != gvk:
+                continue
+            enabled = True
+            if trace.dump == "All":
+                dump = True
+        return enabled, dump
+
+    def _review(self, request: dict):
+        """reviewRequest (policy.go:244-277)."""
+        tracing, dump = self._trace_switch(request)
+        if self.batcher is not None and not tracing:
+            resp = self.batcher.submit(request)
+        else:
+            resp = self.client.review(request, tracing=tracing)
+        if tracing:
+            self.log(resp.trace_dump())
+        if dump:
+            self.log(self.client.dump())
+        return resp

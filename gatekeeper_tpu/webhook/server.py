@@ -1,0 +1,74 @@
+"""Webhook HTTP server: POST /v1/admit with an AdmissionReview envelope.
+
+Reference: pkg/webhook/policy.go:56-79 — controller-runtime's webhook
+server at path /v1/admit, port flag default 443.  This build serves the
+same contract over stdlib http.server (threaded, one handler instance):
+request body is a v1beta1 AdmissionReview; the response echoes the
+request UID.  TLS/cert bootstrap (policy.go:81-100) is deployment
+machinery a cluster would provide; the serving semantics live here.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from gatekeeper_tpu.webhook.policy import ValidationHandler
+
+WEBHOOK_PATH = "/v1/admit"
+DEFAULT_PORT = 8443          # the reference defaults to 443 (policy.go:48)
+
+
+class WebhookServer:
+    def __init__(self, handler: ValidationHandler, port: int = DEFAULT_PORT,
+                 host: str = "127.0.0.1"):
+        self.handler = handler
+        outer = self
+
+        class _HTTPHandler(BaseHTTPRequestHandler):
+            def log_message(self, *args):  # quiet
+                pass
+
+            def do_POST(self):
+                if self.path != WEBHOOK_PATH:
+                    self.send_error(404)
+                    return
+                try:
+                    length = int(self.headers.get("Content-Length", "0"))
+                    body = json.loads(self.rfile.read(length) or b"{}")
+                    request = body.get("request") or {}
+                    response = outer.handler.handle(request)
+                    envelope = {
+                        "apiVersion": body.get("apiVersion",
+                                               "admission.k8s.io/v1beta1"),
+                        "kind": "AdmissionReview",
+                        "response": {"uid": request.get("uid", ""),
+                                     **response},
+                    }
+                    payload = json.dumps(envelope).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(payload)))
+                    self.end_headers()
+                    self.wfile.write(payload)
+                except Exception as e:  # malformed body etc.
+                    self.send_error(400, str(e))
+
+        self._server = ThreadingHTTPServer((host, port), _HTTPHandler)
+        self.port = self._server.server_address[1]
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> None:
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._server.serve_forever, daemon=True,
+                name="webhook-server")
+            self._thread.start()
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None

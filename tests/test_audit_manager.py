@@ -1,0 +1,135 @@
+"""Audit manager tests: sweep semantics, cap, truncation, status writes
+with backoff (reference pkg/audit/manager.go:84-119,161-199,250-379).
+"""
+
+import pytest
+
+from gatekeeper_tpu.api.config import GVK
+from gatekeeper_tpu.audit.manager import (CRD_NAME, AuditManager,
+                                          truncate_message)
+from gatekeeper_tpu.client.client import Backend
+from gatekeeper_tpu.client.local_driver import LocalDriver
+from gatekeeper_tpu.cluster.fake import FakeCluster
+from gatekeeper_tpu.controllers.constrainttemplate import (CRD_GVK,
+                                                           TEMPLATE_GVK)
+from gatekeeper_tpu.controllers.registry import add_to_manager
+from gatekeeper_tpu.engine.jax_driver import JaxDriver
+from gatekeeper_tpu.target.k8s import K8sValidationTarget
+from tests.test_control_plane import (NS_GVK, constraint_obj, ns_obj,
+                                      template_obj)
+
+CON_GVK = GVK("constraints.gatekeeper.sh", "v1alpha1", "K8sRequiredLabels")
+
+
+def template_crd_obj():
+    return {"apiVersion": "apiextensions.k8s.io/v1beta1",
+            "kind": "CustomResourceDefinition",
+            "metadata": {"name": CRD_NAME},
+            "spec": {"group": "templates.gatekeeper.sh",
+                     "version": "v1alpha1",
+                     "names": {"kind": "ConstraintTemplate",
+                               "plural": "constrainttemplates"}}}
+
+
+@pytest.fixture(params=["local", "jax"])
+def setup(request):
+    cluster = FakeCluster()
+    cluster.register_kind(TEMPLATE_GVK, "constrainttemplates")
+    cluster.register_kind(NS_GVK, "namespaces")
+    driver = LocalDriver() if request.param == "local" else JaxDriver()
+    client = Backend(driver).new_client([K8sValidationTarget()])
+    plane = add_to_manager(cluster, client)
+    cluster.create(template_crd_obj())
+    sleeps = []
+    am = AuditManager(cluster, client, sleep=sleeps.append)
+    return cluster, client, plane, am, sleeps
+
+
+def ingest_namespaces(cluster, client, n=30, labeled_every=3):
+    for i in range(n):
+        labels = {"gatekeeper": "on"} if i % labeled_every == 0 else None
+        obj = ns_obj(f"ns{i:03d}", labels)
+        cluster.create(obj)
+        client.add_data(obj)
+
+
+class TestTruncation:
+    def test_truncate_rules(self):
+        assert truncate_message("x" * 256) == "x" * 256
+        out = truncate_message("x" * 300)
+        assert len(out) == 256 and out.endswith("...")
+
+
+class TestAuditManager:
+    def test_skips_without_crd(self, setup):
+        cluster, client, plane, am, _ = setup
+        cluster.delete(CRD_GVK, CRD_NAME)
+        report = am.audit_once()
+        assert report["skipped"] is True
+
+    def test_sweep_writes_statuses(self, setup):
+        cluster, client, plane, am, _ = setup
+        ingest_namespaces(cluster, client, n=30)
+        cluster.create(template_obj())
+        plane.run_until_idle()
+        cluster.create(constraint_obj())
+        plane.run_until_idle()
+
+        report = am.audit_once()
+        assert report["skipped"] is False
+        assert report["violations"] == 20  # capped at the default limit
+        assert report["constraints_updated"] == 1
+
+        con = cluster.get(CON_GVK, "ns-must-have-gk")
+        viol = con["status"]["violations"]
+        assert len(viol) == 20
+        assert con["status"]["auditTimestamp"]
+        assert all(v["kind"] == "Namespace" for v in viol)
+        assert all(v["enforcementAction"] == "deny" for v in viol)
+        assert all(len(v["message"]) <= 256 for v in viol)
+
+    def test_empty_violations_removed(self, setup):
+        cluster, client, plane, am, _ = setup
+        ingest_namespaces(cluster, client, n=6, labeled_every=1)  # all labeled
+        cluster.create(template_obj())
+        plane.run_until_idle()
+        cluster.create(constraint_obj())
+        plane.run_until_idle()
+        # seed a stale violations status
+        con = cluster.get(CON_GVK, "ns-must-have-gk")
+        con.setdefault("status", {})["violations"] = [{"kind": "Namespace"}]
+        cluster.update(con)
+
+        report = am.audit_once()
+        assert report["violations"] == 0
+        con = cluster.get(CON_GVK, "ns-must-have-gk")
+        assert "violations" not in con["status"]
+        assert con["status"]["auditTimestamp"]
+
+    def test_status_write_backoff(self, setup):
+        cluster, client, plane, am, sleeps = setup
+        ingest_namespaces(cluster, client, n=4, labeled_every=100)
+        cluster.create(template_obj())
+        plane.run_until_idle()
+        cluster.create(constraint_obj())
+        plane.run_until_idle()
+
+        cluster.inject_update_failures(2)
+        report = am.audit_once()
+        assert report["constraints_updated"] == 1
+        assert sleeps == [1.0, 2.0]  # exponential backoff rounds
+        con = cluster.get(CON_GVK, "ns-must-have-gk")
+        assert len(con["status"]["violations"]) == 3
+
+    def test_loop_runs_and_stops(self, setup):
+        cluster, client, plane, am, _ = setup
+        am.interval = 0.01
+        am._sleep = lambda s: None
+        am.start()
+        import time
+        deadline = time.time() + 5
+        while not am.last_sweep and time.time() < deadline:
+            time.sleep(0.01)
+        am.stop()
+        assert am.last_sweep  # at least one sweep ran
+        assert am.metrics.counter("audit_sweeps").value >= 1

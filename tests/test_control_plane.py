@@ -381,3 +381,108 @@ class TestControllersEndToEnd:
         assert cluster.try_get(con_gvk, "ns-must-have-gk") is None
         # engine no longer has the constraint
         assert client.constraints.get("K8sRequiredLabels") == {}
+
+
+# ---------------------------------------------------------------------------
+# lifecycle deadlock regressions (round-2 code-review findings)
+
+
+class TestLifecycleDeadlocks:
+    def test_broken_template_still_deletable(self, plane):
+        """A template whose Rego stops compiling must still tear down on
+        delete (the reference leaks its finalizer in this case)."""
+        cluster, client = plane.cluster, plane.client
+        cluster.create(template_obj())
+        plane.run_until_idle()
+        assert "K8sRequiredLabels" in client.templates
+        # break the rego in-place
+        tmpl = cluster.get(TEMPLATE_GVK, "k8srequiredlabels")
+        tmpl["spec"]["targets"][0]["rego"] = "package foo\nnot rego at all"
+        cluster.update(tmpl)
+        plane.run_until_idle()
+        # now delete: finalizer must be released and everything torn down
+        cluster.delete(TEMPLATE_GVK, "k8srequiredlabels")
+        plane.run_until_idle()
+        assert cluster.try_get(TEMPLATE_GVK, "k8srequiredlabels") is None
+        assert cluster.try_get(
+            CRD_GVK, "k8srequiredlabels.constraints.gatekeeper.sh") is None
+        assert "K8sRequiredLabels" not in client.templates
+
+    def test_template_delete_cascades_constraints(self, plane):
+        """CRD delete cascades to constraints; their finalizers are
+        stripped by the (still-watching) constraint reconciler before the
+        CRD finishes terminating."""
+        cluster, client = plane.cluster, plane.client
+        cluster.create(template_obj())
+        plane.run_until_idle()
+        cluster.create(constraint_obj())
+        plane.run_until_idle()
+        con_gvk = GVK("constraints.gatekeeper.sh", "v1alpha1",
+                      "K8sRequiredLabels")
+        cluster.delete(TEMPLATE_GVK, "k8srequiredlabels")
+        plane.run_until_idle()
+        assert cluster.try_get(con_gvk, "ns-must-have-gk") is None
+        assert cluster.try_get(TEMPLATE_GVK, "k8srequiredlabels") is None
+        assert cluster.try_get(
+            CRD_GVK, "k8srequiredlabels.constraints.gatekeeper.sh") is None
+        assert client.constraints.get("K8sRequiredLabels") is None
+
+    def test_config_delete_waits_for_cleanup(self, plane):
+        """Config deletion must not release its own finalizer while sync
+        finalizers remain (the durable allFinalizers record would die
+        with the object)."""
+        cluster = plane.cluster
+        cfg = empty_config_object()
+        cfg["spec"] = {"sync": {"syncOnly": [
+            {"group": "", "version": "v1", "kind": "Namespace"}]}}
+        cluster.create(cfg)
+        cluster.create(ns_obj("a"))
+        plane.run_until_idle()
+        assert "finalizers.gatekeeper.sh/sync" in \
+            cluster.get(NS_GVK, "a")["metadata"]["finalizers"]
+        # fail the first cleanup attempt, then delete the config
+        cluster.inject_update_failures(1)
+        cluster.delete(CONFIG_GVK, "config", "gatekeeper-system")
+        plane.run_until_idle()
+        # the retry succeeded: namespace finalizer stripped, config gone
+        assert not cluster.get(NS_GVK, "a")["metadata"].get("finalizers")
+        assert cluster.try_get(CONFIG_GVK, "config",
+                               "gatekeeper-system") is None
+
+    def test_reconciler_exception_does_not_kill_worker(self):
+        cluster = FakeCluster()
+        mgr = ControllerManager(cluster, max_attempts=3)
+
+        class Boom:
+            name = "boom"
+
+            def reconcile(self, request):
+                raise ValueError("not an ApiError")
+
+        from gatekeeper_tpu.controllers.runtime import Request
+        mgr.start()
+        try:
+            mgr.enqueue(Boom(), Request(name="x"))
+            import time
+            deadline = time.time() + 5
+            while not mgr.errors and time.time() < deadline:
+                time.sleep(0.01)
+            assert mgr.errors
+            # worker still alive: a well-behaved item is processed
+            seen = []
+
+            class Ok:
+                name = "ok"
+
+                def reconcile(self, request):
+                    seen.append(request.name)
+                    from gatekeeper_tpu.controllers.runtime import DONE
+                    return DONE
+
+            mgr.enqueue(Ok(), Request(name="y"))
+            deadline = time.time() + 5
+            while not seen and time.time() < deadline:
+                time.sleep(0.01)
+            assert seen == ["y"]
+        finally:
+            mgr.stop()
