@@ -149,7 +149,11 @@ class JaxDriver(LocalDriver):
         row_order = {row: i for i, row in enumerate(ordered_rows)}
         rank = self._row_rank(st, row_order)
 
-        tagged: list[tuple[tuple, Result]] = []
+        # phase 1: dispatch every kind's device evaluation without
+        # blocking — one packed-fetch round-trip per kind, all in
+        # flight at once (run_topk_async; the tunnel latency of fetch
+        # N overlaps the execution of fetch N+1)
+        plans: list[tuple] = []
         for kind in sorted(st.templates):
             compiled = st.templates[kind]
             constraints = self._kind_constraints(st, kind)
@@ -160,13 +164,29 @@ class JaxDriver(LocalDriver):
                 bindings = self._kind_bindings(st, kind, compiled, constraints)
                 prog = compiled.vectorized.program
                 if limit is not None:
-                    self._format_topk(st, target, handler, compiled, constraints,
-                                      prog, bindings, mask, rank, row_order,
-                                      kind, limit, trace, tagged)
+                    handle = self.executor.run_topk_async(
+                        prog, bindings, limit, match=mask, rank=rank)
+                    plans.append(("topk", kind, compiled, constraints, prog,
+                                  bindings, mask, handle))
                 else:
-                    cand = self.executor.run(prog, bindings, match=mask)
-                    self._format_pairs(st, target, handler, compiled, constraints,
-                                       cand, row_order, kind, limit, trace, tagged)
+                    handle = self.executor.run_async(prog, bindings, match=mask)
+                    plans.append(("mask", kind, compiled, constraints, prog,
+                                  bindings, mask, handle))
+            else:
+                plans.append(("scalar", kind, compiled, constraints, None,
+                              None, mask, None))
+
+        # phase 2: host formatting per kind
+        tagged: list[tuple[tuple, Result]] = []
+        for mode, kind, compiled, constraints, prog, bindings, mask, handle in plans:
+            if mode == "topk":
+                self._format_topk(st, target, handler, compiled, constraints,
+                                  prog, bindings, mask, rank, row_order,
+                                  kind, limit, trace, tagged, handle)
+            elif mode == "mask":
+                self._format_pairs(st, target, handler, compiled, constraints,
+                                   handle.get(), row_order, kind, limit, trace,
+                                   tagged)
             else:
                 self._scalar_kind(st, target, handler, compiled, constraints,
                                   mask, ordered_rows, row_order, kind, limit,
@@ -216,14 +236,16 @@ class JaxDriver(LocalDriver):
 
     def _format_topk(self, st, target, handler, compiled, constraints,
                      prog, bindings, mask, rank, row_order, kind, limit,
-                     trace, tagged):
+                     trace, tagged, handle=None):
         """Capped audit: device finds the first-k candidate rows per
         constraint (in scalar cap order, via rank); the host formats
         only those.  If over-approximated pairs leave the cap
         under-filled while more candidates exist, fall back to the full
         mask for that constraint."""
-        counts, rows, valid = self.executor.run_topk(prog, bindings, limit,
-                                                     match=mask, rank=rank)
+        if handle is None:
+            handle = self.executor.run_topk_async(prog, bindings, limit,
+                                                  match=mask, rank=rank)
+        counts, rows, valid = handle.get()
         full_cand = None
         for ci, c in enumerate(constraints):
             sel = [int(r) for r, v in zip(rows[ci], valid[ci]) if v]

@@ -30,7 +30,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from gatekeeper_tpu.ir.prep import Bindings
+from gatekeeper_tpu.ir.prep import Bindings, binding_axes
 from gatekeeper_tpu.ir.program import Node, Program, RuleSpec
 
 _3D = (1, 1, 1)
@@ -94,19 +94,15 @@ class _Evaluator:
             val = self.arrays[tname + ".v"][ci]
             return d_i & ok, val
         if op in ("ptable_any", "ptable_all"):
+            # pre-combined per-constraint table (ir/prep.py): one gather,
+            # no [C, K, R, E] per-param axis on device
             tname, _ = n.meta
             d_i, idx = self.node(n.args[0])
-            tbl = self.arrays[tname]                       # [P, T]
-            pidx = self.arrays[tname + ".idx"]             # [C, K]
-            pval = self.arrays[tname + ".valid"]           # [C, K]
-            by_val = tbl[:, jnp.clip(idx, 0, None)]        # [P, 1|C, R, E]
-            by_val = by_val.reshape(by_val.shape[0], *by_val.shape[-2:])  # [P,R,E]
-            per_k = by_val[pidx]                           # [C, K, R, E]
-            m = pval[:, :, None, None]
-            if op == "ptable_any":
-                v = jnp.any(per_k & m, axis=1)
-            else:
-                v = jnp.all(per_k | ~m, axis=1)
+            vmap = self.arrays[tname + ".vmap"]            # [T] -> dense u
+            tbl = self.arrays[tname + (".any" if op == "ptable_any" else ".all")]
+            sentinel = tbl.shape[1] - 1
+            u = jnp.where(idx >= 0, vmap[jnp.clip(idx, 0, None)], sentinel)
+            v = tbl[:, u[0]]                               # [C, R, E]
             return d_i & jnp.ones_like(v), v
         if op == "cmp":
             (cop,) = n.meta
@@ -141,28 +137,29 @@ class _Evaluator:
             (cname,) = n.meta
             d_i, idx = self.node(n.args[0])
             # idx must be r/e-axis ([1, R, E]); the lowerer guarantees this
-            ids = self.arrays[cname + ".idx"]              # [C, K] global ids
-            valid = self.arrays[cname + ".valid"]
-            eq = ids[:, :, None, None] == idx              # [C, K, R, E]
-            v = jnp.any(eq & valid[:, :, None, None], axis=1)
+            vmap = self.arrays[cname + ".vmap"]            # [T] -> dense u
+            bitmap = self.arrays[cname + ".bitmap"]        # [C, U]
+            sentinel = bitmap.shape[1] - 1
+            u = jnp.where(idx >= 0, vmap[jnp.clip(idx, 0, None)], sentinel)
+            v = bitmap[:, u[0]]                            # [C, R, E]
             return d_i & jnp.ones_like(v), v
-        if op == "cset_not_subset_memb":
+        if op in ("cset_not_subset_memb", "cset_subset_memb"):
+            # required-keys subset test as a bf16 matmul on the MXU:
+            # miss[c, r] = |{l : B[c, l] & ~memb[l, r]}| — exact in f32
+            # accumulation (0/1 operands, L < 2^24)
             cname, mname = n.meta
             memb = self.arrays[mname]                      # [L, R]
-            lidx = self.arrays[cname + ".idx"]             # [C, K] local ids
-            valid = self.arrays[cname + ".valid"]
-            present = memb[lidx]                           # [C, K, R]
-            missing = jnp.any(~present & valid[:, :, None], axis=1)  # [C, R]
-            v = missing[:, :, None]
-            return jnp.ones_like(v), v
-        if op == "cset_subset_memb":
-            cname, mname = n.meta
-            memb = self.arrays[mname]
-            lidx = self.arrays[cname + ".idx"]
-            valid = self.arrays[cname + ".valid"]
-            present = memb[lidx]
-            allp = jnp.all(present | ~valid[:, :, None], axis=1)
-            v = allp[:, :, None]
+            B = self.arrays[cname + ".B"]                  # [C, L]
+            # bf16 feeds the MXU natively; CPU (tests) lacks bf16 dot
+            mm = jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32
+            miss = jax.lax.dot_general(
+                B.astype(mm), (~memb).astype(mm),
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)        # [C, R]
+            if op == "cset_not_subset_memb":
+                v = (miss > 0.5)[:, :, None]
+            else:
+                v = (miss < 0.5)[:, :, None]
             return jnp.ones_like(v), v
         if op in ("any_e", "all_e", "count_e"):
             (axis,) = n.meta
@@ -229,6 +226,92 @@ def _eval_program(program: Program, arrays: dict[str, jax.Array]) -> jax.Array:
     return viol
 
 
+R_CHUNK = 1 << 16
+"""Rows per device evaluation chunk.  Above this, the [C, R(, E)]
+intermediates are produced chunk-by-chunk under a ``lax.scan`` so peak
+HBM stays bounded regardless of inventory size (SURVEY §7 step 9);
+top-k and counts merge across chunks on device."""
+
+
+def _r_axis(name: str) -> int | None:
+    """Which dim of a bound array is the resource axis (None if none).
+    Derived from the shared prep naming convention (ir/prep.binding_axes,
+    also the source of truth for multi-chip sharding); unknown binding
+    names raise there rather than silently skipping the chunk slice."""
+    axes = binding_axes(name)
+    return axes.index("r") if "r" in axes else None
+
+
+def _slice_r(name: str, arr: jax.Array, off, rc: int) -> jax.Array:
+    ax = _r_axis(name)
+    if ax is None:
+        return arr
+    return jax.lax.dynamic_slice_in_dim(arr, off, rc, axis=ax)
+
+
+def _n_chunks(r_pad: int) -> int:
+    if r_pad <= R_CHUNK or r_pad % R_CHUNK != 0:
+        return 1
+    return r_pad // R_CHUNK
+
+
+def _eval_mask(program: Program, d: dict[str, jax.Array]) -> jax.Array:
+    """Full violation mask [C, R], chunked over R when large."""
+    r_pad = d["__alive__"].shape[0]
+    c_pad = d["__cvalid__"].shape[0]
+    nc = _n_chunks(r_pad)
+    if nc == 1:
+        return _eval_program(program, d)
+    rc = r_pad // nc
+
+    def body(_, i):
+        dd = {nm: _slice_r(nm, a, i * rc, rc) for nm, a in d.items()}
+        return None, _eval_program(program, dd)
+
+    _, ys = jax.lax.scan(body, None, jnp.arange(nc))   # [nc, C, rc]
+    return jnp.moveaxis(ys, 0, 1).reshape(c_pad, r_pad)
+
+
+def _eval_topk(program: Program, d: dict[str, jax.Array], k: int):
+    """Violation top-k, chunked over R: per-chunk lax.top_k merged into
+    a running [C, k] best set (scores are globally comparable:
+    ``r_pad - global_rank``), counts psum'd across chunks."""
+    r_pad = d["__alive__"].shape[0]
+    c_pad = d["__cvalid__"].shape[0]
+    nc = _n_chunks(r_pad)
+    if nc == 1:
+        viol = _eval_program(program, d)
+        return topk_reduce(viol, k, d.get("__rank__"))
+    rc = r_pad // nc
+    k_out = min(k, r_pad)
+    k_eff = min(k_out, rc)
+
+    def body(carry, i):
+        off = i * rc
+        dd = {nm: _slice_r(nm, a, off, rc) for nm, a in d.items()}
+        viol = _eval_program(program, dd)              # [C, rc]
+        cnt = jnp.sum(viol, axis=1, dtype=jnp.int32)
+        rank = dd.get("__rank__")
+        if rank is None:
+            rank = off + jnp.arange(rc, dtype=jnp.int32)
+        score = jnp.where(viol, r_pad - rank[None, :], 0)
+        vals, rows = jax.lax.top_k(score, k_eff)
+        rows = rows + off
+        bs, br, bc = carry
+        ms, mi = jax.lax.top_k(jnp.concatenate([bs, vals], axis=1), k_out)
+        mr = jnp.take_along_axis(jnp.concatenate([br, rows], axis=1), mi, axis=1)
+        return (ms, mr, bc + cnt), None
+
+    init = (jnp.zeros((c_pad, k_out), jnp.int32),
+            jnp.zeros((c_pad, k_out), jnp.int32),
+            jnp.zeros((c_pad,), jnp.int32))
+    (vals, rows, counts), _ = jax.lax.scan(body, init, jnp.arange(nc))
+    if k_out < k:
+        vals = jnp.pad(vals, ((0, 0), (0, k - k_out)))
+        rows = jnp.pad(rows, ((0, 0), (0, k - k_out)))
+    return counts, rows, vals > 0
+
+
 def pad_rank(rank: np.ndarray, r_pad: int) -> np.ndarray:
     """Pad a [n_rows] rank array to [r_pad].  The fill must stay within
     [live-rank, r_pad) so padded rows can never outscore live ones in
@@ -267,6 +350,34 @@ def topk_reduce(viol: jax.Array, k: int, rank: jax.Array | None = None):
     return counts, rows, vals > 0
 
 
+class PendingMask:
+    """In-flight full violation mask (see run_async)."""
+
+    def __init__(self, mask, n_constraints: int, n_resources: int):
+        self._mask = mask
+        self._nc = n_constraints
+        self._nr = n_resources
+
+    def get(self) -> np.ndarray:
+        return np.asarray(self._mask)[: self._nc, : self._nr]
+
+
+class PendingTopK:
+    """In-flight packed top-k result (see run_topk_async)."""
+
+    def __init__(self, packed, n_constraints: int, k: int):
+        self._packed = packed
+        self._nc = n_constraints
+        self._k = k
+
+    def get(self):
+        p = np.asarray(self._packed)[: self._nc]
+        counts = p[:, 0]
+        rows = p[:, 1: 1 + self._k]
+        valid = p[:, 1 + self._k:].astype(bool)
+        return counts, rows, valid
+
+
 class ProgramExecutor:
     """Jit-cache wrapper: one compiled executable per (program, bucket)."""
 
@@ -296,22 +407,40 @@ class ProgramExecutor:
 
     def _compiled(self, program: Program, arrays: dict, topk: int | None):
         names = tuple(sorted(arrays))
-        key = (program.cache_key(), topk,
+        key = (program.cache_key(), topk, R_CHUNK,
                tuple((nm,) + tuple(arrays[nm].shape)
                      + (str(arrays[nm].dtype),) for nm in names))
         fn = self._cache.get(key)
         if fn is None:
             if topk is None:
                 def raw(args: tuple):
-                    return _eval_program(program, dict(zip(names, args)))
+                    return _eval_mask(program, dict(zip(names, args)))
             else:
                 def raw(args: tuple):
-                    d = dict(zip(names, args))
-                    viol = _eval_program(program, d)
-                    return topk_reduce(viol, topk, d.get("__rank__"))
+                    counts, rows, valid = _eval_topk(
+                        program, dict(zip(names, args)), topk)
+                    return jnp.concatenate(
+                        [counts[:, None], rows, valid.astype(jnp.int32)],
+                        axis=1)                    # packed [C, 1+2k]
             fn = jax.jit(raw)
             self._cache[key] = fn
         return fn, names
+
+    def run_async(self, program: Program, bindings: Bindings,
+                  match: np.ndarray | None = None,
+                  rank: np.ndarray | None = None) -> "PendingMask":
+        """Dispatch a full-mask evaluation without blocking; .get()
+        yields the violation mask trimmed to [n_constraints,
+        n_resources].  Like run_topk_async, the host copy starts
+        eagerly so per-kind fetch round-trips overlap."""
+        arrays = self._arrays(bindings, match, rank)
+        fn, names = self._compiled(program, arrays, None)
+        mask = fn(tuple(arrays[nm] for nm in names))
+        try:
+            mask.copy_to_host_async()
+        except AttributeError:
+            pass
+        return PendingMask(mask, bindings.n_constraints, bindings.n_resources)
 
     def run(self, program: Program, bindings: Bindings,
             match: np.ndarray | None = None,
@@ -322,22 +451,31 @@ class ProgramExecutor:
         caller alternating run_topk/run on the same bindings (the capped
         audit's under-fill fallback) must pass the same rank instance to
         keep the single-slot device cache hot."""
+        return self.run_async(program, bindings, match, rank).get()
+
+    def run_topk_async(self, program: Program, bindings: Bindings, k: int,
+                       match: np.ndarray | None = None,
+                       rank: np.ndarray | None = None) -> "PendingTopK":
+        """Dispatch evaluate + device top-k without blocking; returns a
+        PendingTopK whose .get() yields (counts [C], rows [C, k],
+        valid [C, k]) trimmed to the live constraint count.
+
+        The three outputs are packed into ONE [C, 1+2k] int32 array on
+        device and the host copy is started eagerly: when the accelerator
+        sits behind a high-latency transport (axon tunnel ~100ms/fetch),
+        one audit sweep pays one round-trip per kind — all overlapping —
+        instead of three serialized fetches per kind."""
         arrays = self._arrays(bindings, match, rank)
-        fn, names = self._compiled(program, arrays, None)
-        mask = np.asarray(fn(tuple(arrays[nm] for nm in names)))
-        return mask[: bindings.n_constraints, : bindings.n_resources]
+        fn, names = self._compiled(program, arrays, k)
+        packed = fn(tuple(arrays[nm] for nm in names))
+        try:
+            packed.copy_to_host_async()
+        except AttributeError:
+            pass
+        return PendingTopK(packed, bindings.n_constraints, k)
 
     def run_topk(self, program: Program, bindings: Bindings, k: int,
                  match: np.ndarray | None = None,
                  rank: np.ndarray | None = None):
-        """Evaluate + device top-k: (counts [C], rows [C, k], valid
-        [C, k]) trimmed to the live constraint count.  The full mask
-        never leaves the device.  `rank` (see topk_reduce) orders the
-        capped subset; callers must reuse the same array instance across
-        steady-state sweeps to keep the device cache warm."""
-        arrays = self._arrays(bindings, match, rank)
-        fn, names = self._compiled(program, arrays, k)
-        counts, rows, valid = fn(tuple(arrays[nm] for nm in names))
-        nc = bindings.n_constraints
-        return (np.asarray(counts)[:nc], np.asarray(rows)[:nc],
-                np.asarray(valid)[:nc])
+        """Blocking convenience wrapper around run_topk_async."""
+        return self.run_topk_async(program, bindings, k, match, rank).get()

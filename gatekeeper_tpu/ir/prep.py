@@ -48,6 +48,44 @@ def bucket(n: int, minimum: int = 8) -> int:
     return b
 
 
+def binding_axes(name: str) -> tuple:
+    """Logical axes of one bound array, by the prep naming convention:
+    'c' (constraints), 'r' (resources), or None (replicated/table) per
+    dim.  Single source of truth for multi-chip sharding
+    (parallel/sharding.binding_spec) and R-chunking (engine/veval).
+    Raises on unknown names — a new binding kind silently replicated or
+    left unchunked would mis-shard or shape-crash downstream."""
+    base = name.split(".")[0]
+    if name == "__match__":
+        return ("c", "r")
+    if name in ("__alive__", "__rank__"):
+        return ("r",)
+    if name == "__cvalid__":
+        return ("c",)
+    if name.startswith("__elem__:") or base.startswith("e:"):
+        return ("r", None)
+    if base.startswith("r:"):
+        return ("r",)
+    if base.startswith("m") and base[1:].isdigit():
+        return (None, "r")                       # memb [L, R]
+    if base.startswith("cs") and base[2:].isdigit():
+        if name.endswith(".vmap"):
+            return (None,)                       # global id -> dense u [T]
+        return ("c", None)                       # .bitmap / .B [C, U|L]
+    if base.startswith("cv") and base[2:].isdigit():
+        return ("c",)                            # cval [C] (.v/.p too)
+    if base.startswith("cb") and base[2:].isdigit():
+        return ("c",)                            # per-constraint bool [C]
+    if base.startswith("pt") and base[2:].isdigit():
+        if name.endswith(".vmap"):
+            return (None,)                       # global id -> dense u [T]
+        return ("c", None)                       # .any / .all [C, U]
+    if base.startswith("t") and base[1:].isdigit():
+        return (None,)                           # unary table [T]
+    raise ValueError(f"binding_axes: unrecognized binding {name!r}; "
+                     f"add its axes rule here")
+
+
 # ---------------------------------------------------------------------------
 # prep spec: declarative requests emitted by the lowerer
 
@@ -380,7 +418,16 @@ def build_bindings(spec: PrepSpec, table: ResourceTable,
         out[tr.name + ".ok"] = ok
         out[tr.name + ".v"] = vals
 
-    # ---- parametric tables + per-constraint param index sets
+    # ---- parametric tables, pre-combined per constraint
+    #
+    # The [n_params, n_values] predicate table and the per-constraint
+    # param index sets are folded on host into dense per-constraint
+    # tables over the *distinct* source values:
+    #   vmap  [t_pad]      global value id -> dense u (sentinel = U-1)
+    #   .any  [c_pad, U]   OR  over the constraint's params of fn(v, p)
+    #   .all  [c_pad, U]   AND over the constraint's params (vacuous True)
+    # The device never materializes a [C, K, R, E] per-param axis — one
+    # gather per evaluation, O(C*U) bytes of table.
     for pt in spec.ptables:
         per_con: list[list] = []
         distinct: dict[str, int] = {}
@@ -397,28 +444,38 @@ def build_bindings(spec: PrepSpec, table: ResourceTable,
         src_ids = _src_ids(out, pt.src)
         uniq = np.unique(src_ids)
         uniq = uniq[uniq >= 0]
-        p_pad = bucket(max(len(distinct), 1), minimum=2)
         t_pad = bucket(len(interner), minimum=8)
-        tbl = np.zeros((p_pad, t_pad), dtype=bool)
-        plist = list(distinct)
-        for pi, pstr in enumerate(plist):
-            for uid in uniq.tolist():
+        u_pad = bucket(len(uniq) + 1, minimum=2)   # +1: sentinel slot
+        vmap = np.full((t_pad,), u_pad - 1, dtype=np.int32)
+        vmap[uniq] = np.arange(len(uniq), dtype=np.int32)
+        tbl = np.zeros((len(distinct), u_pad), dtype=bool)
+        for pi, pstr in enumerate(distinct):
+            for u, uid in enumerate(uniq.tolist()):
                 key = interner.string(uid)
                 arg = decode_value(key) if pt.src_val else key
                 v = _eval_host(pt.fn, arg, pstr)
-                tbl[pi, uid] = bool(v) if v is not None and v is not False else False
-        out[pt.name] = tbl
-        k_pad = bucket(max((len(x) for x in per_con), default=1), minimum=2)
-        idx = np.full((c_pad, k_pad), 0, dtype=np.int32)
-        valid = np.zeros((c_pad, k_pad), dtype=bool)
+                tbl[pi, u] = bool(v) if v is not None and v is not False else False
+        t_any = np.zeros((c_pad, u_pad), dtype=bool)
+        t_all = np.zeros((c_pad, u_pad), dtype=bool)
         for ci, lst in enumerate(per_con):
-            for k, pi in enumerate(lst):
-                idx[ci, k] = pi
-                valid[ci, k] = True
-        out[pt.name + ".idx"] = idx
-        out[pt.name + ".valid"] = valid
+            if lst:
+                t_any[ci] = tbl[lst].any(axis=0)
+                t_all[ci] = tbl[lst].all(axis=0)
+            else:
+                t_all[ci] = True                   # vacuous all-of-none
+        out[pt.name + ".vmap"] = vmap
+        out[pt.name + ".any"] = t_any
+        out[pt.name + ".all"] = t_all
 
     # ---- per-constraint id sets
+    #
+    # Two consumption forms, both K-axis-free on device:
+    # - with a paired membership matrix (subset ops): a [c_pad, l_pad]
+    #   indicator ``B`` — the subset test becomes one bf16 matmul
+    #   B @ ~memb on the MXU (engine/veval.py);
+    # - otherwise (``in_cset``): ``vmap`` [t_pad] global id -> dense u
+    #   over the union of set values, plus a [c_pad, U] ``bitmap``
+    #   (sentinel column U-1 = not in any constraint's set).
     memb_by_cset = {m.cset: m for m in spec.membs}
     for cs in spec.csets:
         per_con = []
@@ -436,24 +493,30 @@ def build_bindings(spec: PrepSpec, table: ResourceTable,
                             lst.append(interner.intern(key))
             per_con.append(lst)
         m = memb_by_cset.get(cs.name)
+        needed = sorted({i for lst in per_con for i in lst})
+        local = {gid: li for li, gid in enumerate(needed)}
         if m is not None:
-            # re-index into a local [0, L) axis + membership matrix
-            needed = sorted({i for lst in per_con for i in lst})
-            local = {gid: li for li, gid in enumerate(needed)}
             l_pad = bucket(max(len(needed), 1), minimum=2)
             memb = np.zeros((l_pad, r_pad), dtype=bool)
             _fill_membership(memb, objs, m.keys_path, needed, local, interner)
             out[m.name] = memb
-            per_con = [[local[g] for g in lst] for lst in per_con]
-        k_pad = bucket(max((len(x) for x in per_con), default=1), minimum=2)
-        idx = np.full((c_pad, k_pad), 0, dtype=np.int32)
-        valid = np.zeros((c_pad, k_pad), dtype=bool)
-        for ci, lst in enumerate(per_con):
-            for k, gi in enumerate(lst):
-                idx[ci, k] = gi
-                valid[ci, k] = True
-        out[cs.name + ".idx"] = idx
-        out[cs.name + ".valid"] = valid
+            B = np.zeros((c_pad, l_pad), dtype=bool)
+            for ci, lst in enumerate(per_con):
+                for gid in lst:
+                    B[ci, local[gid]] = True
+            out[cs.name + ".B"] = B
+        else:
+            t_pad = bucket(len(interner), minimum=8)
+            u_pad = bucket(len(needed) + 1, minimum=2)   # +1: sentinel
+            vmap = np.full((t_pad,), u_pad - 1, dtype=np.int32)
+            for gid, li in local.items():
+                vmap[gid] = li
+            bitmap = np.zeros((c_pad, u_pad), dtype=bool)
+            for ci, lst in enumerate(per_con):
+                for gid in lst:
+                    bitmap[ci, local[gid]] = True
+            out[cs.name + ".vmap"] = vmap
+            out[cs.name + ".bitmap"] = bitmap
 
     # ---- per-constraint scalars
     for cv in spec.cvals:

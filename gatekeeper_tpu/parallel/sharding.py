@@ -33,43 +33,16 @@ from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from gatekeeper_tpu.engine.veval import _eval_program, pad_rank, topk_reduce
-from gatekeeper_tpu.ir.prep import Bindings
+from gatekeeper_tpu.ir.prep import Bindings, binding_axes
 from gatekeeper_tpu.ir.program import Program
 
 
 def binding_spec(name: str, arr: np.ndarray) -> P:
-    """PartitionSpec for one bound array, by the prep naming convention
-    (ir/prep.py emits every kind listed here): resources shard on 'r',
-    constraints on 'c', lookup tables replicate.  Unknown names raise —
-    a new binding kind silently replicated would broadcast-crash (or
-    worse, silently mis-shard) inside shard_map."""
-    base = name.split(".")[0]
-    if name == "__match__":
-        return P("c", "r")
-    if name in ("__alive__", "__rank__"):
-        return P("r")
-    if name == "__cvalid__":
-        return P("c")
-    if name.startswith("__elem__:") or base.startswith("e:"):
-        return P("r", None)
-    if base.startswith("r:"):
-        return P("r")
-    if base.startswith("m") and base[1:].isdigit():
-        return P(None, "r")                      # memb [L, R]
-    if base.startswith("cs") and base[2:].isdigit():
-        return P("c", None)                      # cset [C, K]
-    if base.startswith("cv") and base[2:].isdigit():
-        return P("c")                            # cval [C] (.v/.p too)
-    if base.startswith("cb") and base[2:].isdigit():
-        return P("c")                            # per-constraint bool [C]
-    if base.startswith("pt") and base[2:].isdigit():
-        if name.endswith(".idx") or name.endswith(".valid"):
-            return P("c", None)                  # param index sets [C, K]
-        return P(None, None)                     # ptable [P, T] replicated
-    if base.startswith("t") and base[1:].isdigit():
-        return P(None)                           # unary table [T]
-    raise ValueError(f"binding_spec: unrecognized binding {name!r} "
-                     f"(shape {arr.shape}); add its sharding rule here")
+    """PartitionSpec for one bound array: resources shard on 'r',
+    constraints on 'c', lookup tables replicate.  The axes convention
+    lives in ir/prep.binding_axes (shared with the R-chunking path);
+    unknown names raise there."""
+    return P(*binding_axes(name))
 
 
 def pad_bindings_for_mesh(bindings: Bindings, c_shards: int,
@@ -95,7 +68,7 @@ def pad_bindings_for_mesh(bindings: Bindings, c_shards: int,
                 pads.append((0, 0))
         while len(pads) < arr.ndim:
             pads.append((0, 0))
-        fill = -1 if arr.dtype == np.int32 and not name.endswith(".idx") else 0
+        fill = -1 if arr.dtype == np.int32 else 0    # int32 = interner ids; -1 = MISSING
         out[name] = np.pad(arr, pads, constant_values=fill)
     return Bindings(arrays=out, n_constraints=bindings.n_constraints,
                     n_resources=bindings.n_resources, c_pad=c_pad2,

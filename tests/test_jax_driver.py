@@ -170,6 +170,35 @@ def test_audit_limit_per_constraint():
             assert len(rs) >= 3
 
 
+def test_audit_equivalence_chunked(monkeypatch):
+    """Force the chunked scan path (R_CHUNK below r_pad): capped and
+    uncapped audits must still match the scalar driver exactly."""
+    from gatekeeper_tpu.engine import veval
+    monkeypatch.setattr(veval, "R_CHUNK", 8)
+    local, jx = _mk_clients()
+    _setup(local)
+    _setup(jx)
+    lres = local.audit().results()
+    jres = jx.audit().results()
+    assert len(lres) > 0
+    assert [_results_key(r) for r in lres] == [_results_key(r) for r in jres]
+    # capped: per constraint, the device subset must be a prefix of the
+    # scalar (sorted-cache-key) order — driver-level on both sides
+    # (Result.resource is attached by the client wrapper, not the driver)
+    lraw = local.driver.query_audit("admission.k8s.gatekeeper.sh")[0]
+    jcap = jx.driver.query_audit("admission.k8s.gatekeeper.sh",
+                                 QueryOpts(limit_per_constraint=3))[0]
+    by_con_full: dict = {}
+    for r in lraw:
+        by_con_full.setdefault(_results_key(r)[1], []).append(_results_key(r))
+    by_con: dict = {}
+    for r in jcap:
+        by_con.setdefault(_results_key(r)[1], []).append(_results_key(r))
+    assert by_con
+    for name, rs in by_con.items():
+        assert rs == by_con_full[name][: len(rs)]
+
+
 def test_review_equivalence():
     local, jx = _mk_clients()
     _setup(local, n_pods=10)
