@@ -54,6 +54,7 @@ class JaxTargetState(TargetState):
         self.bindings_cache: dict[str, tuple] = {}  # kind -> (gen, ver, b)
         self.mask_cache: dict[str, tuple] = {}
         self.rank_cache: tuple | None = None       # (generation, rank arr)
+        self.order_cache: tuple | None = None      # (gen, ordered_rows, row_order)
         self.match_engine = None
 
     def bump(self, kind: str) -> None:
@@ -144,9 +145,15 @@ class JaxDriver(LocalDriver):
         trace: list | None = [] if tracing else None
 
         # row ordering matches the scalar driver (sorted cache keys) so
-        # both drivers return identical result lists
-        ordered_rows = [row for _, row in sorted(st.table.rows_items())]
-        row_order = {row: i for i, row in enumerate(ordered_rows)}
+        # both drivers return identical result lists; the 1M-row sort +
+        # index dict are generation-cached (steady-state sweeps reuse)
+        gen = st.table.generation
+        if st.order_cache is not None and st.order_cache[0] == gen:
+            _, ordered_rows, row_order = st.order_cache
+        else:
+            ordered_rows = [row for _, row in sorted(st.table.rows_items())]
+            row_order = {row: i for i, row in enumerate(ordered_rows)}
+            st.order_cache = (gen, ordered_rows, row_order)
         rank = self._row_rank(st, row_order)
 
         # phase 1: dispatch every kind's device evaluation without
@@ -176,26 +183,43 @@ class JaxDriver(LocalDriver):
                 plans.append(("scalar", kind, compiled, constraints, None,
                               None, mask, None))
 
-        # phase 2: host formatting per kind
+        # phase 2: host formatting per kind.  One (review, frozen)
+        # per violating row for the whole sweep — rows recur across
+        # kinds/constraints, and freeze() is a deep walk
+        rcache: dict[int, tuple] = {}
         tagged: list[tuple[tuple, Result]] = []
         for mode, kind, compiled, constraints, prog, bindings, mask, handle in plans:
             if mode == "topk":
                 self._format_topk(st, target, handler, compiled, constraints,
                                   prog, bindings, mask, rank, row_order,
-                                  kind, limit, trace, tagged, handle)
+                                  kind, limit, trace, tagged, handle, rcache)
             elif mode == "mask":
                 self._format_pairs(st, target, handler, compiled, constraints,
                                    handle.get(), row_order, kind, limit, trace,
-                                   tagged)
+                                   tagged, rcache)
             else:
                 self._scalar_kind(st, target, handler, compiled, constraints,
                                   mask, ordered_rows, row_order, kind, limit,
-                                  trace, tagged)
+                                  trace, tagged, rcache)
         tagged.sort(key=lambda kv: kv[0])
         return [r for _, r in tagged], ("\n".join(trace) if trace is not None else None)
 
+    def _row_review(self, st, handler, row, rcache):
+        """(review, frozen_review) for a table row, cached per sweep;
+        None if the row is dead."""
+        hit = rcache.get(row)
+        if hit is None:
+            meta = st.table.meta_at(row)
+            if meta is None:
+                return None
+            review = handler.make_review(meta, st.table.object_at(row))
+            hit = (review, freeze(review))
+            rcache[row] = hit
+        return hit
+
     def _format_pairs(self, st, target, handler, compiled, constraints,
-                      cand: np.ndarray, row_order, kind, limit, trace, tagged):
+                      cand: np.ndarray, row_order, kind, limit, trace, tagged,
+                      rcache):
         """Host-format violating (constraint, resource) pairs via the
         scalar oracle; over-approximated pairs yield no results."""
         for ci, c in enumerate(constraints):
@@ -207,12 +231,12 @@ class JaxDriver(LocalDriver):
             for row in rows:
                 if limit is not None and emitted >= limit:
                     break
-                meta = st.table.meta_at(row)
-                if meta is None:
+                pair = self._row_review(st, handler, row, rcache)
+                if pair is None:
                     continue
-                review = handler.make_review(meta, st.table.object_at(row))
+                review, frozen = pair
                 results = list(self._eval_pair(st, target, compiled, review,
-                                               freeze(review), c, trace))
+                                               frozen, c, trace))
                 for r in results:
                     tagged.append(((row_order[row], kind,
                                     (c.get("metadata") or {}).get("name", "")), r))
@@ -236,7 +260,7 @@ class JaxDriver(LocalDriver):
 
     def _format_topk(self, st, target, handler, compiled, constraints,
                      prog, bindings, mask, rank, row_order, kind, limit,
-                     trace, tagged, handle=None):
+                     trace, tagged, handle=None, rcache=None):
         """Capped audit: device finds the first-k candidate rows per
         constraint (in scalar cap order, via rank); the host formats
         only those.  If over-approximated pairs leave the cap
@@ -245,6 +269,8 @@ class JaxDriver(LocalDriver):
         if handle is None:
             handle = self.executor.run_topk_async(prog, bindings, limit,
                                                   match=mask, rank=rank)
+        if rcache is None:
+            rcache = {}
         counts, rows, valid = handle.get()
         full_cand = None
         for ci, c in enumerate(constraints):
@@ -252,7 +278,8 @@ class JaxDriver(LocalDriver):
             sel = sorted((r for r in sel if r in row_order),
                          key=row_order.__getitem__)
             emitted = self._emit_rows(st, target, handler, compiled, c, sel,
-                                      row_order, kind, limit, trace, tagged)
+                                      row_order, kind, limit, trace, tagged,
+                                      rcache)
             if emitted < limit and int(counts[ci]) > len(sel):
                 if full_cand is None:
                     full_cand = self.executor.run(prog, bindings, match=mask,
@@ -262,20 +289,21 @@ class JaxDriver(LocalDriver):
                                if ri in row_order and ri not in sel_set),
                               key=row_order.__getitem__)
                 self._emit_rows(st, target, handler, compiled, c, rest,
-                                row_order, kind, limit - emitted, trace, tagged)
+                                row_order, kind, limit - emitted, trace, tagged,
+                                rcache)
 
     def _emit_rows(self, st, target, handler, compiled, c, rows, row_order,
-                   kind, limit, trace, tagged) -> int:
+                   kind, limit, trace, tagged, rcache) -> int:
         emitted = 0
         for row in rows:
             if limit is not None and emitted >= limit:
                 break
-            meta = st.table.meta_at(row)
-            if meta is None:
+            pair = self._row_review(st, handler, row, rcache)
+            if pair is None:
                 continue
-            review = handler.make_review(meta, st.table.object_at(row))
+            review, frozen = pair
             results = list(self._eval_pair(st, target, compiled, review,
-                                           freeze(review), c, trace))
+                                           frozen, c, trace))
             for r in results:
                 tagged.append(((row_order[row], kind,
                                 (c.get("metadata") or {}).get("name", "")), r))
@@ -283,16 +311,15 @@ class JaxDriver(LocalDriver):
         return emitted
 
     def _scalar_kind(self, st, target, handler, compiled, constraints,
-                     mask, ordered_rows, row_order, kind, limit, trace, tagged):
+                     mask, ordered_rows, row_order, kind, limit, trace, tagged,
+                     rcache):
         """Scalar fallback for unlowerable templates, restricted to
         match-mask candidates when a vector matcher exists."""
         emitted = {ci: 0 for ci in range(len(constraints))}
         for row in ordered_rows:
-            meta = st.table.meta_at(row)
-            if meta is None:
+            if st.table.meta_at(row) is None:
                 continue
-            review = None
-            frozen = None
+            pair = None
             for ci, c in enumerate(constraints):
                 if limit is not None and emitted[ci] >= limit:
                     continue
@@ -300,15 +327,14 @@ class JaxDriver(LocalDriver):
                     if not mask[ci, row]:
                         continue
                 else:
-                    if review is None:
-                        review = handler.make_review(meta, st.table.object_at(row))
+                    if pair is None:
+                        pair = self._row_review(st, handler, row, rcache)
                     if not any(True for _ in handler.matching_constraints(
-                            review, [c], st.table)):
+                            pair[0], [c], st.table)):
                         continue
-                if review is None:
-                    review = handler.make_review(meta, st.table.object_at(row))
-                if frozen is None:
-                    frozen = freeze(review)
+                if pair is None:
+                    pair = self._row_review(st, handler, row, rcache)
+                review, frozen = pair
                 results = list(self._eval_pair(st, target, compiled, review,
                                                frozen, c, trace))
                 for r in results:

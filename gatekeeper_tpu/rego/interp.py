@@ -52,6 +52,33 @@ class Interpreter:
         self.rules: dict[str, list[Rule]] = {}
         for r in self.module.rules:
             self.rules.setdefault(r.name, []).append(r)
+        # id-keyed side tables over the (immutable, kept-alive) AST:
+        # per-node precomputation that the frozen dataclasses can't carry
+        self._canon: dict[int, Any] = {}      # Scalar -> canonical value
+        self._constpath: dict[int, tuple] = {}  # Ref -> all-constant keys
+        self._builtinfn: dict[int, Any] = {}  # Call -> resolved builtin
+        for r in self.module.rules:
+            _walk_rule(r, self._index_term)
+
+    def _index_term(self, term) -> None:
+        t = term.__class__
+        if t is Scalar:
+            v = term.value
+            self._canon[id(term)] = canon_num(v) if isinstance(v, (int, float)) else v
+        elif t is Ref:
+            if all(p.__class__ is Scalar for p in term.path):
+                keys = []
+                for p in term.path:
+                    v = p.value
+                    keys.append(canon_num(v) if isinstance(v, (int, float)) else v)
+                self._constpath[id(term)] = tuple(keys)
+        elif t is Call:
+            name = term.name
+            if name not in (("trace",), ("internal", "compare")) and \
+                    not (len(name) == 1 and name[0] in self.rules):
+                fn = bi.REGISTRY.get(name)
+                if fn is not None:
+                    self._builtinfn[id(term)] = fn
 
     # ------------------------------------------------------------------
     # public entry points
@@ -148,7 +175,8 @@ class Interpreter:
             raise EvalError(f"max call depth exceeded in {name}")
         rules = self.rules.get(name, [])
         outputs: list = []
-        ctx = dataclasses.replace(ctx, depth=ctx.depth + 1, memo=ctx.memo)
+        ctx = _Ctx(input=ctx.input, data=ctx.data, tracer=ctx.tracer,
+                   memo=ctx.memo, depth=ctx.depth + 1)
         for rule in rules:
             if rule.kind != "function" or len(rule.args or ()) != len(argvals):
                 continue
@@ -319,10 +347,15 @@ class Interpreter:
     # term evaluation
 
     def _eval_term(self, ctx: _Ctx, term: Term, env: dict) -> Iterator[tuple[Any, dict]]:
-        if isinstance(term, Scalar):
-            yield canon_num(term.value) if isinstance(term.value, (int, float)) else term.value, env
+        cls = term.__class__
+        if cls is Scalar:
+            v = self._canon.get(id(term), _MISS)
+            if v is _MISS:
+                v = canon_num(term.value) if isinstance(term.value, (int, float)) \
+                    else term.value
+            yield v, env
             return
-        if isinstance(term, Var):
+        if cls is Var:
             name = term.name
             if name in env:
                 yield env[name], env
@@ -340,7 +373,34 @@ class Interpreter:
                     yield v, env
                 return
             raise EvalError(f"unsafe variable: {name}")
-        if isinstance(term, Ref):
+        if cls is Ref:
+            keys = self._constpath.get(id(term))
+            if keys is not None:
+                # all-constant path: iterative descent, no per-element
+                # generator frames
+                base = term.base
+                if base.__class__ is Var:
+                    name = base.name
+                    if name in env:
+                        base_v = env[name]
+                    elif name == "input":
+                        if ctx.input is UNDEFINED:
+                            return
+                        base_v = ctx.input
+                    elif name == "data":
+                        base_v = ctx.data
+                    else:
+                        base_v = _MISS
+                    if base_v is not _MISS:
+                        v = _walk_const(base_v, keys)
+                        if v is not _MISS:
+                            yield v, env
+                        return
+                for base_v, env1 in self._eval_term(ctx, base, env):
+                    v = _walk_const(base_v, keys)
+                    if v is not _MISS:
+                        yield v, env1
+                return
             for base_v, env1 in self._eval_term(ctx, term.base, env):
                 yield from self._walk_ref(ctx, base_v, term.path, 0, env1)
             return
@@ -427,6 +487,48 @@ class Interpreter:
         return
 
     def _eval_call(self, ctx: _Ctx, term: Call, env: dict) -> Iterator[tuple[Any, dict]]:
+        fn = self._builtinfn.get(id(term))
+        if fn is not None:
+            # pre-resolved builtin: unrolled 1/2-arg paths skip the
+            # _eval_seq accumulator machinery
+            args = term.args
+            n = len(args)
+            if n == 1:
+                for a0, env2 in self._eval_term(ctx, args[0], env):
+                    try:
+                        v = fn(a0)
+                    except bi.BuiltinError:
+                        continue
+                    except (TypeError, ValueError, KeyError, IndexError,
+                            ZeroDivisionError):
+                        continue
+                    if v is not UNDEFINED:
+                        yield v, env2
+                return
+            if n == 2:
+                for a0, env1 in self._eval_term(ctx, args[0], env):
+                    for a1, env2 in self._eval_term(ctx, args[1], env1):
+                        try:
+                            v = fn(a0, a1)
+                        except bi.BuiltinError:
+                            continue
+                        except (TypeError, ValueError, KeyError, IndexError,
+                                ZeroDivisionError):
+                            continue
+                        if v is not UNDEFINED:
+                            yield v, env2
+                return
+            for argvals, env2 in self._eval_seq(ctx, args, env, tuple):
+                try:
+                    v = fn(*argvals)
+                except bi.BuiltinError:
+                    continue
+                except (TypeError, ValueError, KeyError, IndexError,
+                        ZeroDivisionError):
+                    continue
+                if v is not UNDEFINED:
+                    yield v, env2
+            return
         name = term.name
         if name == ("trace",):
             for v, env2 in self._eval_term(ctx, term.args[0], env):
@@ -490,6 +592,85 @@ class Interpreter:
 
 
 _IN_PROGRESS = object()
+_MISS = object()
+
+
+def _walk_rule(rule: Rule, visit) -> None:
+    """Apply `visit` to every term in a rule (pre-order)."""
+    for t in (rule.key, rule.value):
+        if t is not None:
+            _walk_term(t, visit)
+    for a in rule.args or ():
+        _walk_term(a, visit)
+    _walk_body(rule.body, visit)
+
+
+def _walk_body(body, visit) -> None:
+    for lit in body:
+        for w in lit.withs or ():
+            _walk_term(w.target, visit)
+            _walk_term(w.value, visit)
+        if not isinstance(lit.expr, SomeDecl):
+            _walk_term(lit.expr, visit)
+
+
+def _walk_term(term, visit) -> None:
+    visit(term)
+    t = term.__class__
+    if t is Ref:
+        _walk_term(term.base, visit)
+        for p in term.path:
+            _walk_term(p, visit)
+    elif t in (ArrayTerm, SetTerm):
+        for x in term.items:
+            _walk_term(x, visit)
+    elif t is ObjectTerm:
+        for k, v in term.pairs:
+            _walk_term(k, visit)
+            _walk_term(v, visit)
+    elif t is Call:
+        for a in term.args:
+            _walk_term(a, visit)
+    elif t is BinOp:
+        _walk_term(term.lhs, visit)
+        _walk_term(term.rhs, visit)
+    elif t is UnaryMinus:
+        _walk_term(term.operand, visit)
+    elif t is Comprehension:
+        for h in term.head:
+            if h is not None:
+                _walk_term(h, visit)
+        _walk_body(term.body, visit)
+    elif t is Assign:
+        _walk_term(term.lhs, visit)
+        _walk_term(term.rhs, visit)
+    elif t is Compare:
+        _walk_term(term.lhs, visit)
+        _walk_term(term.rhs, visit)
+
+
+def _walk_const(value, keys):
+    """Resolve an all-constant ref path iteratively; _MISS if undefined.
+    Semantics identical to _walk_ref's ground branch."""
+    for k in keys:
+        tv = value.__class__
+        if tv is Obj:
+            value = value._d.get(k, _MISS)
+            if value is _MISS:
+                return _MISS
+        elif tv is tuple:
+            if k.__class__ is int and 0 <= k < len(value):
+                value = value[k]
+            else:
+                return _MISS
+        elif tv is frozenset:
+            if k in value:
+                value = k
+            else:
+                return _MISS
+        else:
+            return _MISS
+    return value
 
 
 def _contains(values: list, v) -> bool:

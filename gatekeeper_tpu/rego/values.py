@@ -33,12 +33,7 @@ class Obj(Mapping):
     __slots__ = ("_d", "_hash")
 
     def __init__(self, items: Iterable[tuple[Any, Any]] | Mapping | None = None):
-        if items is None:
-            d = {}
-        elif isinstance(items, Mapping):
-            d = dict(items)
-        else:
-            d = dict(items)
+        d = {} if items is None else dict(items)
         object.__setattr__(self, "_d", d)
         object.__setattr__(self, "_hash", None)
 
@@ -93,7 +88,21 @@ def canon_num(x):
 
 def freeze(v: Any) -> Any:
     """JSON-ish Python value -> canonical immutable value."""
-    if v is None or isinstance(v, (str, bool)):
+    t = v.__class__
+    if t is str or t is bool or v is None:
+        return v
+    if t is int:
+        return v
+    if t is float:
+        return canon_num(v)
+    if t is dict:
+        return Obj({freeze(k): freeze(val) for k, val in v.items()})
+    if t is list or t is tuple:
+        return tuple(freeze(x) for x in v)
+    if t is Obj:
+        return v
+    # subclass / abstract fallbacks
+    if isinstance(v, (str, bool)):
         return v
     if isinstance(v, (int, float)):
         return canon_num(v)
