@@ -33,6 +33,7 @@ rides the audit kernels via webhook batching (pkg webhook).
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Any
 
 import numpy as np
@@ -55,6 +56,7 @@ class JaxTargetState(TargetState):
         self.mask_cache: dict[str, tuple] = {}
         self.rank_cache: tuple | None = None       # (generation, rank arr)
         self.order_cache: tuple | None = None      # (gen, ordered_rows, row_order)
+        self.fmt_cache: dict[str, tuple] = {}      # kind -> (con_ver, {(cname,row): (ver, results)})
         self.match_engine = None
 
     def bump(self, kind: str) -> None:
@@ -114,7 +116,7 @@ class JaxDriver(LocalDriver):
         engine = self._match_engine(st, target)
         if engine is None:
             return None
-        key = (st.table.generation, st.con_version.get(kind, 0))
+        key = (st.table.generation, self.con_version_of(st, kind))
         hit = st.mask_cache.get(kind)
         if hit is not None and hit[0] == key:
             return hit[1]
@@ -124,7 +126,7 @@ class JaxDriver(LocalDriver):
 
     def _kind_bindings(self, st: JaxTargetState, kind: str,
                        compiled: CompiledTemplate, constraints: list[dict]):
-        key = (st.table.generation, st.con_version.get(kind, 0))
+        key = (st.table.generation, self.con_version_of(st, kind))
         hit = st.bindings_cache.get(kind)
         if hit is not None and hit[0] == key:
             return hit[1]
@@ -204,6 +206,47 @@ class JaxDriver(LocalDriver):
         tagged.sort(key=lambda kv: kv[0])
         return [r for _, r in tagged], ("\n".join(trace) if trace is not None else None)
 
+    def _pair_results(self, st, target, kind, compiled, c, row, review,
+                      frozen, trace) -> list:
+        """Memoized per-pair formatting.  Steady-state sweeps re-visit
+        the same capped (constraint, row) pairs against unchanged rows —
+        the oracle re-evaluation is skipped when neither the row (its
+        table version) nor the kind's constraint set changed.  Inventory
+        -reading templates key on the whole table generation instead
+        (their results can depend on any row); tracing bypasses the
+        cache (the tracer must observe the evaluation)."""
+        if trace is not None:
+            return list(self._eval_pair(st, target, compiled, review, frozen,
+                                        c, trace))
+        con_ver = self.con_version_of(st, kind)
+        hit = st.fmt_cache.get(kind)
+        if hit is None or hit[0] != con_ver:
+            hit = (con_ver, {})
+            st.fmt_cache[kind] = hit
+        entries = hit[1]
+        ver = st.table.generation if compiled.uses_inventory \
+            else st.table.version_at(row)
+        cname = (c.get("metadata") or {}).get("name", "")
+        key = (cname, row)
+        ent = entries.get(key)
+        if ent is None or ent[0] != ver:
+            results = list(self._eval_pair(st, target, compiled, review,
+                                           frozen, c, trace))
+            if len(entries) > 65536:     # bound growth across churn
+                entries.clear()
+            entries[key] = ent = (ver, results)
+        # fresh copies (own metadata dict too): downstream sets
+        # .resource and owns result.metadata — the cached canonical list
+        # must stay pristine.  (metadata["details"] values are still
+        # shared; they are produced once by thaw() and treated
+        # read-only everywhere.)
+        return [dataclasses.replace(r, metadata=dict(r.metadata))
+                for r in ent[1]]
+
+    @staticmethod
+    def con_version_of(st, kind: str) -> int:
+        return st.con_version.get(kind, 0)
+
     def _row_review(self, st, handler, row, rcache):
         """(review, frozen_review) for a table row, cached per sweep;
         None if the row is dead."""
@@ -235,8 +278,8 @@ class JaxDriver(LocalDriver):
                 if pair is None:
                     continue
                 review, frozen = pair
-                results = list(self._eval_pair(st, target, compiled, review,
-                                               frozen, c, trace))
+                results = self._pair_results(st, target, kind, compiled, c,
+                                             row, review, frozen, trace)
                 for r in results:
                     tagged.append(((row_order[row], kind,
                                     (c.get("metadata") or {}).get("name", "")), r))
@@ -260,7 +303,8 @@ class JaxDriver(LocalDriver):
 
     def _format_topk(self, st, target, handler, compiled, constraints,
                      prog, bindings, mask, rank, row_order, kind, limit,
-                     trace, tagged, handle=None, rcache=None):
+                     trace, tagged, handle, rcache):
+
         """Capped audit: device finds the first-k candidate rows per
         constraint (in scalar cap order, via rank); the host formats
         only those.  If over-approximated pairs leave the cap
@@ -269,8 +313,6 @@ class JaxDriver(LocalDriver):
         if handle is None:
             handle = self.executor.run_topk_async(prog, bindings, limit,
                                                   match=mask, rank=rank)
-        if rcache is None:
-            rcache = {}
         counts, rows, valid = handle.get()
         full_cand = None
         for ci, c in enumerate(constraints):
@@ -302,8 +344,8 @@ class JaxDriver(LocalDriver):
             if pair is None:
                 continue
             review, frozen = pair
-            results = list(self._eval_pair(st, target, compiled, review,
-                                           frozen, c, trace))
+            results = self._pair_results(st, target, kind, compiled, c, row,
+                                         review, frozen, trace)
             for r in results:
                 tagged.append(((row_order[row], kind,
                                 (c.get("metadata") or {}).get("name", "")), r))
@@ -335,8 +377,8 @@ class JaxDriver(LocalDriver):
                 if pair is None:
                     pair = self._row_review(st, handler, row, rcache)
                 review, frozen = pair
-                results = list(self._eval_pair(st, target, compiled, review,
-                                               frozen, c, trace))
+                results = self._pair_results(st, target, kind, compiled, c,
+                                             row, review, frozen, trace)
                 for r in results:
                     tagged.append(((row_order[row], kind,
                                     (c.get("metadata") or {}).get("name", "")), r))

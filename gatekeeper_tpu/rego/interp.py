@@ -175,8 +175,7 @@ class Interpreter:
             raise EvalError(f"max call depth exceeded in {name}")
         rules = self.rules.get(name, [])
         outputs: list = []
-        ctx = _Ctx(input=ctx.input, data=ctx.data, tracer=ctx.tracer,
-                   memo=ctx.memo, depth=ctx.depth + 1)
+        ctx = dataclasses.replace(ctx, depth=ctx.depth + 1)
         for rule in rules:
             if rule.kind != "function" or len(rule.args or ()) != len(argvals):
                 continue

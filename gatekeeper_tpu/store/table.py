@@ -54,6 +54,7 @@ class ResourceTable:
         self.interner = interner or Interner()
         self._objs: list[Any] = []
         self._metas: list[ResourceMeta | None] = []
+        self._versions: list[int] = []       # generation at last modify
         self._rows: dict[str, int] = {}      # path key -> row
         self._free: list[int] = []
         self.generation = 0
@@ -80,14 +81,17 @@ class ResourceTable:
                 row = len(self._objs)
                 self._objs.append(obj)
                 self._metas.append(meta)
+                self._versions.append(0)
             self._rows[key] = row
         else:
             self._objs[row] = obj
             self._metas[row] = meta
         self.generation += 1
+        self._versions[row] = self.generation
         return row
 
     def bulk_upsert(self, entries: list[tuple[str, dict, ResourceMeta]]) -> None:
+        dirty: list[int] = []
         for key, obj, meta in entries:
             row = self._rows.get(key)
             if row is None:
@@ -99,11 +103,15 @@ class ResourceTable:
                     row = len(self._objs)
                     self._objs.append(obj)
                     self._metas.append(meta)
+                    self._versions.append(0)
                 self._rows[key] = row
             else:
                 self._objs[row] = obj
                 self._metas[row] = meta
+            dirty.append(row)
         self.generation += 1
+        for row in dirty:
+            self._versions[row] = self.generation
 
     def remove(self, key: str) -> bool:
         row = self._rows.pop(key, None)
@@ -113,6 +121,7 @@ class ResourceTable:
         self._metas[row] = None
         self._free.append(row)
         self.generation += 1
+        self._versions[row] = self.generation
         if len(self._free) > 64 and len(self._free) > len(self._rows):
             self.compact()
         return True
@@ -120,6 +129,7 @@ class ResourceTable:
     def wipe(self) -> None:
         self._objs.clear()
         self._metas.clear()
+        self._versions.clear()
         self._rows.clear()
         self._free.clear()
         self._col_cache.clear()
@@ -136,6 +146,9 @@ class ResourceTable:
         self._objs, self._metas, self._rows = new_objs, new_metas, new_rows
         self._free = []
         self.generation += 1
+        # row ids were reassigned: stamp everything with the new
+        # generation so (row, version) pairs can't alias across compaction
+        self._versions = [self.generation] * len(new_objs)
 
     # ------------------------------------------------------------------
 
@@ -144,6 +157,11 @@ class ResourceTable:
 
     def meta_at(self, row: int) -> ResourceMeta | None:
         return self._metas[row]
+
+    def version_at(self, row: int) -> int:
+        """Generation at the row's last modify — cache-invalidation key
+        for per-row derived results (e.g. formatted violations)."""
+        return self._versions[row]
 
     def rows_items(self):
         """(key, row) pairs for live rows."""

@@ -199,6 +199,47 @@ def test_audit_equivalence_chunked(monkeypatch):
         assert rs == by_con_full[name][: len(rs)]
 
 
+def test_capped_format_memo_invalidation():
+    """The per-pair formatting memo must reflect row updates, constraint
+    updates, and (for inventory templates) any table change."""
+    _, jx = _mk_clients()
+    _setup(jx, n_pods=0)
+    bad = {"apiVersion": "v1", "kind": "Pod",
+           "metadata": {"name": "badpod", "namespace": "default", "labels": {}},
+           "spec": {"containers": [{"name": "c", "image": "docker.io/evil"}]}}
+    jx.add_data(bad)
+    opts = QueryOpts(limit_per_constraint=5)
+    r1 = jx.driver.query_audit("admission.k8s.gatekeeper.sh", opts)[0]
+    r2 = jx.driver.query_audit("admission.k8s.gatekeeper.sh", opts)[0]
+    assert [_results_key(r) for r in r1] == [_results_key(r) for r in r2]
+    assert any("badpod" in (r.review or {}).get("name", "") for r in r2)
+    # fix the pod: its violations must disappear from the next sweep
+    good = {"apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": "badpod", "namespace": "default",
+                         "labels": {"app": "x", "env": "y", "owner": "z"}},
+            "spec": {"containers": [{"name": "c", "image": "gcr.io/org/app"}]}}
+    jx.add_data(good)
+    r3 = jx.driver.query_audit("admission.k8s.gatekeeper.sh", opts)[0]
+    assert not any((r.review or {}).get("name") == "badpod" for r in r3
+                   if r.constraint["metadata"]["name"] in ("need-app", "gcr-only"))
+    # constraint update: new params must take effect
+    jx.add_constraint(constraint_doc(
+        "K8sRequiredLabels", "need-app", {"labels": ["definitely-absent"]}))
+    r4 = jx.driver.query_audit("admission.k8s.gatekeeper.sh", opts)[0]
+    msgs = [r.msg for r in r4
+            if r.constraint["metadata"]["name"] == "need-app"]
+    assert msgs and all("definitely-absent" in m for m in msgs)
+    # inventory template: adding an unrelated duplicate-host ingress must
+    # surface through the generation-keyed memo
+    before = [r for r in r4 if r.constraint["metadata"]["name"] == "uniq-host"]
+    jx.add_data({"apiVersion": "extensions/v1beta1", "kind": "Ingress",
+                 "metadata": {"name": "ing-new", "namespace": "default"},
+                 "spec": {"host": "h0.example.com"}})
+    r5 = jx.driver.query_audit("admission.k8s.gatekeeper.sh", opts)[0]
+    after = [r for r in r5 if r.constraint["metadata"]["name"] == "uniq-host"]
+    assert len(after) > len(before)
+
+
 def test_review_equivalence():
     local, jx = _mk_clients()
     _setup(local, n_pods=10)
