@@ -633,10 +633,30 @@ class Lowerer:
     def _cinput(self, constraint: dict):
         return freeze({"constraint": constraint})
 
+    def _check_cenv(self, env_vars, env_map, seen=None) -> None:
+        """Eagerly verify every env var a constraint-side closure needs
+        resolves to a constraint-only symbol.  Without this the failure
+        surfaces as CannotLower at build_bindings time — far past the
+        put_template scalar-fallback seam — and crashes the audit."""
+        if seen is None:
+            seen = set()
+        for v in env_vars:
+            if v in seen:
+                continue
+            seen.add(v)
+            sym = env_map.get(v)
+            if isinstance(sym, SConst):
+                continue
+            if isinstance(sym, SCTerm):
+                self._check_cenv(sym.env_vars, env_map, seen)
+                continue
+            raise CannotLower(f"var {v} not constraint-only")
+
     def _make_cval(self, sym: SCTerm, kind: str) -> str:
         name = f"cv{next(self.serial)}"
         term, env_vars = sym.term, sym.env_vars
         env_map = dict(self.env)
+        self._check_cenv(env_vars, env_map)
 
         def fn(c, _t=term, _ev=env_vars, _k=kind, _em=env_map):
             v = self._ceval_term(self._cinput(c), _t, _ev, _em)
@@ -654,6 +674,7 @@ class Lowerer:
                    iterate: bool, encode: str) -> str:
         name = f"cs{next(self.serial)}"
         env_map = dict(self.env)
+        self._check_cenv(env_vars, env_map)
 
         def fn(c, _t=term, _ev=env_vars, _it=iterate, _em=env_map):
             if _it:

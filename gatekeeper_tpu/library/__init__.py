@@ -1,0 +1,2 @@
+from gatekeeper_tpu.library.templates import (  # noqa: F401
+    LIBRARY, TARGET, all_docs, constraint_doc, template_doc)

@@ -458,6 +458,158 @@ def _is_number(x):
     return not isinstance(x, bool) and isinstance(x, (int, float))
 
 
+_BIN_UNITS = {"ki": 2**10, "mi": 2**20, "gi": 2**30, "ti": 2**40,
+              "pi": 2**50, "ei": 2**60}
+_DEC_UNITS = {"": 1, "k": 10**3, "m": 10**6, "g": 10**9, "t": 10**12,
+              "p": 10**15, "e": 10**18}
+_MILLI_UNITS = {"m": 0.001}
+
+
+def _units_parse_bytes(s):
+    """units.parse_bytes: k8s-style byte quantities ("1Gi", "512Mi",
+    "128974848", "1G"); case-insensitive suffix, optional trailing "b"
+    (vendor opa/topdown/parse_bytes.go semantics)."""
+    raw = _need_string(s, "units.parse_bytes").strip().strip('"')
+    low = raw.lower()
+    i = len(low)
+    while i > 0 and (low[i - 1].isalpha()):
+        i -= 1
+    num, suffix = low[:i], low[i:]
+    if suffix.endswith("b"):
+        suffix = suffix[:-1]
+    if not num:
+        raise BuiltinError(f"units.parse_bytes: no amount in {raw!r}")
+    mult = _BIN_UNITS.get(suffix)
+    if mult is None:
+        mult = _DEC_UNITS.get(suffix)
+    if mult is None:
+        raise BuiltinError(f"units.parse_bytes: unknown unit {suffix!r}")
+    try:
+        val = float(num)
+    except ValueError:
+        raise BuiltinError(f"units.parse_bytes: bad number {num!r}")
+    return canon_num(val * mult)
+
+
+def _units_parse(s):
+    """units.parse: like parse_bytes plus lowercase milli ("200m") and
+    decimal units; binary suffixes allowed (vendor opa/topdown/parse.go)."""
+    raw = _need_string(s, "units.parse").strip().strip('"')
+    i = len(raw)
+    while i > 0 and raw[i - 1].isalpha():
+        i -= 1
+    num, suffix = raw[:i], raw[i:]
+    if not num:
+        raise BuiltinError(f"units.parse: no amount in {raw!r}")
+    mult = _MILLI_UNITS.get(suffix)
+    if mult is None:
+        mult = _BIN_UNITS.get(suffix.lower())
+    if mult is None:
+        # decimal units: K and k both 10^3; M is mega here (unlike milli)
+        mult = _DEC_UNITS.get(suffix.lower())
+    if mult is None:
+        raise BuiltinError(f"units.parse: unknown unit {suffix!r}")
+    try:
+        val = float(num)
+    except ValueError:
+        raise BuiltinError(f"units.parse: bad number {num!r}")
+    return canon_num(val * mult)
+
+
+def _object_union(a, b):
+    if not isinstance(a, Obj) or not isinstance(b, Obj):
+        raise BuiltinError("object.union: operands must be objects")
+    d = dict(a.items())
+    d.update(b.items())
+    return Obj(d)
+
+
+def _object_remove(obj, keys):
+    if not isinstance(obj, Obj):
+        raise BuiltinError("object.remove: operand must be object")
+    if isinstance(keys, (tuple, frozenset)):
+        drop = set(keys)
+    elif isinstance(keys, Obj):
+        drop = set(keys)
+    else:
+        raise BuiltinError("object.remove: keys must be array/set/object")
+    return Obj({k: v for k, v in obj.items() if k not in drop})
+
+
+def _object_filter(obj, keys):
+    if not isinstance(obj, Obj):
+        raise BuiltinError("object.filter: operand must be object")
+    if isinstance(keys, (tuple, frozenset)):
+        keep = set(keys)
+    elif isinstance(keys, Obj):
+        keep = set(keys)
+    else:
+        raise BuiltinError("object.filter: keys must be array/set/object")
+    return Obj({k: v for k, v in obj.items() if k in keep})
+
+
+def _base64_encode(s):
+    import base64
+    return base64.b64encode(_need_string(s, "base64.encode").encode()).decode()
+
+
+def _base64_decode(s):
+    import base64
+    try:
+        return base64.b64decode(_need_string(s, "base64.decode"),
+                                validate=True).decode()
+    except Exception as e:
+        raise BuiltinError(f"base64.decode: {e}")
+
+
+def _base64url_encode(s):
+    import base64
+    return base64.urlsafe_b64encode(
+        _need_string(s, "base64url.encode").encode()).decode()
+
+
+def _base64url_decode(s):
+    import base64
+    try:
+        return base64.urlsafe_b64decode(
+            _need_string(s, "base64url.decode")).decode()
+    except Exception as e:
+        raise BuiltinError(f"base64url.decode: {e}")
+
+
+def _numbers_range(a, b):
+    if not isinstance(a, int) or not isinstance(b, int) or \
+            isinstance(a, bool) or isinstance(b, bool):
+        raise BuiltinError("numbers.range: operands must be integers")
+    step = 1 if b >= a else -1
+    return tuple(range(a, b + step, step))
+
+
+def _regex_split(pattern, s):
+    p = compile_go_regex(_need_string(pattern, "regex.split"))
+    return tuple(p.split(_need_string(s, "regex.split")))
+
+
+def walk_pairs(x):
+    """All (path, value) pairs of a document, OPA walk() order
+    (vendor opa/topdown/walk.go): the node itself first, then children."""
+    out = []
+
+    def rec(path, v):
+        out.append((tuple(path), v))
+        if isinstance(v, Obj):
+            for k, val in v.items():
+                rec(path + [k], val)
+        elif isinstance(v, tuple):
+            for i, val in enumerate(v):
+                rec(path + [i], val)
+        elif isinstance(v, frozenset):
+            for m in sorted_values(v):
+                rec(path + [m], m)
+    rec([], x)
+    return out
+
+
 REGISTRY: dict[tuple[str, ...], Callable] = {
     # aggregates
     ("count",): _count,
@@ -504,6 +656,20 @@ REGISTRY: dict[tuple[str, ...], Callable] = {
     ("object", "get"): _object_get,
     ("cast_array",): _cast_array,
     ("cast_set",): _cast_set,
+    ("object", "union"): _object_union,
+    ("object", "remove"): _object_remove,
+    ("object", "filter"): _object_filter,
+    # units (container limits quantities, parse_bytes.go)
+    ("units", "parse_bytes"): _units_parse_bytes,
+    ("units", "parse"): _units_parse,
+    # encoding
+    ("base64", "encode"): _base64_encode,
+    ("base64", "decode"): _base64_decode,
+    ("base64url", "encode"): _base64url_encode,
+    ("base64url", "decode"): _base64url_decode,
+    # numbers
+    ("numbers", "range"): _numbers_range,
+    ("regex", "split"): _regex_split,
     # json
     ("json", "marshal"): _json_marshal,
     ("json", "unmarshal"): _json_unmarshal,

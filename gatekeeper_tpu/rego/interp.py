@@ -542,6 +542,21 @@ class Interpreter:
                 for rv, env2 in self._eval_term(ctx, term.args[2], env1):
                     yield _compare(str(op_t.value), lv, rv), env2
             return
+        if name == ("walk",):
+            # relation builtin (vendor opa/topdown/walk.go): yields every
+            # (path, value) pair; 2-arg statement form unifies the pair,
+            # 1-arg expression form yields the pairs as values
+            for xv, env1 in self._eval_term(ctx, term.args[0], env):
+                pairs = bi.walk_pairs(xv)
+                if len(term.args) == 2:
+                    for path, v in pairs:
+                        for env2 in self._match_pattern(
+                                ctx, term.args[1], (path, v), env1):
+                            yield True, env2
+                else:
+                    for path, v in pairs:
+                        yield (path, v), env1
+            return
         if len(name) == 1 and name[0] in self.rules:
             # user-defined function
             for argvals, env2 in self._eval_seq(ctx, term.args, env, tuple):

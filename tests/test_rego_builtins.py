@@ -167,3 +167,92 @@ violation[{"msg": "ok"}] { f(1) == 1 }
     def test_json_marshal_sorted_keys(self):
         from gatekeeper_tpu.rego.builtins import REGISTRY
         assert REGISTRY[("json", "marshal")](freeze({"b": 1, "a": 2})) == '{"a":2,"b":1}'
+
+
+class TestRound2Builtins:
+    def test_units_parse_bytes(self):
+        from gatekeeper_tpu.rego.builtins import REGISTRY, BuiltinError
+        pb = REGISTRY[("units", "parse_bytes")]
+        assert pb("1Gi") == 2**30
+        assert pb("512Mi") == 512 * 2**20
+        assert pb("128974848") == 128974848
+        assert pb("1G") == 10**9
+        assert pb("10KB") == 10**4
+        assert pb("1.5Ki") == 1536
+        import pytest
+        with pytest.raises(BuiltinError):
+            pb("wat")
+        with pytest.raises(BuiltinError):
+            pb("1Zi")
+
+    def test_units_parse_milli(self):
+        from gatekeeper_tpu.rego.builtins import REGISTRY
+        up = REGISTRY[("units", "parse")]
+        assert up("200m") == 0.2
+        assert up("2Ki") == 2048
+        assert up("3") == 3
+
+    def test_object_union_remove_filter(self):
+        from gatekeeper_tpu.rego.builtins import REGISTRY
+        a = freeze({"a": 1, "b": 2})
+        b = freeze({"b": 3, "c": 4})
+        assert dict(REGISTRY[("object", "union")](a, b).items()) == {"a": 1, "b": 3, "c": 4}
+        assert dict(REGISTRY[("object", "remove")](a, ("a",)).items()) == {"b": 2}
+        assert dict(REGISTRY[("object", "filter")](a, ("a",)).items()) == {"a": 1}
+
+    def test_base64_roundtrip(self):
+        from gatekeeper_tpu.rego.builtins import REGISTRY
+        enc = REGISTRY[("base64", "encode")]("hello")
+        assert REGISTRY[("base64", "decode")](enc) == "hello"
+
+    def test_numbers_range(self):
+        from gatekeeper_tpu.rego.builtins import REGISTRY
+        assert REGISTRY[("numbers", "range")](1, 4) == (1, 2, 3, 4)
+        assert REGISTRY[("numbers", "range")](3, 1) == (3, 2, 1)
+
+    def test_walk_relation_two_arg(self):
+        m = parse_module("""
+package t
+violation[{"msg": msg}] {
+  walk(input, [path, value])
+  value == "secret"
+  msg := sprintf("found at %v", [path])
+}
+""")
+        out = Interpreter(m).query_set(
+            "violation", {"a": {"b": ["x", "secret"]}}, {})
+        assert len(out) == 1
+        assert 'found at ["a", "b", 1]' in str(out[0]["msg"])
+
+    def test_walk_in_template_falls_back_to_scalar_engine(self):
+        from gatekeeper_tpu.client.client import Backend
+        from gatekeeper_tpu.engine.jax_driver import JaxDriver
+        from gatekeeper_tpu.client.local_driver import LocalDriver
+        from gatekeeper_tpu.target.k8s import K8sValidationTarget
+        rego = """package walkcheck
+violation[{"msg": msg}] {
+  walk(input.review.object, [path, value])
+  value == "forbidden"
+  msg := sprintf("forbidden value at %v", [path])
+}
+"""
+        tdoc = {"apiVersion": "templates.gatekeeper.sh/v1alpha1",
+                "kind": "ConstraintTemplate", "metadata": {"name": "walkcheck"},
+                "spec": {"crd": {"spec": {"names": {"kind": "WalkCheck"}}},
+                         "targets": [{"target": "admission.k8s.gatekeeper.sh",
+                                      "rego": rego}]}}
+        cdoc = {"apiVersion": "constraints.gatekeeper.sh/v1alpha1",
+                "kind": "WalkCheck", "metadata": {"name": "wc"}, "spec": {}}
+        obj = {"apiVersion": "v1", "kind": "Pod",
+               "metadata": {"name": "p", "namespace": "default",
+                            "labels": {"x": "forbidden"}},
+               "spec": {"containers": []}}
+        res = {}
+        for nm, drv in (("local", LocalDriver()), ("jax", JaxDriver())):
+            c = Backend(drv).new_client([K8sValidationTarget()])
+            c.add_template(tdoc)
+            c.add_constraint(cdoc)
+            c.add_data(obj)
+            res[nm] = sorted(r.msg for r in c.audit().results())
+        assert res["local"] == res["jax"]
+        assert res["local"] and "forbidden value at" in res["local"][0]
