@@ -199,6 +199,14 @@ class AuditManager:
     def start(self) -> None:
         if self._thread is not None:
             return
+        # async warmup: compile (or load from the persistent cache) the
+        # capped-audit executables before the first interval tick so a
+        # restart or template churn doesn't stall the first sweep
+        from gatekeeper_tpu.utils.compile_cache import warm_audit
+        drv = getattr(self.client, "driver", None)
+        if drv is not None and hasattr(drv, "executor"):
+            for target in getattr(drv, "targets", {}):
+                warm_audit(drv, target, cap=self.violations_limit)
         self._stop.clear()
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="audit-manager")

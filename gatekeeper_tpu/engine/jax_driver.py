@@ -46,6 +46,15 @@ from gatekeeper_tpu.engine.veval import ProgramExecutor
 from gatekeeper_tpu.ir.lower import CannotLower, lower_template
 from gatekeeper_tpu.ir.prep import build_bindings
 from gatekeeper_tpu.rego.values import freeze
+from gatekeeper_tpu.utils.metrics import Metrics
+
+
+SMALL_WORKLOAD_EVALS = 20_000
+"""Below this many (resource, constraint) pairs per kind, the scalar
+engine beats the device path: a single device dispatch+fetch costs a
+fixed ~100ms through a tunneled accelerator, which only amortizes over
+enough work.  The scalar path produces identical results (it is the
+oracle), so routing is purely a latency decision."""
 
 
 class JaxTargetState(TargetState):
@@ -70,6 +79,7 @@ class JaxDriver(LocalDriver):
     def __init__(self, tracing: bool = False):
         super().__init__(tracing=tracing)
         self.executor = ProgramExecutor()
+        self.metrics = Metrics()
 
     # ------------------------------------------------------------------
 
@@ -138,6 +148,8 @@ class JaxDriver(LocalDriver):
 
     def query_audit(self, target: str,
                     opts: QueryOpts | None = None) -> tuple[list[Result], str | None]:
+        import time as _time
+        _t0 = _time.perf_counter()
         st = self._state(target)
         if not isinstance(st, JaxTargetState):
             return super().query_audit(target, opts)
@@ -161,29 +173,48 @@ class JaxDriver(LocalDriver):
         # phase 1: dispatch every kind's device evaluation without
         # blocking — one packed-fetch round-trip per kind, all in
         # flight at once (run_topk_async; the tunnel latency of fetch
-        # N overlaps the execution of fetch N+1)
-        plans: list[tuple] = []
+        # N overlaps the execution of fetch N+1).  Dispatches run on a
+        # thread pool so first-time jit traces / XLA compiles of
+        # different kinds overlap (a 30-template library would
+        # otherwise pay its compiles serially on a cold start).
+        specs: list[tuple] = []
         for kind in sorted(st.templates):
             compiled = st.templates[kind]
             constraints = self._kind_constraints(st, kind)
             if not constraints:
                 continue
             mask = self._kind_mask(st, target, kind, constraints)
-            if compiled.vectorized is not None and mask is not None:
+            small = len(ordered_rows) * len(constraints) < SMALL_WORKLOAD_EVALS
+            if compiled.vectorized is not None and mask is not None and not small:
                 bindings = self._kind_bindings(st, kind, compiled, constraints)
                 prog = compiled.vectorized.program
-                if limit is not None:
-                    handle = self.executor.run_topk_async(
-                        prog, bindings, limit, match=mask, rank=rank)
-                    plans.append(("topk", kind, compiled, constraints, prog,
-                                  bindings, mask, handle))
-                else:
-                    handle = self.executor.run_async(prog, bindings, match=mask)
-                    plans.append(("mask", kind, compiled, constraints, prog,
-                                  bindings, mask, handle))
+                mode = "topk" if limit is not None else "mask"
+                specs.append((mode, kind, compiled, constraints, prog,
+                              bindings, mask))
             else:
-                plans.append(("scalar", kind, compiled, constraints, None,
-                              None, mask, None))
+                # unlowerable template — or a workload too small to
+                # amortize a device dispatch round-trip
+                specs.append(("scalar", kind, compiled, constraints, None,
+                              None, mask))
+
+        def dispatch(spec):
+            mode, _, _, _, prog, bindings, mask = spec
+            if mode == "topk":
+                return self.executor.run_topk_async(prog, bindings, limit,
+                                                    match=mask, rank=rank)
+            if mode == "mask":
+                return self.executor.run_async(prog, bindings, match=mask)
+            return None
+
+        n_dev = sum(1 for sp in specs if sp[0] != "scalar")
+        if n_dev > 1:
+            import concurrent.futures
+            with concurrent.futures.ThreadPoolExecutor(
+                    max_workers=min(8, n_dev)) as pool:
+                handles = list(pool.map(dispatch, specs))
+        else:
+            handles = [dispatch(sp) for sp in specs]
+        plans = [sp + (h,) for sp, h in zip(specs, handles)]
 
         # phase 2: host formatting per kind.  One (review, frozen)
         # per violating row for the whole sweep — rows recur across
@@ -204,6 +235,11 @@ class JaxDriver(LocalDriver):
                                   mask, ordered_rows, row_order, kind, limit,
                                   trace, tagged, rcache)
         tagged.sort(key=lambda kv: kv[0])
+        m = self.metrics
+        m.counter("audit_sweeps").inc()
+        m.counter("audit_results").inc(len(tagged))
+        m.timer("audit_sweep_wall").observe(_time.perf_counter() - _t0)
+        m.gauge("audit_resources").set(len(ordered_rows))
         return [r for _, r in tagged], ("\n".join(trace) if trace is not None else None)
 
     def _pair_results(self, st, target, kind, compiled, c, row, review,
@@ -230,11 +266,14 @@ class JaxDriver(LocalDriver):
         key = (cname, row)
         ent = entries.get(key)
         if ent is None or ent[0] != ver:
+            self.metrics.counter("format_memo_misses").inc()
             results = list(self._eval_pair(st, target, compiled, review,
                                            frozen, c, trace))
             if len(entries) > 65536:     # bound growth across churn
                 entries.clear()
             entries[key] = ent = (ver, results)
+        else:
+            self.metrics.counter("format_memo_hits").inc()
         # fresh copies (own metadata dict too): downstream sets
         # .resource and owns result.metadata — the cached canonical list
         # must stay pristine.  (metadata["details"] values are still
@@ -310,10 +349,14 @@ class JaxDriver(LocalDriver):
         only those.  If over-approximated pairs leave the cap
         under-filled while more candidates exist, fall back to the full
         mask for that constraint."""
+        import time as _time
         if handle is None:
             handle = self.executor.run_topk_async(prog, bindings, limit,
                                                   match=mask, rank=rank)
+        _tw = _time.perf_counter()
         counts, rows, valid = handle.get()
+        self.metrics.timer("device_wait").observe(_time.perf_counter() - _tw)
+        _tf = _time.perf_counter()
         full_cand = None
         for ci, c in enumerate(constraints):
             sel = [int(r) for r, v in zip(rows[ci], valid[ci]) if v]
@@ -333,6 +376,7 @@ class JaxDriver(LocalDriver):
                 self._emit_rows(st, target, handler, compiled, c, rest,
                                 row_order, kind, limit - emitted, trace, tagged,
                                 rcache)
+        self.metrics.timer("host_format").observe(_time.perf_counter() - _tf)
 
     def _emit_rows(self, st, target, handler, compiled, c, rows, row_order,
                    kind, limit, trace, tagged, rcache) -> int:
@@ -359,6 +403,8 @@ class JaxDriver(LocalDriver):
         match-mask candidates when a vector matcher exists."""
         emitted = {ci: 0 for ci in range(len(constraints))}
         for row in ordered_rows:
+            if limit is not None and all(e >= limit for e in emitted.values()):
+                break            # every constraint capped: stop scanning
             if st.table.meta_at(row) is None:
                 continue
             pair = None

@@ -379,10 +379,17 @@ class PendingTopK:
 
 
 class ProgramExecutor:
-    """Jit-cache wrapper: one compiled executable per (program, bucket)."""
+    """Jit-cache wrapper: one compiled executable per (program, bucket).
+    Executables also persist across processes via JAX's on-disk
+    compilation cache (utils/compile_cache) — a restart re-traces but
+    skips the multi-second XLA compile per (template, bucket)."""
 
     def __init__(self):
+        from gatekeeper_tpu.utils.compile_cache import enable_persistent_cache
+        enable_persistent_cache()
         self._cache: dict[tuple, Any] = {}
+        self.compiles = 0      # executable-cache misses (trace+compile)
+        self.cache_hits = 0    # executable-cache hits
 
     def _arrays(self, bindings: Bindings, match: np.ndarray | None,
                 rank: np.ndarray | None = None):
@@ -411,7 +418,10 @@ class ProgramExecutor:
                tuple((nm,) + tuple(arrays[nm].shape)
                      + (str(arrays[nm].dtype),) for nm in names))
         fn = self._cache.get(key)
-        if fn is None:
+        if fn is not None:
+            self.cache_hits += 1
+        else:
+            self.compiles += 1
             if topk is None:
                 def raw(args: tuple):
                     return _eval_mask(program, dict(zip(names, args)))

@@ -1,2 +1,3 @@
 from gatekeeper_tpu.library.templates import (  # noqa: F401
     LIBRARY, TARGET, all_docs, constraint_doc, template_doc)
+from gatekeeper_tpu.library.workload import make_mixed  # noqa: F401

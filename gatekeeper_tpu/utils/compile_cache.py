@@ -1,0 +1,60 @@
+"""Persistent compiled-executable cache + async warmup.
+
+The reference recompiles every Rego module on any PutModule
+(drivers/local/local.go:65-93) and pays that cost on every process
+start.  Here executables are cached at two levels:
+
+- in-process: ProgramExecutor's (program, shape-bucket) jit cache;
+- on disk: JAX/XLA's persistent compilation cache, keyed by HLO hash —
+  which is exactly (lowered template structure, shape bucket).  A
+  process restart re-traces (cheap) and reuses the compiled TPU
+  binary (expensive part), so the first audit after a restart does not
+  pay the multi-second XLA compile per template kind.
+
+`warm_audit` runs the capped-audit executables for every registered
+kind once on a background thread — template churn triggers compilation
+off the serving path (SURVEY §5 checkpoint/warmup bullet).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+_enabled = False
+_lock = threading.Lock()
+
+
+def enable_persistent_cache(path: str | None = None) -> str:
+    """Idempotently point JAX's persistent compilation cache at `path`
+    (default: $GATEKEEPER_XLA_CACHE_DIR or ./.gatekeeper_xla_cache)."""
+    global _enabled
+    with _lock:
+        path = path or os.environ.get("GATEKEEPER_XLA_CACHE_DIR") \
+            or os.path.join(os.getcwd(), ".gatekeeper_xla_cache")
+        if not _enabled:
+            import jax
+            jax.config.update("jax_compilation_cache_dir", path)
+            jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
+            _enabled = True
+        return path
+
+
+def warm_audit(driver, target: str, cap: int = 20,
+               block: bool = False) -> threading.Thread:
+    """Compile (and run once, on throwaway output) the capped-audit
+    executables for every template kind currently registered — in the
+    background unless `block`."""
+    def run():
+        try:
+            from gatekeeper_tpu.client.interface import QueryOpts
+            driver.query_audit(target, QueryOpts(limit_per_constraint=cap))
+        except Exception:
+            pass  # warmup is best-effort; real sweeps surface errors
+
+    t = threading.Thread(target=run, name="audit-warmup", daemon=True)
+    t.start()
+    if block:
+        t.join()
+    return t

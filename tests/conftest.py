@@ -18,3 +18,10 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_num_cpu_devices", 8)
+
+# Test workloads are tiny; without this the adaptive small-workload
+# routing would send every driver-level test down the scalar path and
+# silently stop exercising the device engine.
+from gatekeeper_tpu.engine import jax_driver  # noqa: E402
+
+jax_driver.SMALL_WORKLOAD_EVALS = 0

@@ -199,6 +199,29 @@ def test_audit_equivalence_chunked(monkeypatch):
         assert rs == by_con_full[name][: len(rs)]
 
 
+def test_small_workload_scalar_routing(monkeypatch):
+    """With the adaptive threshold active, small workloads route down
+    the scalar path — results must be identical to the device path."""
+    from gatekeeper_tpu.engine import jax_driver as jd_mod
+    local, jx = _mk_clients()
+    _setup(local, n_pods=25)
+    _setup(jx, n_pods=25)
+    monkeypatch.setattr(jd_mod, "SMALL_WORKLOAD_EVALS", 10**9)
+    lres = local.audit().results()
+    jres = jx.audit().results()
+    assert len(lres) > 0
+    assert [_results_key(r) for r in lres] == [_results_key(r) for r in jres]
+    jcap = jx.driver.query_audit("admission.k8s.gatekeeper.sh",
+                                 QueryOpts(limit_per_constraint=2))[0]
+    # a single (row, constraint) pair may emit several results; the cap
+    # bounds the number of distinct rows per constraint
+    by: dict = {}
+    for r in jcap:
+        by.setdefault(r.constraint["metadata"]["name"], set()).add(
+            (r.review or {}).get("name"))
+    assert by and all(len(v) <= 2 for v in by.values())
+
+
 def test_capped_format_memo_invalidation():
     """The per-pair formatting memo must reflect row updates, constraint
     updates, and (for inventory templates) any table change."""
