@@ -244,7 +244,7 @@ def bench_library(detail):
     log(f"[library] {len(LIBRARY)} templates ({lowered} device-lowered) x {n}:"
         f" steady {best*1e3:.0f}ms ({n_res} capped results), cold {cold_s:.1f}s,"
         f" cpu oracle ~{t_cpu:.1f}s")
-    detail["library_100k"] = {
+    detail[f"library_{n}"] = {
         "n_resources": n, "n_templates": len(LIBRARY),
         "device_lowered": lowered, "steady_seconds": round(best, 4),
         "cold_seconds": round(cold_s, 2), "ingest_seconds": round(ingest_s, 2),
@@ -259,7 +259,7 @@ def bench_regex_heavy(detail):
     kinds = ["K8sImageDigests", "K8sDisallowedTags", "K8sNoEnvVarSecrets"]
     templates = [template_doc(k, LIBRARY[k][0]) for k in kinds]
     constraints = [constraint_doc(k, k.lower(), LIBRARY[k][1]) for k in kinds]
-    bench_two_engines(detail, "regex_heavy_100k", resources, templates,
+    bench_two_engines(detail, f"regex_heavy_{n}", resources, templates,
                       constraints, oracle_n=2_000)
 
 

@@ -388,6 +388,7 @@ class ProgramExecutor:
         from gatekeeper_tpu.utils.compile_cache import enable_persistent_cache
         enable_persistent_cache()
         self._cache: dict[tuple, Any] = {}
+        self._lock = __import__("threading").Lock()   # dispatch runs threaded
         self.compiles = 0      # executable-cache misses (trace+compile)
         self.cache_hits = 0    # executable-cache hits
 
@@ -417,11 +418,13 @@ class ProgramExecutor:
         key = (program.cache_key(), topk, R_CHUNK,
                tuple((nm,) + tuple(arrays[nm].shape)
                      + (str(arrays[nm].dtype),) for nm in names))
-        fn = self._cache.get(key)
-        if fn is not None:
-            self.cache_hits += 1
-        else:
-            self.compiles += 1
+        with self._lock:
+            fn = self._cache.get(key)
+            if fn is not None:
+                self.cache_hits += 1
+        if fn is None:
+            with self._lock:
+                self.compiles += 1
             if topk is None:
                 def raw(args: tuple):
                     return _eval_mask(program, dict(zip(names, args)))
@@ -433,7 +436,8 @@ class ProgramExecutor:
                         [counts[:, None], rows, valid.astype(jnp.int32)],
                         axis=1)                    # packed [C, 1+2k]
             fn = jax.jit(raw)
-            self._cache[key] = fn
+            with self._lock:
+                fn = self._cache.setdefault(key, fn)
         return fn, names
 
     def run_async(self, program: Program, bindings: Bindings,

@@ -322,6 +322,8 @@ class Lowerer:
         self.env: dict[str, Sym] = {}
         self.elem: tuple[str, tuple[str, ...]] | None = None
         self.conjuncts: list[int] = []
+        self._retired_axes: set[str] = set()
+        self._rule_axis_leaves: set[str] = set()   # axis roots emitted in this rule
         self._inline_depth = 0
         # set by _inline_function when the subtree being lowered contains
         # an inexact (over-approximating) inlined call, so exactness
@@ -338,6 +340,8 @@ class Lowerer:
             self.env = {}
             self.elem = None
             self.conjuncts = []
+            self._retired_axes = set()
+            self._rule_axis_leaves = set()
             try:
                 self._lower_rule(rule)
             except _RuleNeverFires:
@@ -362,6 +366,12 @@ class Lowerer:
         return len(self.nodes) - 1
 
     def _emit_leaf(self, leaf: LeafId, mode: str) -> int:
+        if leaf.root in self._retired_axes:
+            # the parent of a nested flattened axis carries no device
+            # columns in this rule — a conjunct here would mix axes
+            raise CannotLower("conjunct on the parent of a nested axis")
+        if leaf.root not in ("obj", "meta"):
+            self._rule_axis_leaves.add(leaf.root)
         key = (leaf, mode)
         hit = self._leaf_nodes.get(key)
         if hit is not None:
@@ -416,6 +426,17 @@ class Lowerer:
             if isinstance(base, Var) and base.name == "data":
                 raise CannotLower("data.inventory access")
             if isinstance(base, Var) and isinstance(self.env.get(base.name), SLeaf):
+                sym = self.env[base.name]
+                mid, lastp = term.path[:-1], (term.path[-1] if term.path else None)
+                if isinstance(lastp, Var) and lastp.is_wildcard \
+                        and all(isinstance(p, Scalar) for p in mid):
+                    # nested iteration (containers[_].env[_]): a leaf dep
+                    # of the (future) flattened axis — _try_nested_elem
+                    # resolves it at assignment time
+                    d.leaves.add(LeafId(sym.leaf.root,
+                                        sym.leaf.path
+                                        + tuple(p.value for p in mid)))
+                    return d
                 raise CannotLower("dynamic path under a leaf binding")
             db = self._deps(base, bound)
             d.merge(db)
@@ -671,12 +692,13 @@ class Lowerer:
         return name
 
     def _make_cset(self, term: Term, env_vars: tuple[str, ...],
-                   iterate: bool, encode: str) -> str:
+                   iterate: bool, encode: str, drop_false: bool = False) -> str:
         name = f"cs{next(self.serial)}"
         env_map = dict(self.env)
         self._check_cenv(env_vars, env_map)
 
-        def fn(c, _t=term, _ev=env_vars, _it=iterate, _em=env_map):
+        def fn(c, _t=term, _ev=env_vars, _it=iterate, _em=env_map,
+               _df=drop_false):
             if _it:
                 vals = self._ceval_iter(self._cinput(c), _t, _ev, _em)
             else:
@@ -688,6 +710,8 @@ class Lowerer:
                     return None
                 if isinstance(v, frozenset):
                     vals = sorted(vals, key=repr)
+            if _df:
+                vals = [x for x in vals if x is not False]
             # elements stay frozen: prep's encode_value handles scalars
             # and compounds alike (a compound element must match only
             # equal compounds, never null)
@@ -847,6 +871,13 @@ class Lowerer:
             nid = sym.nid
         elif isinstance(sym, SLeafExpr):
             nid = self._table_node(sym, "bool")
+        elif isinstance(sym, SParamPred):
+            # statement `pred(leaf, p)` with p iterating a constraint
+            # list: fires iff SOME param satisfies (Rego existential);
+            # `not` is then none-satisfies — both exact (the predicate
+            # is host-evaluated per (value, param))
+            nid = self._ptable_node(sym.leaf, sym.pred_term, sym.pvar,
+                                    sym.iter_term, sym.iter_env, mode="any")
         else:
             raise CannotLower(f"conjunct from {type(sym).__name__}")
         return self._emit("not", (nid,)) if negated else nid
@@ -952,6 +983,11 @@ class Lowerer:
             # comprehensions, an undefined ref makes the whole term
             # undefined in the oracle's _eval_term)
             for leaf in self._direct_leaves(rhs):
+                if leaf.root in self._retired_axes:
+                    # parent-axis field feeding only the head: skip the
+                    # definedness conjunct (over-approximation — host
+                    # re-eval filters pairs whose msg is undefined)
+                    continue
                 self.conjuncts.append(self._emit_leaf(leaf, "present"))
             return
         sym = self._rhs_sym(rhs)
@@ -1002,6 +1038,9 @@ class Lowerer:
         elem = self._try_elem_binding(rhs)
         if elem is not None:
             return elem
+        elem = self._try_nested_elem(rhs)
+        if elem is not None:
+            return elem
         # constraint-list iteration: p := input.constraint...xs[_]
         it = self._try_citer(rhs)
         if it is not None:
@@ -1036,6 +1075,44 @@ class Lowerer:
         self.axes[axis] = base
         return SLeaf(LeafId(axis, ()))
 
+    def _try_nested_elem(self, rhs: Term) -> Sym | None:
+        """``x := <elem-var>.<path>[_]`` — nested list iteration under an
+        element binding (``containers[_].env[_]``), lowered as ONE
+        flattened element axis (prep flattens at the ``"*"`` segment).
+        The parent axis then carries no device columns in this rule:
+        parent fields may feed the head (host-formatted, presence
+        over-approximated) but not conjuncts."""
+        if not isinstance(rhs, Ref) or not isinstance(rhs.base, Var):
+            return None
+        sym = self.env.get(rhs.base.name)
+        if not isinstance(sym, SLeaf) or sym.leaf.root in ("obj", "meta"):
+            return None
+        path = rhs.path
+        if not path:
+            return None
+        last = path[-1]
+        if not (isinstance(last, Var) and last.is_wildcard):
+            return None
+        if not all(isinstance(p, Scalar) for p in path[:-1]):
+            raise CannotLower("computed key in nested iteration")
+        parent_key = sym.leaf.root
+        if parent_key in self._rule_axis_leaves:
+            # a conjunct of THIS rule already emitted a device column on
+            # the parent axis (other rules' columns don't conflict —
+            # each rule reduces over its own elem_axis)
+            raise CannotLower("parent-axis leaf before nested iteration")
+        rel = sym.leaf.path + tuple(p.value for p in path[:-1])
+        if not rel:
+            raise CannotLower("nested iteration directly over the element")
+        base = self.axes[parent_key] + ("*",) + rel
+        key = ".".join(base)
+        if self.elem is not None and self.elem[0] not in (parent_key, key):
+            raise CannotLower("multiple element axes in one rule")
+        self.elem = (key, base)
+        self.axes[key] = base
+        self._retired_axes.add(parent_key)
+        return SLeaf(LeafId(key, ()))
+
     def _try_citer(self, rhs: Term) -> Sym | None:
         if not isinstance(rhs, Ref):
             return None
@@ -1056,6 +1133,11 @@ class Lowerer:
     # -- value lowering ------------------------------------------------
 
     def _lower_value(self, term: Term) -> Sym:
+        # a var bound to a constraint-list iterator stays an iterator
+        # (the membership/ptable recognizers consume it); wrapping it as
+        # a plain constraint term would lose the per-element semantics
+        if isinstance(term, Var) and isinstance(self.env.get(term.name), SCIter):
+            return self.env[term.name]
         d = self._deps(term)
         for v in list(d.env_vars):
             d.merge(self._sym_deps(self.env[v]))
@@ -1082,6 +1164,9 @@ class Lowerer:
             leaf = _resolve_ref_leaf(term, self.axes, self.env)
             if leaf is not None:
                 return SLeaf(leaf)
+            memb = self._try_cset_member_ref(term)
+            if memb is not None:
+                return memb
             raise CannotLower("unresolvable reference")
         if isinstance(term, Comprehension):
             pat = self._try_label_keys(term)
@@ -1333,6 +1418,38 @@ class Lowerer:
         return self._emit("in_cset", (idx,), (csname,))
 
     # -- comprehension patterns ----------------------------------------
+
+    def _try_cset_member_ref(self, term: Ref) -> Sym | None:
+        """``<constraint-set>[<leaf>]`` -> in_cset membership node (the
+        K8sExternalIPs / allowed-set pattern).  Rego set[x] as a
+        statement fires iff x ∈ set AND the member is truthy; literal
+        ``false`` members are dropped from the device set so both
+        polarities stay exact (a false member can never fire the
+        statement in the oracle either)."""
+        if not (isinstance(term.base, Var) and len(term.path) == 1):
+            return None
+        bsym = self.env.get(term.base.name)
+        if not isinstance(bsym, SCTerm):
+            return None
+        key = term.path[0]
+        ks: Sym | None = None
+        if isinstance(key, Var):
+            ks = self.env.get(key.name)
+        elif isinstance(key, Ref):
+            kleaf = _resolve_ref_leaf(key, self.axes, self.env)
+            if kleaf is not None:
+                ks = SLeaf(kleaf)
+        if not isinstance(ks, (SLeaf, SLeafExpr)):
+            return None
+        if isinstance(ks, SLeaf):
+            ns = "str" if ks.leaf.root == "meta" else "val"
+            idx = self._emit_leaf(ks.leaf, "str" if ns == "str" else "val")
+        else:
+            ns = "val"
+            idx = self._table_node(ks, "id_val")
+        csname = self._make_cset(bsym.term, bsym.env_vars, iterate=False,
+                                 encode=ns, drop_false=True)
+        return SNode(self._emit("in_cset", (idx,), (csname,)), "bool")
 
     def _try_label_keys(self, term: Comprehension) -> Sym | None:
         """{k | input.review.object.<path>[k]} -> ragged key set."""

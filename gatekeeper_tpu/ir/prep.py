@@ -211,8 +211,25 @@ class PrepSpec:
 
 
 def _elem_rows(obj: Any, base: tuple[str, ...]):
-    v = get_path(obj, base)
-    return v if isinstance(v, list) else []
+    """Elements of the list at `base`.  A ``"*"`` segment flattens an
+    intermediate list axis (``spec.containers.*.env`` yields every env
+    entry of every container, nested iteration ``containers[_].env[_]``
+    collapsed onto one flattened element axis)."""
+    cur = [obj]
+    for p in base:
+        if p == "*":
+            nxt: list = []
+            for v in cur:
+                if isinstance(v, list):
+                    nxt.extend(v)
+            cur = nxt
+        else:
+            cur = [v[p] for v in cur if isinstance(v, dict) and p in v]
+    out: list = []
+    for v in cur:
+        if isinstance(v, list):
+            out.extend(v)
+    return out
 
 
 def build_elem_arrays(objs: list, base: tuple[str, ...], rels: list[tuple[tuple[str, ...], str]],

@@ -27,17 +27,24 @@ _lock = threading.Lock()
 
 def enable_persistent_cache(path: str | None = None) -> str:
     """Idempotently point JAX's persistent compilation cache at `path`
-    (default: $GATEKEEPER_XLA_CACHE_DIR or ./.gatekeeper_xla_cache)."""
+    (default: $GATEKEEPER_XLA_CACHE_DIR or ./.gatekeeper_xla_cache).
+    A cache dir the embedding application already configured wins — it
+    is never clobbered.  Returns the path actually in effect."""
     global _enabled
     with _lock:
+        import jax
+        existing = getattr(jax.config, "jax_compilation_cache_dir", None)
+        if existing:
+            _enabled = True
+            return existing
+        if _enabled:
+            return getattr(jax.config, "jax_compilation_cache_dir", "") or ""
         path = path or os.environ.get("GATEKEEPER_XLA_CACHE_DIR") \
             or os.path.join(os.getcwd(), ".gatekeeper_xla_cache")
-        if not _enabled:
-            import jax
-            jax.config.update("jax_compilation_cache_dir", path)
-            jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
-            jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
-            _enabled = True
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
+        _enabled = True
         return path
 
 

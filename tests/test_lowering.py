@@ -220,3 +220,143 @@ violation[{"msg": "m"}] {
     compiled = compile_target_rego("P", "k8s", rego)
     with pytest.raises(CannotLower):
         lower_template(compiled.module, compiled.interp)
+
+
+NESTED_ENV = """package nested
+violation[{"msg": msg}] {
+  container := input.review.object.spec.containers[_]
+  env := container.env[_]
+  re_match("(?i)(secret|token)", env.name)
+  env.value
+  msg := sprintf("container <%v> env <%v>", [container.name, env.name])
+}
+"""
+
+NESTED_CAPS = """package nestedcaps
+violation[{"msg": msg}] {
+  container := input.review.object.spec.containers[_]
+  cap := container.securityContext.capabilities.add[_]
+  bad := input.constraint.spec.parameters.disallowed[_]
+  cap == bad
+  msg := sprintf("cap %v", [cap])
+}
+"""
+
+
+class TestNestedElementAxes:
+    def _pair(self):
+        from gatekeeper_tpu.client.client import Backend
+        from gatekeeper_tpu.client.local_driver import LocalDriver
+        from gatekeeper_tpu.engine.jax_driver import JaxDriver
+        from gatekeeper_tpu.target.k8s import K8sValidationTarget
+        return (Backend(LocalDriver()).new_client([K8sValidationTarget()]),
+                Backend(JaxDriver()).new_client([K8sValidationTarget()]))
+
+    def _tdoc(self, kind, rego):
+        return {"apiVersion": "templates.gatekeeper.sh/v1alpha1",
+                "kind": "ConstraintTemplate", "metadata": {"name": kind.lower()},
+                "spec": {"crd": {"spec": {"names": {"kind": kind}}},
+                         "targets": [{"target": "admission.k8s.gatekeeper.sh",
+                                      "rego": rego}]}}
+
+    def _cdoc(self, kind, name, params=None):
+        return {"apiVersion": "constraints.gatekeeper.sh/v1alpha1",
+                "kind": kind, "metadata": {"name": name},
+                "spec": ({"parameters": params} if params else {})}
+
+    def test_nested_env_parity_and_lowered(self):
+        local, jx = self._pair()
+        pods = [
+            # multiple containers x multiple envs, hits in different spots
+            {"apiVersion": "v1", "kind": "Pod",
+             "metadata": {"name": "p1", "namespace": "d"},
+             "spec": {"containers": [
+                 {"name": "a", "env": [{"name": "API_TOKEN", "value": "x"},
+                                        {"name": "HOME", "value": "/"}]},
+                 {"name": "b", "env": [{"name": "MY_SECRET", "value": "y"}]}]}},
+            # env without value (valueFrom) must not fire the truthy conjunct
+            {"apiVersion": "v1", "kind": "Pod",
+             "metadata": {"name": "p2", "namespace": "d"},
+             "spec": {"containers": [
+                 {"name": "c", "env": [{"name": "TOKEN_X",
+                                        "valueFrom": {"secretKeyRef": {}}}]}]}},
+            # empty env / missing env / non-dict containers entries
+            {"apiVersion": "v1", "kind": "Pod",
+             "metadata": {"name": "p3", "namespace": "d"},
+             "spec": {"containers": [{"name": "d", "env": []}, {"name": "e"}]}},
+            {"apiVersion": "v1", "kind": "Pod",
+             "metadata": {"name": "p4", "namespace": "d"},
+             "spec": {"containers": "notalist"}},
+        ]
+        for c in (local, jx):
+            c.add_template(self._tdoc("NestedEnv", NESTED_ENV))
+            c.add_constraint(self._cdoc("NestedEnv", "ne"))
+            for p in pods:
+                c.add_data(p)
+        st = jx.driver.state["admission.k8s.gatekeeper.sh"]
+        assert st.templates["NestedEnv"].vectorized is not None
+        lmsg = sorted(r.msg for r in local.audit().results())
+        jmsg = sorted(r.msg for r in jx.audit().results())
+        assert lmsg == jmsg
+        assert lmsg == ["container <a> env <API_TOKEN>",
+                        "container <b> env <MY_SECRET>"]
+
+    def test_nested_caps_membership_parity(self):
+        local, jx = self._pair()
+        pods = [{"apiVersion": "v1", "kind": "Pod",
+                 "metadata": {"name": f"p{i}", "namespace": "d"},
+                 "spec": {"containers": [
+                     {"name": "a", "securityContext": {"capabilities":
+                         {"add": caps}}}]}}
+                for i, caps in enumerate([["SYS_ADMIN"], ["CHOWN"],
+                                          ["NET_ADMIN", "SYS_ADMIN"], []])]
+        for c in (local, jx):
+            c.add_template(self._tdoc("NestedCaps", NESTED_CAPS))
+            c.add_constraint(self._cdoc("NestedCaps", "nc",
+                                        {"disallowed": ["SYS_ADMIN", "NET_ADMIN"]}))
+            for p in pods:
+                c.add_data(p)
+        st = jx.driver.state["admission.k8s.gatekeeper.sh"]
+        assert st.templates["NestedCaps"].vectorized is not None
+        lres = sorted((r.msg, (r.review or {}).get("name"))
+                      for r in local.audit().results())
+        jres = sorted((r.msg, (r.review or {}).get("name"))
+                      for r in jx.audit().results())
+        assert lres == jres
+        assert len(lres) == 3  # p0: SYS_ADMIN; p2: NET_ADMIN + SYS_ADMIN
+
+    def test_nested_axis_independent_of_rule_order(self):
+        """A rule touching the parent axis must not knock a sibling
+        nested-axis rule (or the whole template) off the device path."""
+        from gatekeeper_tpu.api.templates import compile_target_rego
+        from gatekeeper_tpu.ir.lower import lower_template
+        both_orders = [
+            """package t
+violation[{"msg": "img"}] {
+  container := input.review.object.spec.containers[_]
+  container.image == "bad"
+}
+violation[{"msg": msg}] {
+  container := input.review.object.spec.containers[_]
+  env := container.env[_]
+  env.name == "SECRET"
+  msg := "env"
+}
+""",
+            """package t
+violation[{"msg": msg}] {
+  container := input.review.object.spec.containers[_]
+  env := container.env[_]
+  env.name == "SECRET"
+  msg := "env"
+}
+violation[{"msg": "img"}] {
+  container := input.review.object.spec.containers[_]
+  container.image == "bad"
+}
+""",
+        ]
+        for rego in both_orders:
+            ct = compile_target_rego("T", "admission.k8s.gatekeeper.sh", rego)
+            lp = lower_template(ct.module, ct.interp)
+            assert lp.n_rules_lowered == 2, rego
