@@ -1,0 +1,261 @@
+"""The ``remote`` driver: out-of-process engine worker over HTTP.
+
+The reference ships two drivers behind its seam: in-process OPA
+(drivers/local) and an HTTP client speaking to a remote OPA
+(vendor/.../drivers/remote/remote.go:49-100, one URL per API:
+PutModule -> PUT /v1/policies/<name>, Query -> POST /v1/data/...).
+This is that second kind for the TPU engine: the control plane
+(controllers, webhook, audit manager) runs in one process while the
+evaluation engine — typically a JaxDriver owning the TPU — runs in a
+worker process.  The wire protocol is one POST per Driver-seam method
+with JSON bodies; templates travel as Rego source and are re-compiled
+worker-side (exactly how the reference's remote OPA receives modules).
+
+``EngineWorker`` hosts any Driver implementation; ``RemoteDriver`` is
+the client half, implementing the same seam so ``Backend``/``Client``
+cannot tell the difference (the conformance suite runs against it).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable
+
+from gatekeeper_tpu.api.templates import CompiledTemplate, compile_target_rego
+from gatekeeper_tpu.client.interface import Driver, QueryOpts
+from gatekeeper_tpu.client.targets import TargetHandler
+from gatekeeper_tpu.client.types import Result
+from gatekeeper_tpu.errors import ClientError
+from gatekeeper_tpu.store.table import ResourceMeta
+
+# worker-side registry: target name -> handler factory (handlers are
+# code, not data — the worker constructs its own, like a remote OPA
+# owning its own regolib)
+TARGET_REGISTRY: dict[str, Callable[[], TargetHandler]] = {}
+
+
+def register_target(name: str, factory: Callable[[], TargetHandler]) -> None:
+    TARGET_REGISTRY[name] = factory
+
+
+def _default_registry() -> None:
+    from gatekeeper_tpu.target.k8s import TARGET_NAME, K8sValidationTarget
+    TARGET_REGISTRY.setdefault(TARGET_NAME, K8sValidationTarget)
+
+
+def _result_to_wire(r: Result) -> dict:
+    return {"msg": r.msg, "metadata": r.metadata, "constraint": r.constraint,
+            "review": r.review, "resource": r.resource,
+            "enforcement_action": r.enforcement_action}
+
+
+def _result_from_wire(d: dict) -> Result:
+    return Result(msg=d.get("msg", ""), metadata=d.get("metadata") or {},
+                  constraint=d.get("constraint"), review=d.get("review"),
+                  resource=d.get("resource"),
+                  enforcement_action=d.get("enforcement_action", "deny"))
+
+
+def _opts_to_wire(opts: QueryOpts | None) -> dict | None:
+    if opts is None:
+        return None
+    return {"tracing": opts.tracing,
+            "limit_per_constraint": opts.limit_per_constraint}
+
+
+def _opts_from_wire(d: dict | None) -> QueryOpts | None:
+    if d is None:
+        return None
+    return QueryOpts(tracing=bool(d.get("tracing")),
+                     limit_per_constraint=d.get("limit_per_constraint"))
+
+
+class EngineWorker:
+    """HTTP server hosting a Driver (usually a JaxDriver owning the
+    accelerator).  One POST endpoint per seam method.  ``driver`` may be
+    an instance or a zero-arg factory; with a factory, each ``init``
+    from a (re)connecting control plane gets a FRESH driver — a
+    restarted manager must not inherit templates/constraints/data a
+    previous manager synced (they would never be garbage-collected)."""
+
+    def __init__(self, driver: Driver | Callable[[], Driver],
+                 host: str = "127.0.0.1", port: int = 0):
+        _default_registry()
+        if callable(driver) and not isinstance(driver, Driver):
+            self._factory: Callable[[], Driver] | None = driver
+            self.driver = driver()
+        else:
+            self._factory = None
+            self.driver = driver
+        outer = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):  # quiet
+                pass
+
+            def do_POST(self):
+                try:
+                    length = int(self.headers.get("Content-Length", "0"))
+                    body = json.loads(self.rfile.read(length) or b"{}")
+                    method = self.path.strip("/").split("/")[-1]
+                    out = outer._dispatch(method, body)
+                    payload = json.dumps(out).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(payload)))
+                    self.end_headers()
+                    self.wfile.write(payload)
+                except ClientError as e:
+                    self.send_error(400, str(e))
+                except Exception as e:  # worker must not die on a bad call
+                    self.send_error(500, f"{type(e).__name__}: {e}")
+
+        self._server = ThreadingHTTPServer((host, port), _Handler)
+        self.port = self._server.server_address[1]
+        self.url = f"http://{host}:{self.port}"
+        self._thread: threading.Thread | None = None
+
+    # ------------------------------------------------------------------
+
+    def _dispatch(self, method: str, b: dict) -> Any:
+        d = self.driver
+        if method == "init":
+            targets = {}
+            for name in b["targets"]:
+                factory = TARGET_REGISTRY.get(name)
+                if factory is None:
+                    raise ClientError(f"worker has no target {name!r}")
+                targets[name] = factory()
+            if self._factory is not None:
+                self.driver = d = self._factory()   # fresh state per client
+            d.init(targets)
+            return {"ok": True}
+        if method == "put_template":
+            compiled = compile_target_rego(b["kind"], b["target"], b["source"])
+            d.put_template(b["target"], b["kind"], compiled)
+            return {"ok": True}
+        if method == "delete_template":
+            d.delete_template(b["target"], b["kind"])
+            return {"ok": True}
+        if method == "put_constraint":
+            d.put_constraint(b["target"], b["kind"], b["name"], b["constraint"])
+            return {"ok": True}
+        if method == "delete_constraint":
+            d.delete_constraint(b["target"], b["kind"], b["name"])
+            return {"ok": True}
+        if method == "put_data":
+            m = b["meta"]
+            meta = ResourceMeta(m["api_version"], m["kind"], m["name"],
+                                m.get("namespace"))
+            d.put_data(b["target"], b["key"], meta, b["obj"])
+            return {"ok": True}
+        if method == "delete_data":
+            return {"removed": d.delete_data(b["target"], b["key"])}
+        if method == "wipe_data":
+            d.wipe_data(b["target"])
+            return {"ok": True}
+        if method == "query_review":
+            results, trace = d.query_review(b["target"], b["review"],
+                                            _opts_from_wire(b.get("opts")))
+            return {"results": [_result_to_wire(r) for r in results],
+                    "trace": trace}
+        if method == "query_audit":
+            results, trace = d.query_audit(b["target"],
+                                           _opts_from_wire(b.get("opts")))
+            return {"results": [_result_to_wire(r) for r in results],
+                    "trace": trace}
+        if method == "dump":
+            return {"dump": d.dump()}
+        raise ClientError(f"unknown method {method!r}")
+
+    # ------------------------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._server.serve_forever,
+                                            daemon=True, name="engine-worker")
+            self._thread.start()
+
+    def stop(self) -> None:
+        if self._thread is not None:
+            # BaseServer.shutdown blocks on an event only serve_forever
+            # sets — calling it without a running thread hangs forever
+            self._server.shutdown()
+        self._server.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+
+
+class RemoteDriver(Driver):
+    """Driver-seam client forwarding every call to an EngineWorker."""
+
+    def __init__(self, url: str, timeout: float = 60.0):
+        self.url = url.rstrip("/")
+        self.timeout = timeout
+
+    def _call(self, method: str, body: dict) -> dict:
+        req = urllib.request.Request(
+            f"{self.url}/v1/{method}", data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                return json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            detail = e.read().decode(errors="replace")[:500]
+            raise ClientError(f"worker {method} failed: {e.code} {detail}")
+        except urllib.error.URLError as e:
+            raise ClientError(f"worker unreachable at {self.url}: {e.reason}")
+
+    # ------------------------------------------------------------------
+
+    def init(self, targets: dict[str, TargetHandler]) -> None:
+        self._call("init", {"targets": sorted(targets)})
+
+    def put_template(self, target: str, kind: str,
+                     compiled: CompiledTemplate) -> None:
+        self._call("put_template", {"target": target, "kind": kind,
+                                    "source": compiled.source})
+
+    def delete_template(self, target: str, kind: str) -> None:
+        self._call("delete_template", {"target": target, "kind": kind})
+
+    def put_constraint(self, target: str, kind: str, name: str,
+                       constraint: dict) -> None:
+        self._call("put_constraint", {"target": target, "kind": kind,
+                                      "name": name, "constraint": constraint})
+
+    def delete_constraint(self, target: str, kind: str, name: str) -> None:
+        self._call("delete_constraint", {"target": target, "kind": kind,
+                                         "name": name})
+
+    def put_data(self, target: str, key: str, meta: ResourceMeta,
+                 obj: dict) -> None:
+        self._call("put_data", {
+            "target": target, "key": key, "obj": obj,
+            "meta": {"api_version": meta.api_version, "kind": meta.kind,
+                     "name": meta.name, "namespace": meta.namespace}})
+
+    def delete_data(self, target: str, key: str) -> bool:
+        return bool(self._call("delete_data",
+                               {"target": target, "key": key})["removed"])
+
+    def wipe_data(self, target: str) -> None:
+        self._call("wipe_data", {"target": target})
+
+    def query_review(self, target: str, review: dict,
+                     opts: QueryOpts | None = None):
+        out = self._call("query_review", {"target": target, "review": review,
+                                          "opts": _opts_to_wire(opts)})
+        return [_result_from_wire(r) for r in out["results"]], out.get("trace")
+
+    def query_audit(self, target: str, opts: QueryOpts | None = None):
+        out = self._call("query_audit", {"target": target,
+                                         "opts": _opts_to_wire(opts)})
+        return [_result_from_wire(r) for r in out["results"]], out.get("trace")
+
+    def dump(self) -> dict:
+        return self._call("dump", {})["dump"]

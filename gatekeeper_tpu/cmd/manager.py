@@ -68,7 +68,14 @@ class Manager:
         self.metrics = Metrics()
         self.cluster = cluster if cluster is not None else FakeCluster()
         bootstrap_cluster(self.cluster)
-        driver = JaxDriver(tracing=False)
+        if getattr(args, "engine_worker_url", None):
+            # engine-process split: the evaluation engine (and the TPU)
+            # live in a worker process behind the Driver seam
+            # (reference drivers/remote analogue, remote.go:49)
+            from gatekeeper_tpu.client.remote_driver import RemoteDriver
+            driver = RemoteDriver(args.engine_worker_url)
+        else:
+            driver = JaxDriver(tracing=False)
         self.client = Backend(driver).new_client([K8sValidationTarget()])
         self.plane: ControlPlane = add_to_manager(self.cluster, self.client)
         self.batcher = MicroBatcher(
@@ -115,6 +122,9 @@ def parse_args(argv=None) -> argparse.Namespace:
                    help="admission micro-batch window")
     p.add_argument("--max-batch", type=int, default=64,
                    help="admission micro-batch size cap")
+    p.add_argument("--engine-worker-url", default=None,
+                   help="run evaluation in a separate engine worker "
+                        "process at this URL (see cmd/worker)")
     p.add_argument("--demo", action="store_true",
                    help="seed demo/basic (1k namespaces + required-labels) "
                         "and run one audit sweep")

@@ -1,0 +1,38 @@
+"""Engine worker process: a JaxDriver (owning the accelerator) served
+over the Driver seam (reference drivers/remote analogue, remote.go:49).
+
+Run ``python -m gatekeeper_tpu.cmd.worker --port 8686`` next to a
+manager started with ``--engine-worker-url http://127.0.0.1:8686`` —
+the control plane stays responsive while evaluation (and XLA
+compilation) happens out of process.
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+import threading
+
+from gatekeeper_tpu.client.remote_driver import EngineWorker
+from gatekeeper_tpu.engine.jax_driver import JaxDriver
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="gatekeeper-tpu-worker")
+    p.add_argument("--port", type=int, default=8686)
+    p.add_argument("--host", default="127.0.0.1")
+    args = p.parse_args(argv)
+    worker = EngineWorker(JaxDriver, host=args.host, port=args.port)
+    worker.start()
+    print(f"engine worker up at {worker.url}", file=sys.stderr)
+    stop = threading.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        signal.signal(sig, lambda *_: stop.set())
+    stop.wait()
+    worker.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

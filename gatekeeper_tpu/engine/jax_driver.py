@@ -242,6 +242,42 @@ class JaxDriver(LocalDriver):
         m.gauge("audit_resources").set(len(ordered_rows))
         return [r for _, r in tagged], ("\n".join(trace) if trace is not None else None)
 
+    def explain_pair(self, target: str, kind: str, constraint_name: str,
+                     resource_key: str) -> str:
+        """Device-path mask dump for one (constraint, resource) pair:
+        every IR node's value on that slice plus rule verdicts (the
+        tracing equivalent for the vectorized engine, SURVEY §5), with
+        the scalar oracle's verdict appended for cross-checking."""
+        from gatekeeper_tpu.engine.veval import explain
+        st = self._state(target)
+        compiled = st.templates.get(kind)
+        if compiled is None:
+            return f"no template {kind!r}"
+        row = st.table.lookup(resource_key)
+        if row is None:
+            return f"no resource {resource_key!r}"
+        constraints = self._kind_constraints(st, kind)
+        names = [(c.get("metadata") or {}).get("name") for c in constraints]
+        if constraint_name not in names:
+            return f"no constraint {constraint_name!r} of kind {kind!r}"
+        ci = names.index(constraint_name)
+        if compiled.vectorized is None:
+            return f"template {kind!r} runs on the scalar engine (not lowered)"
+        bindings = self._kind_bindings(st, kind, compiled, constraints)
+        mask = self._kind_mask(st, target, kind, constraints)
+        out = explain(compiled.vectorized.program, bindings, ci, row,
+                      match=mask)
+        handler = self.targets[target]
+        meta = st.table.meta_at(row)
+        review = handler.make_review(meta, st.table.object_at(row))
+        matched = any(True for _ in handler.matching_constraints(
+            review, [constraints[ci]], st.table))
+        oracle = list(self._eval_pair(st, target, compiled, review,
+                                      freeze(review), constraints[ci], None)) \
+            if matched else []
+        return out + f"\n  oracle: {len(oracle)} violation(s)" + "".join(
+            f"\n    msg={r.msg!r}" for r in oracle)
+
     def _pair_results(self, st, target, kind, compiled, c, row, review,
                       frozen, trace) -> list:
         """Memoized per-pair formatting.  Steady-state sweeps re-visit

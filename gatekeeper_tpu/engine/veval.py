@@ -350,6 +350,66 @@ def topk_reduce(viol: jax.Array, k: int, rank: jax.Array | None = None):
     return counts, rows, vals > 0
 
 
+def explain(program: Program, bindings: Bindings, ci: int, row: int,
+            match: np.ndarray | None = None) -> str:
+    """Mask dump for one (constraint, resource) pair: every IR node's
+    (defined, value) on the [1, 1(, E)] slice, plus each rule's conjunct
+    verdicts and the match-mask gate — the device-path analogue of the
+    scalar tracer (SURVEY §5 tracing).  Runs the real evaluator on
+    sliced bindings, so what it prints is exactly what the device
+    computes."""
+    matched = True if match is None else bool(match[ci, row])
+    sliced: dict[str, jax.Array] = {}
+    for nm, arr in bindings.arrays.items():
+        axes = binding_axes(nm)
+        a = arr
+        for d, ax in enumerate(axes):
+            if ax == "r":
+                a = np.take(a, [row], axis=d)
+            elif ax == "c":
+                a = np.take(a, [ci], axis=d)
+        sliced[nm] = jnp.asarray(a)
+    ev = _Evaluator(program, sliced)
+    lines = [f"explain constraint={ci} row={row}"]
+    for i, n in enumerate(program.nodes):
+        try:
+            d, v = ev.node(i)
+        except KeyError as e:
+            lines.append(f"  n{i:<3} {n.op:<22} <missing binding {e}>")
+            continue
+        dv = np.asarray(d).ravel()
+        vv = np.asarray(v).ravel()
+        show = vv if vv.size <= 8 else vv[:8]
+        lines.append(f"  n{i:<3} {n.op:<22} meta={n.meta!r} "
+                     f"defined={bool(dv.all())} value={show.tolist()}")
+    for ri, rule in enumerate(program.rules):
+        # elementwise AND of conjuncts, reduced exactly like
+        # _eval_program (existential over the presence-masked E axis)
+        total = None
+        verdicts = []
+        for cix in rule.conjuncts:
+            f = _fires(ev.node(cix))
+            verdicts.append(f"n{cix}={np.asarray(f).ravel().astype(int).tolist()}")
+            total = f if total is None else total & f
+        if total is None:
+            fired = True
+        else:
+            total = total & sliced["__alive__"][None, :, None] \
+                & sliced["__cvalid__"][:, None, None]
+            if rule.elem_axis is not None:
+                pres = sliced[f"__elem__:{rule.elem_axis}"][None]
+                fired = bool(np.asarray(jnp.any(total & pres)))
+            else:
+                fired = bool(np.asarray(jnp.any(total)))
+        fired = fired and matched
+        lines.append(f"  rule{ri} axis={rule.elem_axis or '-'} "
+                     f"conjuncts[{' '.join(verdicts)}] -> "
+                     f"{'FIRES' if fired else 'no'}")
+    lines.insert(1, f"  match gate: "
+                    f"{'matched' if matched else 'NOT matched (constraint match criteria exclude this resource)'}")
+    return "\n".join(lines)
+
+
 class PendingMask:
     """In-flight full violation mask (see run_async)."""
 

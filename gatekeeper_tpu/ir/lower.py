@@ -692,15 +692,38 @@ class Lowerer:
         return name
 
     def _make_cset(self, term: Term, env_vars: tuple[str, ...],
-                   iterate: bool, encode: str, drop_false: bool = False) -> str:
+                   iterate: bool, encode: str, member_ref: bool = False) -> str:
         name = f"cs{next(self.serial)}"
         env_map = dict(self.env)
         self._check_cenv(env_vars, env_map)
 
         def fn(c, _t=term, _ev=env_vars, _it=iterate, _em=env_map,
-               _df=drop_false):
+               _mr=member_ref):
             if _it:
                 vals = self._ceval_iter(self._cinput(c), _t, _ev, _em)
+            elif _mr:
+                # coll[x] statement semantics, exact per collection kind:
+                #   set    -> fires iff x ∈ set and the member isn't
+                #             literal false      -> members minus false
+                #   array  -> index access: fires iff x is an in-range
+                #             int and arr[x] isn't false -> truthy indices
+                #   object -> field access: fires iff x is a key and the
+                #             value isn't false  -> truthy keys
+                #   other/undefined -> never fires -> empty set
+                v = self._ceval_term(self._cinput(c), _t, _ev, _em)
+                if v is UNDEFINED:
+                    vals = []
+                elif isinstance(v, frozenset):
+                    vals = [x for x in sorted(v, key=repr) if x is not False]
+                elif isinstance(v, tuple):
+                    vals = [i for i, el in enumerate(v) if el is not False]
+                else:
+                    try:
+                        items = list(v.items())
+                    except AttributeError:
+                        items = None
+                    vals = ([k for k, val in items if val is not False]
+                            if items is not None else [])
             else:
                 v = self._ceval_term(self._cinput(c), _t, _ev, _em)
                 if v is UNDEFINED:
@@ -710,8 +733,6 @@ class Lowerer:
                     return None
                 if isinstance(v, frozenset):
                     vals = sorted(vals, key=repr)
-            if _df:
-                vals = [x for x in vals if x is not False]
             # elements stay frozen: prep's encode_value handles scalars
             # and compounds alike (a compound element must match only
             # equal compounds, never null)
@@ -872,12 +893,15 @@ class Lowerer:
         elif isinstance(sym, SLeafExpr):
             nid = self._table_node(sym, "bool")
         elif isinstance(sym, SParamPred):
-            # statement `pred(leaf, p)` with p iterating a constraint
-            # list: fires iff SOME param satisfies (Rego existential);
-            # `not` is then none-satisfies — both exact (the predicate
-            # is host-evaluated per (value, param))
+            # statement `pred(leaf, p)` with p a generator binding
+            # (p := params[_]): fires iff SOME param satisfies.  Under
+            # negation the `not` applies per binding of p — the rule
+            # fires iff SOME param FAILS the predicate, i.e.
+            # ¬(ALL p: pred) — NOT ¬(∃ p: pred).  Both forms are exact
+            # (the predicate is host-evaluated per (value, param)).
+            mode = "all" if negated else "any"
             nid = self._ptable_node(sym.leaf, sym.pred_term, sym.pvar,
-                                    sym.iter_term, sym.iter_env, mode="any")
+                                    sym.iter_term, sym.iter_env, mode=mode)
         else:
             raise CannotLower(f"conjunct from {type(sym).__name__}")
         return self._emit("not", (nid,)) if negated else nid
@@ -1408,7 +1432,7 @@ class Lowerer:
             raise CannotLower(f"iterated comparison {op}")
         if isinstance(ls, SLeaf):
             ns = "str" if ls.leaf.root == "meta" else "val"
-            idx = self._emit_leaf(ls.leaf, "str" if ns == "str" else "val")
+            idx = self._emit_leaf(ls.leaf, ns)
         elif isinstance(ls, SLeafExpr):
             ns = "val"
             idx = self._table_node(ls, "id_val")
@@ -1443,12 +1467,12 @@ class Lowerer:
             return None
         if isinstance(ks, SLeaf):
             ns = "str" if ks.leaf.root == "meta" else "val"
-            idx = self._emit_leaf(ks.leaf, "str" if ns == "str" else "val")
+            idx = self._emit_leaf(ks.leaf, ns)
         else:
             ns = "val"
             idx = self._table_node(ks, "id_val")
         csname = self._make_cset(bsym.term, bsym.env_vars, iterate=False,
-                                 encode=ns, drop_false=True)
+                                 encode=ns, member_ref=True)
         return SNode(self._emit("in_cset", (idx,), (csname,)), "bool")
 
     def _try_label_keys(self, term: Comprehension) -> Sym | None:

@@ -281,3 +281,90 @@ def test_capped_subset_matches_scalar_after_churn():
     scalar_first5 = [(r.review or {}).get("object", {})["metadata"]["name"]
                      for r in lres[:5]]
     assert sorted(sharded_names) == sorted(scalar_first5)
+
+
+NEG_PARAM_PRED = """package negparam
+violation[{"msg": msg}] {
+  p := input.constraint.spec.parameters.pats[_]
+  container := input.review.object.spec.containers[_]
+  not startswith(container.image, p)
+  msg := sprintf("container %v misses pattern %v", [container.name, p])
+}
+"""
+
+ARRAY_MEMBER_REF = """package arrmember
+violation[{"msg": msg}] {
+  allowed := input.constraint.spec.parameters.allowed
+  container := input.review.object.spec.containers[_]
+  not allowed[container.image]
+  msg := sprintf("image %v not allowed", [container.image])
+}
+"""
+
+SET_MEMBER_REF = """package setmember
+violation[{"msg": msg}] {
+  allowed := {a | a := input.constraint.spec.parameters.allowed[_]}
+  container := input.review.object.spec.containers[_]
+  not allowed[container.image]
+  msg := sprintf("image %v not in set", [container.image])
+}
+"""
+
+
+def _pod(i, image):
+    return {"apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": f"p{i:03d}", "namespace": "d"},
+            "spec": {"containers": [{"name": "c", "image": image}]}}
+
+
+def _audit_msgs(client):
+    return sorted(r.msg for r in client.audit().results())
+
+
+def test_negated_param_pred_exists_not_semantics():
+    """`not pred(leaf, p)` with p := params[_] fires when SOME param
+    fails the predicate (the not applies per binding), not when none
+    succeed.  Device path must match the oracle."""
+    local, jx = _pair()
+    for c in (local, jx):
+        c.add_template(template_doc("NegParam", NEG_PARAM_PRED))
+        c.add_constraint(constraint_doc("NegParam", "np",
+                                        {"pats": ["a", "b"]}))
+        c.add_data(_pod(0, "a-image"))   # fails p="b" -> violates
+        c.add_data(_pod(1, "b-image"))   # fails p="a" -> violates
+    st = jx.driver.state["admission.k8s.gatekeeper.sh"]
+    assert st.templates["NegParam"].vectorized is not None
+    l, j = _audit_msgs(local), _audit_msgs(jx)
+    assert l == j
+    assert len(l) == 2  # both pods fail at least one pattern
+
+
+def test_array_member_ref_is_index_access():
+    """`allowed[x]` on an ARRAY is index access (undefined for string
+    keys), not membership; both drivers must agree."""
+    local, jx = _pair()
+    for c in (local, jx):
+        c.add_template(template_doc("ArrMember", ARRAY_MEMBER_REF))
+        c.add_constraint(constraint_doc("ArrMember", "am",
+                                        {"allowed": ["good"]}))
+        c.add_data(_pod(0, "good"))
+        c.add_data(_pod(1, "bad"))
+    st = jx.driver.state["admission.k8s.gatekeeper.sh"]
+    l, j = _audit_msgs(local), _audit_msgs(jx)
+    assert l == j
+    assert len(l) == 2  # array["good"] is undefined -> both violate
+
+
+def test_set_member_ref_is_membership():
+    local, jx = _pair()
+    for c in (local, jx):
+        c.add_template(template_doc("SetMember", SET_MEMBER_REF))
+        c.add_constraint(constraint_doc("SetMember", "sm",
+                                        {"allowed": ["good"]}))
+        c.add_data(_pod(0, "good"))
+        c.add_data(_pod(1, "bad"))
+    st = jx.driver.state["admission.k8s.gatekeeper.sh"]
+    assert st.templates["SetMember"].vectorized is not None
+    l, j = _audit_msgs(local), _audit_msgs(jx)
+    assert l == j
+    assert l == ["image bad not in set"]

@@ -42,3 +42,21 @@ def test_threaded_start_stop():
         assert "K8sDenyAll" in mgr.client.templates
     finally:
         mgr.stop()
+
+
+def test_demo_flow_through_engine_worker():
+    """Full control plane (controllers -> audit -> status writes) with
+    the evaluation engine split into a worker process boundary."""
+    from gatekeeper_tpu.client.remote_driver import EngineWorker
+    from gatekeeper_tpu.engine.jax_driver import JaxDriver
+    worker = EngineWorker(JaxDriver())
+    worker.start()
+    try:
+        args = parse_args(["--port", "-1",
+                           "--engine-worker-url", worker.url])
+        mgr = Manager(args)
+        out = run_demo(mgr, n_namespaces=40)
+        assert out["status_violations"] > 0
+        assert out["audit_timestamp"]
+    finally:
+        worker.stop()

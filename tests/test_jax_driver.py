@@ -277,3 +277,25 @@ def test_review_equivalence():
     jres = jx.review(req).results()
     assert [_results_key(r) for r in lres] == [_results_key(r) for r in jres]
     assert len(lres) > 0
+
+
+def test_explain_pair_mask_dump():
+    """The device-path tracer: per-node values + rule verdicts for one
+    (constraint, resource) pair, cross-checked against the oracle."""
+    _, jx = _mk_clients()
+    _setup(jx, n_pods=5)
+    key = None
+    for k, row in jx.driver.state["admission.k8s.gatekeeper.sh"].table.rows_items():
+        if "Namespace/default" in k or k.endswith("default"):
+            key = k
+            break
+    assert key is not None
+    out = jx.driver.explain_pair("admission.k8s.gatekeeper.sh",
+                                 "K8sRequiredLabels", "need-app", key)
+    assert "explain constraint=" in out
+    assert "rule0" in out and ("FIRES" in out or "-> no" in out)
+    assert "oracle:" in out
+    # verdict agrees with the oracle line
+    fires = "FIRES" in out
+    n_oracle = int(out.split("oracle: ")[1].split(" ")[0])
+    assert fires == (n_oracle > 0)

@@ -1,0 +1,104 @@
+"""RemoteDriver / EngineWorker: the out-of-process engine split.
+
+Mirrors the reference's local-vs-remote driver conformance approach
+(client_test.go:17-23 parametrized over drivers; remote.go:49): the
+same scenarios must produce identical results through the HTTP seam."""
+
+import pytest
+
+from gatekeeper_tpu.client.client import Backend
+from gatekeeper_tpu.client.interface import QueryOpts
+from gatekeeper_tpu.client.local_driver import LocalDriver
+from gatekeeper_tpu.client.remote_driver import EngineWorker, RemoteDriver
+from gatekeeper_tpu.engine.jax_driver import JaxDriver
+from gatekeeper_tpu.target.k8s import K8sValidationTarget
+from tests.test_jax_driver import (
+    _rand_pod, _results_key, _setup, template_doc, constraint_doc)
+
+
+@pytest.fixture()
+def worker():
+    w = EngineWorker(JaxDriver())
+    w.start()
+    yield w
+    w.stop()
+
+
+def test_remote_matches_local(worker):
+    local = Backend(LocalDriver()).new_client([K8sValidationTarget()])
+    remote = Backend(RemoteDriver(worker.url)).new_client([K8sValidationTarget()])
+    _setup(local, n_pods=25)
+    _setup(remote, n_pods=25)
+    lres = local.audit().results()
+    rres = remote.audit().results()
+    assert len(lres) > 0
+    assert [_results_key(r) for r in lres] == [_results_key(r) for r in rres]
+    # review path
+    req = {"kind": {"group": "", "version": "v1", "kind": "Pod"},
+           "name": "x", "namespace": "prod", "operation": "CREATE",
+           "object": {"metadata": {"name": "x", "namespace": "prod"},
+                      "spec": {"containers": [{"name": "c",
+                                               "image": "docker.io/evil"}]}}}
+    lrev = local.review(req).results()
+    rrev = remote.review(req).results()
+    assert [_results_key(r) for r in lrev] == [_results_key(r) for r in rrev]
+    assert len(lrev) > 0
+
+
+def test_remote_lifecycle_and_caps(worker):
+    remote = Backend(RemoteDriver(worker.url)).new_client([K8sValidationTarget()])
+    _setup(remote, n_pods=30)
+    capped, _ = remote.driver.query_audit(
+        "admission.k8s.gatekeeper.sh", QueryOpts(limit_per_constraint=2))
+    by: dict = {}
+    for r in capped:
+        by.setdefault(r.constraint["metadata"]["name"], set()).add(
+            (r.review or {}).get("name"))
+    assert by and all(len(v) <= 2 for v in by.values())
+    # deletes propagate
+    remote.remove_constraint(constraint_doc("K8sRequiredLabels", "need-app"))
+    res = remote.audit().results()
+    assert not any(r.constraint["metadata"]["name"] == "need-app" for r in res)
+    # wipe via remove_data of everything is exercised elsewhere; dump works
+    d = remote.driver.dump()
+    assert "admission.k8s.gatekeeper.sh" in d
+
+
+def test_remote_worker_error_surfaces(worker):
+    drv = RemoteDriver(worker.url)
+    from gatekeeper_tpu.errors import ClientError
+    with pytest.raises(ClientError):
+        drv.query_audit("no-such-target")
+
+
+def test_remote_unreachable():
+    from gatekeeper_tpu.errors import ClientError
+    drv = RemoteDriver("http://127.0.0.1:9")   # discard port, nothing listens
+    with pytest.raises(ClientError):
+        drv.dump()
+
+
+def test_factory_worker_resets_on_init():
+    """A factory-backed worker gives each (re)connecting control plane a
+    fresh engine: state from a previous manager must not leak."""
+    w = EngineWorker(JaxDriver)
+    w.start()
+    try:
+        c1 = Backend(RemoteDriver(w.url)).new_client([K8sValidationTarget()])
+        c1.add_template(template_doc("K8sRequiredLabels", __import__(
+            "tests.test_lowering", fromlist=["REQUIRED_LABELS"]).REQUIRED_LABELS))
+        c1.add_constraint(constraint_doc("K8sRequiredLabels", "stale",
+                                         {"labels": ["x"]}))
+        c1.add_data(_rand_pod(__import__("random").Random(1), 1))
+        assert c1.audit().results()
+        # a new manager connects: init() must reset the worker
+        c2 = Backend(RemoteDriver(w.url)).new_client([K8sValidationTarget()])
+        assert c2.audit().results() == []
+        assert c2.driver.dump()["admission.k8s.gatekeeper.sh"]["templates"] == {}
+    finally:
+        w.stop()
+
+
+def test_worker_stop_without_start_does_not_hang():
+    w = EngineWorker(JaxDriver())
+    w.stop()   # must return promptly (regression: shutdown() deadlock)

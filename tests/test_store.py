@@ -1,13 +1,10 @@
-"""Store tests: interner, document store path semantics, resource table
+"""Store tests: interner and resource table
 columns (path layout from target.go:271-298; wipe semantics from
 config_controller.go:178-188)."""
 
 import numpy as np
-import pytest
 
-from gatekeeper_tpu.errors import StorageError
 from gatekeeper_tpu.store.columns import ColSpec
-from gatekeeper_tpu.store.docstore import DocStore
 from gatekeeper_tpu.store.interner import Interner, MISSING
 from gatekeeper_tpu.store.table import ResourceMeta, ResourceTable
 
@@ -42,37 +39,6 @@ class TestInterner:
         assert it.is_exact_on_device(short)
         assert not it.is_exact_on_device(long)
 
-
-class TestDocStore:
-    def test_put_get(self):
-        s = DocStore()
-        s.put("/external/t/cluster/v1/Namespace/foo", {"a": 1})
-        assert s.get("/external/t/cluster/v1/Namespace/foo") == {"a": 1}
-
-    def test_get_missing(self):
-        assert DocStore().get("/nope/x") is None
-
-    def test_delete_subtree_wipe(self):
-        s = DocStore()
-        s.put("/external/t/cluster/v1/NS/a", 1)
-        s.put("/external/t/cluster/v1/NS/b", 2)
-        s.put("/other/keep", 3)
-        assert s.delete_subtree("/external/t")
-        assert s.get("/external/t/cluster/v1/NS/a") is None
-        assert s.get("/other/keep") == 3
-
-    def test_path_conflict(self):
-        s = DocStore()
-        s.put("/a/b", "scalar")
-        with pytest.raises(StorageError, match="conflict"):
-            s.put("/a/b/c", 1)
-
-    def test_walk(self):
-        s = DocStore()
-        s.put("/d/x/1", "one")
-        s.put("/d/y/2", "two")
-        leaves = dict(s.walk("/d"))
-        assert leaves == {"/d/x/1": "one", "/d/y/2": "two"}
 
 
 def pod(name, ns, images, labels=None):
