@@ -272,16 +272,23 @@ def _eval_mask(program: Program, d: dict[str, jax.Array]) -> jax.Array:
     return jnp.moveaxis(ys, 0, 1).reshape(c_pad, r_pad)
 
 
-def _eval_topk(program: Program, d: dict[str, jax.Array], k: int):
+def _eval_topk(program: Program, d: dict[str, jax.Array], k: int,
+               score_base: int | None = None):
     """Violation top-k, chunked over R: per-chunk lax.top_k merged into
-    a running [C, k] best set (scores are globally comparable:
-    ``r_pad - global_rank``), counts psum'd across chunks."""
+    a running [C, k] best set, counts summed across chunks.  Returns
+    (counts [C], rows [C, k], scores [C, k]) — a positive score marks a
+    valid entry.  Scores are ``score_base - rank`` so they stay
+    comparable across chunks AND across shards: inside shard_map pass
+    the GLOBAL r_pad as score_base (the sharded ``__rank__`` carries
+    global ranks that can exceed the local slice length)."""
     r_pad = d["__alive__"].shape[0]
     c_pad = d["__cvalid__"].shape[0]
+    base_score = score_base if score_base is not None else r_pad
     nc = _n_chunks(r_pad)
     if nc == 1:
         viol = _eval_program(program, d)
-        return topk_reduce(viol, k, d.get("__rank__"))
+        return topk_reduce(viol, k, d.get("__rank__"),
+                           score_base=base_score, return_scores=True)
     rc = r_pad // nc
     k_out = min(k, r_pad)
     k_eff = min(k_out, rc)
@@ -294,7 +301,7 @@ def _eval_topk(program: Program, d: dict[str, jax.Array], k: int):
         rank = dd.get("__rank__")
         if rank is None:
             rank = off + jnp.arange(rc, dtype=jnp.int32)
-        score = jnp.where(viol, r_pad - rank[None, :], 0)
+        score = jnp.where(viol, base_score - rank[None, :], 0)
         vals, rows = jax.lax.top_k(score, k_eff)
         rows = rows + off
         bs, br, bc = carry
@@ -309,7 +316,7 @@ def _eval_topk(program: Program, d: dict[str, jax.Array], k: int):
     if k_out < k:
         vals = jnp.pad(vals, ((0, 0), (0, k - k_out)))
         rows = jnp.pad(rows, ((0, 0), (0, k - k_out)))
-    return counts, rows, vals > 0
+    return counts, rows, vals
 
 
 def pad_rank(rank: np.ndarray, r_pad: int) -> np.ndarray:
@@ -322,7 +329,8 @@ def pad_rank(rank: np.ndarray, r_pad: int) -> np.ndarray:
     return pr
 
 
-def topk_reduce(viol: jax.Array, k: int, rank: jax.Array | None = None):
+def topk_reduce(viol: jax.Array, k: int, rank: jax.Array | None = None,
+                score_base: int | None = None, return_scores: bool = False):
     """First-k violating resource rows per constraint, on device.
 
     Returns (counts [C] int32, rows [C, k] int32, valid [C, k] bool).
@@ -338,15 +346,18 @@ def topk_reduce(viol: jax.Array, k: int, rank: jax.Array | None = None):
     k <= axis size; callers may cap at 20 with fewer padded rows) and
     the outputs are padded back to width k."""
     c_pad, r_pad = viol.shape
+    base_score = score_base if score_base is not None else r_pad
     k_eff = min(k, r_pad)
     counts = jnp.sum(viol, axis=1, dtype=jnp.int32)
     if rank is None:
         rank = jnp.arange(r_pad, dtype=jnp.int32)
-    score = jnp.where(viol, r_pad - rank, 0)
+    score = jnp.where(viol, base_score - rank, 0)
     vals, rows = jax.lax.top_k(score, k_eff)
     if k_eff < k:
         vals = jnp.pad(vals, ((0, 0), (0, k - k_eff)))
         rows = jnp.pad(rows, ((0, 0), (0, k - k_eff)))
+    if return_scores:
+        return counts, rows, vals
     return counts, rows, vals > 0
 
 
@@ -490,11 +501,11 @@ class ProgramExecutor:
                     return _eval_mask(program, dict(zip(names, args)))
             else:
                 def raw(args: tuple):
-                    counts, rows, valid = _eval_topk(
+                    counts, rows, scores = _eval_topk(
                         program, dict(zip(names, args)), topk)
+                    valid = (scores > 0).astype(jnp.int32)
                     return jnp.concatenate(
-                        [counts[:, None], rows, valid.astype(jnp.int32)],
-                        axis=1)                    # packed [C, 1+2k]
+                        [counts[:, None], rows, valid], axis=1)  # [C, 1+2k]
             fn = jax.jit(raw)
             with self._lock:
                 fn = self._cache.setdefault(key, fn)

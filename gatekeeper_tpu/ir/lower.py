@@ -151,13 +151,25 @@ class SCount(Sym):
 @dataclasses.dataclass(frozen=True)
 class SParamPred(Sym):
     """[pred | p = <constraint list>[_]; pred = f(leaf, p)] — the
-    allowedrepos comprehension; any()/all() consume it."""
+    allowedrepos comprehension; any()/all() consume it.
+
+    ``origin`` decides negation semantics in statement position:
+    - 'gen':   p was generator-bound by an EARLIER literal
+               (p := params[_]; not pred(x, p)) — `not` applies per
+               binding, so negated = ∃p ¬pred = ¬(∀p pred);
+    - 'local': the iteration is embedded INSIDE the term
+               (not pred(x, params[_])) — the wildcard scopes under the
+               negation-as-failure, so negated = ¬∃p pred;
+    - 'compr': a comprehension value ([g | ...]) — as a statement it is
+               always truthy (even empty), so positive folds away and
+               negated can never fire."""
 
     iter_term: Term           # the iterating constraint ref (yields params)
     iter_env: tuple[str, ...]
     pvar: str
     pred_term: Term           # with leaf refs replaced by __leaf0__
     leaf: LeafId
+    origin: str = "gen"       # 'gen' | 'local' | 'compr'
 
 
 @dataclasses.dataclass
@@ -893,13 +905,21 @@ class Lowerer:
         elif isinstance(sym, SLeafExpr):
             nid = self._table_node(sym, "bool")
         elif isinstance(sym, SParamPred):
-            # statement `pred(leaf, p)` with p a generator binding
-            # (p := params[_]): fires iff SOME param satisfies.  Under
-            # negation the `not` applies per binding of p — the rule
-            # fires iff SOME param FAILS the predicate, i.e.
-            # ¬(ALL p: pred) — NOT ¬(∃ p: pred).  Both forms are exact
-            # (the predicate is host-evaluated per (value, param)).
-            mode = "all" if negated else "any"
+            # statement semantics depend on where the iteration binds
+            # (see SParamPred.origin); every form is exact — the
+            # predicate is host-evaluated per (value, param)
+            if sym.origin == "compr":
+                # a comprehension value is always truthy as a statement
+                if negated:
+                    raise _RuleNeverFires()
+                return None
+            if negated and sym.origin == "gen":
+                # p bound earlier: not applies per binding -> ¬(∀p pred)
+                mode = "all"
+            else:
+                # positive (∃p pred), or negation over an embedded
+                # wildcard (¬∃p pred — negation-as-failure scopes it)
+                mode = "any"
             nid = self._ptable_node(sym.leaf, sym.pred_term, sym.pvar,
                                     sym.iter_term, sym.iter_env, mode=mode)
         else:
@@ -1275,7 +1295,8 @@ class Lowerer:
             it: SCIter = self.env[v]  # type: ignore[assignment]
             pred = self._to_leaf_expr(term, leaf)
             return SParamPred(iter_term=it.term, iter_env=it.env_vars,
-                              pvar=v, pred_term=pred, leaf=leaf)
+                              pvar=v, pred_term=pred, leaf=leaf,
+                              origin="gen")
         if len(iter_vars) > 1:
             raise CannotLower("two constraint iterators in one predicate")
         # plain constraint subterms: single-param table (param per constraint)
@@ -1289,7 +1310,8 @@ class Lowerer:
             wrapped = ArrayTerm((carg,))  # iterate a singleton list
             return SParamPred(iter_term=Ref(wrapped, (Var("$p"),)),
                               iter_env=tuple(sorted(dv.env_vars)),
-                              pvar=pvar, pred_term=pred, leaf=leaf)
+                              pvar=pvar, pred_term=pred, leaf=leaf,
+                              origin="local")
         return None
 
     def _inline_function(self, term: Call) -> Sym:
@@ -1534,7 +1556,8 @@ class Lowerer:
             leaf = next(iter(d.leaves))
             pred = self._to_leaf_expr(t2, leaf)
             return SParamPred(iter_term=it.term, iter_env=it.env_vars,
-                              pvar=v1, pred_term=pred, leaf=leaf)
+                              pvar=v1, pred_term=pred, leaf=leaf,
+                              origin="compr")
         return None
 
 

@@ -1,0 +1,69 @@
+"""Multi-host meshes: scaling the audit matrix over DCN.
+
+The reference scales out with pod replicas that each re-evaluate
+everything (SURVEY §2.4 — per-pod status slots, no work sharing).  Here
+the audit matrix itself spans hosts: the resource axis ``r`` (the long
+axis) shards across hosts over DCN, the constraint axis ``c`` stays
+inside a host over ICI.
+
+Why this layout: the sharded audit step's only cross-shard traffic is a
+``psum`` of per-constraint counts ([C] int32) and an ``all_gather`` of
+per-shard top-k candidates ([C, k] — a few KB).  Both are tiny compared
+to the sharded columns, so the slow DCN hops cost microseconds per
+sweep; the bandwidth-relevant arrays (columns, membership matrices,
+match masks) never cross hosts at all — each host ingests and prepares
+only its own resource slice.  This is the standard "batch-like axis over
+DCN, tensor-like axis over ICI" recipe applied to constraints×resources.
+
+Wiring on real multi-host TPU:
+
+    jax.distributed.initialize(coordinator, num_processes, process_id)
+    mesh = make_multihost_mesh(c_axis=<ICI constraint shards>)
+    # per host: build bindings for the local resource slice, then
+    # jax.make_array_from_single_device_arrays over binding_spec()
+    # shardings, and run make_sharded_audit_fn as on one host.
+
+The mesh construction is testable single-process by passing ``n_hosts``
+explicitly (the virtual CPU mesh stands in for per-host device groups,
+same approach as tests/conftest.py).
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def init_distributed(coordinator_address: str | None = None,
+                     num_processes: int | None = None,
+                     process_id: int | None = None) -> None:
+    """jax.distributed bring-up (no-op when single-process).  Call
+    before any other jax use on every host of the pod slice."""
+    if num_processes is None or num_processes <= 1:
+        return
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
+
+
+def make_multihost_mesh(c_axis: int = 1, n_hosts: int | None = None) -> Mesh:
+    """2-D (c, r) mesh with ``r`` spanning hosts (DCN) and ``c`` kept
+    within a host (ICI).  Device order: jax.devices() groups devices by
+    process; within each host the local devices split into c_axis
+    constraint shards × per-host resource shards, and the global r axis
+    is host-major so consecutive r shards are host-local where possible
+    (collectives over r ride ICI first, DCN only at host boundaries)."""
+    devices = np.asarray(jax.devices())
+    hosts = n_hosts if n_hosts is not None else max(jax.process_count(), 1)
+    if len(devices) % hosts != 0:
+        raise ValueError(f"{len(devices)} devices not divisible by "
+                         f"{hosts} hosts")
+    local = len(devices) // hosts
+    if local % c_axis != 0:
+        raise ValueError(f"{local} devices per host not divisible by "
+                         f"c_axis={c_axis}")
+    r_local = local // c_axis
+    arr = devices.reshape(hosts, c_axis, r_local)       # [H, c, r_local]
+    arr = arr.transpose(1, 0, 2).reshape(c_axis, hosts * r_local)
+    return Mesh(arr, axis_names=("c", "r"))

@@ -32,7 +32,7 @@ import numpy as np
 from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
-from gatekeeper_tpu.engine.veval import _eval_program, pad_rank, topk_reduce
+from gatekeeper_tpu.engine.veval import _eval_topk, pad_rank
 from gatekeeper_tpu.ir.prep import Bindings, binding_axes
 from gatekeeper_tpu.ir.program import Program
 
@@ -106,20 +106,21 @@ def make_sharded_audit_fn(program: Program, names: tuple[str, ...],
 
     def local_step(*args):
         arrays = dict(zip(names, args))
-        viol = _eval_program(program, arrays)           # [C/c, R/r]
-        counts = jax.lax.psum(jnp.sum(viol, axis=1, dtype=jnp.int32), "r")
-        # local first-k, re-ranked globally after an all_gather over r
-        base = jax.lax.axis_index("r") * r_local
+        # per-shard evaluation rides the same chunked path as the
+        # single-device engine (bounded [C, rc(, E)] intermediates when
+        # the local slice exceeds R_CHUNK); scores use the GLOBAL r_pad
+        # base so they stay comparable across shards.  Without a
+        # caller-supplied global __rank__, per-shard ranks are local
+        # offsets — shard-global order then comes from the `base` shift.
         rank_local = arrays.get("__rank__")
-        if rank_local is not None:
-            # caller-supplied global order (sorted-cache-key rank from
-            # the driver) — matches the single-device capped subset
-            score = jnp.where(viol, r_pad - rank_local[None, :], 0)
-        else:
-            score = jnp.where(viol,
-                              (r_pad - base) - jnp.arange(r_local, dtype=jnp.int32)[None, :],
-                              0)
-        vals, rows_local = jax.lax.top_k(score, k_local)
+        cnt_l, rows_local, vals = _eval_topk(program, arrays, k_local,
+                                             score_base=r_pad)
+        counts = jax.lax.psum(cnt_l, "r")
+        base = jax.lax.axis_index("r") * r_local
+        if rank_local is None:
+            # local ranks 0..r_local-1 were scored as r_pad - rank; fold
+            # the shard offset in so earlier shards outrank later ones
+            vals = jnp.where(vals > 0, vals - base, 0)
         rows_global = rows_local + base
         g_vals = jax.lax.all_gather(vals, "r", axis=1, tiled=True)        # [C, r*k_local]
         g_rows = jax.lax.all_gather(rows_global, "r", axis=1, tiled=True)

@@ -368,3 +368,37 @@ def test_set_member_ref_is_membership():
     l, j = _audit_msgs(local), _audit_msgs(jx)
     assert l == j
     assert l == ["image bad not in set"]
+
+
+EMBEDDED_NEG_PRED = """package embneg
+violation[{"msg": msg}] {
+  container := input.review.object.spec.containers[_]
+  not startswith(container.image, input.constraint.spec.parameters.pats[_])
+  msg := sprintf("image %v matches no pattern", [container.image])
+}
+"""
+
+
+def test_negated_embedded_iteration_is_not_exists():
+    """`not pred(x, params[_])` (iteration INSIDE the negation) is
+    negation-as-failure over the local existential: fires only when NO
+    param satisfies.  Contrast test_negated_param_pred_exists_not_semantics
+    where p is generator-bound outside the not.  The raw device mask
+    must be exact, not merely over-approximating."""
+    local, jx = _pair()
+    for c in (local, jx):
+        c.add_template(template_doc("EmbNeg", EMBEDDED_NEG_PRED))
+        c.add_constraint(constraint_doc("EmbNeg", "en", {"pats": ["a", "b"]}))
+        c.add_data(_pod(0, "a-image"))   # matches "a" -> no violation
+        c.add_data(_pod(1, "c-image"))   # matches none -> violates
+    st = jx.driver.state["admission.k8s.gatekeeper.sh"]
+    assert st.templates["EmbNeg"].vectorized is not None
+    l, j = _audit_msgs(local), _audit_msgs(jx)
+    assert l == j == ["image c-image matches no pattern"]
+    # raw mask exactness (counts feed run_sharded_audit / status totals)
+    from gatekeeper_tpu.engine.veval import ProgramExecutor
+    compiled = st.templates["EmbNeg"]
+    cons = jx.driver._kind_constraints(st, "EmbNeg")
+    b = jx.driver._kind_bindings(st, "EmbNeg", compiled, cons)
+    mask = ProgramExecutor().run(compiled.vectorized.program, b)
+    assert mask.sum() == 1

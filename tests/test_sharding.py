@@ -72,3 +72,73 @@ def test_sharded_elem_axis_program():
     counts8, _, _ = run_sharded_audit(lowered.program, b, make_mesh(8), k=5)
     assert counts1.tolist() == counts8.tolist()
     assert counts1[0] > 0
+
+
+def test_sharded_chunked_matches_single_device(monkeypatch):
+    """Per-shard evaluation rides the chunked scan path when the local
+    slice exceeds R_CHUNK — results must still match single-device."""
+    from gatekeeper_tpu.engine import veval
+    monkeypatch.setattr(veval, "R_CHUNK", 8)   # local r = 128/4 = 32 -> 4 chunks
+    table = _workload(100)
+    cons = [
+        {"kind": "K8sRequiredLabels", "metadata": {"name": "app"},
+         "spec": {"parameters": {"labels": ["app"]}}},
+        {"kind": "K8sRequiredLabels", "metadata": {"name": "both"},
+         "spec": {"parameters": {"labels": ["app", "env"]}}},
+    ]
+    compiled = compile_target_rego("K8sRequiredLabels", "k8s", REQUIRED_LABELS)
+    lowered = lower_template(compiled.module, compiled.interp)
+    b = build_bindings(lowered.spec, table, cons)
+    single = ProgramExecutor()
+    counts1, rows1, valid1 = single.run_topk(lowered.program, b, 10)
+    mesh = make_mesh(8)
+    counts8, rows8, valid8 = run_sharded_audit(lowered.program, b, mesh, k=10)
+    assert counts1.tolist() == counts8.tolist()
+    for ci in range(len(cons)):
+        r1 = sorted(int(r) for r, v in zip(rows1[ci], valid1[ci]) if v)
+        r8 = sorted(int(r) for r, v in zip(rows8[ci], valid8[ci]) if v)
+        assert r1 == r8
+
+
+def test_sharded_rank_order_matches_single_device():
+    """With a caller-supplied global rank, the capped subset must be the
+    same first-k (by rank) on both paths."""
+    table = _workload(60)
+    cons = [{"kind": "K8sRequiredLabels", "metadata": {"name": "app"},
+             "spec": {"parameters": {"labels": ["app"]}}}]
+    compiled = compile_target_rego("K8sRequiredLabels", "k8s", REQUIRED_LABELS)
+    lowered = lower_template(compiled.module, compiled.interp)
+    b = build_bindings(lowered.spec, table, cons)
+    n = table.n_rows
+    rng = np.random.default_rng(3)
+    rank = rng.permutation(n).astype(np.int32)   # arbitrary global order
+    single = ProgramExecutor()
+    counts1, rows1, valid1 = single.run_topk(lowered.program, b, 4, rank=rank)
+    counts8, rows8, valid8 = run_sharded_audit(lowered.program, b,
+                                               make_mesh(8), k=4, rank=rank)
+    assert counts1.tolist() == counts8.tolist()
+    s1 = sorted(int(r) for r, v in zip(rows1[0], valid1[0]) if v)
+    s8 = sorted(int(r) for r, v in zip(rows8[0], valid8[0]) if v)
+    assert s1 == s8
+
+
+def test_multihost_mesh_layout_and_audit():
+    """make_multihost_mesh: r spans simulated hosts, c stays host-local;
+    the sharded audit over that mesh matches single-device results."""
+    from gatekeeper_tpu.parallel.multihost import make_multihost_mesh
+    mesh = make_multihost_mesh(c_axis=2, n_hosts=2)   # 8 devices: 2 hosts x 4
+    assert mesh.shape == {"c": 2, "r": 4}
+    # host-major r: first r_local shards of each c row share a "host"
+    devs = np.asarray(mesh.devices)
+    assert devs.shape == (2, 4)
+    table = _workload(50)
+    cons = [{"kind": "K8sRequiredLabels", "metadata": {"name": "app"},
+             "spec": {"parameters": {"labels": ["app"]}}},
+            {"kind": "K8sRequiredLabels", "metadata": {"name": "both"},
+             "spec": {"parameters": {"labels": ["app", "env"]}}}]
+    compiled = compile_target_rego("K8sRequiredLabels", "k8s", REQUIRED_LABELS)
+    lowered = lower_template(compiled.module, compiled.interp)
+    b = build_bindings(lowered.spec, table, cons)
+    counts1, _, _ = ProgramExecutor().run_topk(lowered.program, b, 5)
+    counts8, _, _ = run_sharded_audit(lowered.program, b, mesh, k=5)
+    assert counts1.tolist() == counts8.tolist()
