@@ -235,7 +235,18 @@ def _elem_rows(obj: Any, base: tuple[str, ...]):
 def build_elem_arrays(objs: list, base: tuple[str, ...], rels: list[tuple[tuple[str, ...], str]],
                       interner: Interner):
     """One pass over the base list producing aligned CSR columns for every
-    (rel, mode) request plus per-row element counts."""
+    (rel, mode) request plus per-row element counts.  Rides the native
+    extractor (gatekeeper_tpu/native) when available; this Python body
+    is the semantics contract the extension is tested against."""
+    from gatekeeper_tpu import native
+    if native.available:
+        counts_l, cols = native.elem_arrays(
+            objs, base, [r for r, _m in rels],
+            [native.MODE_CODES[m] for _r, m in rels],
+            interner._ids, interner._strings, encode_value)
+        counts = np.asarray(counts_l, dtype=np.int32) if counts_l \
+            else np.zeros((len(objs),), dtype=np.int32)
+        return counts, {rm: col for rm, col in zip(rels, cols)}
     n = len(objs)
     counts = np.zeros((n,), dtype=np.int32)
     outs: dict[tuple[tuple[str, ...], str], list] = {rm: [] for rm in rels}
@@ -254,7 +265,10 @@ def build_elem_arrays(objs: list, base: tuple[str, ...], rels: list[tuple[tuple[
                     col.append(interner.intern(key) if key is not None else MISSING)
                 elif mode == "num":
                     ok = isinstance(v, (int, float)) and not isinstance(v, bool)
-                    col.append(float(v) if ok else np.nan)
+                    try:
+                        col.append(float(v) if ok else np.nan)
+                    except OverflowError:
+                        col.append(np.nan)   # beyond float64: absent
                 elif mode == "len":
                     ok = isinstance(v, (list, dict, str))
                     col.append(float(len(v)) if ok else np.nan)
@@ -630,6 +644,11 @@ def _fill_membership(memb: np.ndarray, objs: list, keys_path: tuple[str, ...],
                      interner: Interner) -> None:
     """memb[local_id, row] = key present in the dict at keys_path."""
     if not needed:
+        return
+    from gatekeeper_tpu import native
+    if native.available:
+        native.memb_fill(objs, keys_path, local, interner._ids,
+                         memb, len(objs), memb.shape[0])
         return
     needed_set = set(needed)
     for row, o in enumerate(objs):

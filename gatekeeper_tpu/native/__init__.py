@@ -1,0 +1,58 @@
+"""Native columnar-ingest accelerator: build-on-first-import loader.
+
+Compiles colext.c into a shared object under ``_build/`` (cached by
+source hash) and exposes its functions; everything degrades silently to
+the pure-Python implementations when a toolchain is unavailable or
+``GATEKEEPER_NO_NATIVE=1`` is set.  The Python twins remain the
+semantics contract — tests cross-check both paths.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import importlib.util
+import os
+import subprocess
+import sysconfig
+
+available = False
+elem_arrays = None
+scalar_col = None
+memb_fill = None
+
+MODE_CODES = {"str": 0, "val": 1, "num": 2, "len": 3, "present": 4,
+              "truthy": 5}
+
+
+def _build() -> object | None:
+    here = os.path.dirname(os.path.abspath(__file__))
+    src = os.path.join(here, "colext.c")
+    with open(src, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    build_dir = os.path.join(here, "_build")
+    so_path = os.path.join(build_dir, f"_colext_{digest}.so")
+    if not os.path.exists(so_path):
+        os.makedirs(build_dir, exist_ok=True)
+        include = sysconfig.get_paths()["include"]
+        cc = os.environ.get("CC", "cc")
+        cmd = [cc, "-O2", "-shared", "-fPIC", f"-I{include}", src,
+               "-o", so_path + ".tmp"]
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(so_path + ".tmp", so_path)
+    loader = importlib.machinery.ExtensionFileLoader("_colext", so_path)
+    spec = importlib.util.spec_from_file_location("_colext", so_path,
+                                                  loader=loader)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+if os.environ.get("GATEKEEPER_NO_NATIVE") != "1":
+    try:
+        _mod = _build()
+        elem_arrays = _mod.elem_arrays
+        scalar_col = _mod.scalar_col
+        memb_fill = _mod.memb_fill
+        available = True
+    except Exception:  # no toolchain / unexpected platform: Python paths
+        available = False

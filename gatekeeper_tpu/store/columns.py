@@ -102,8 +102,28 @@ def _has_path(obj: Any, path: tuple[str, ...]) -> bool:
 
 
 def build_column(spec: ColSpec, objs: list, interner: Interner):
-    """objs: list of resource dicts (None rows are tombstones -> absent)."""
+    """objs: list of resource dicts (None rows are tombstones -> absent).
+    Scalar star-free modes ride the native extractor when available;
+    the Python bodies below are the semantics contract."""
+    from gatekeeper_tpu import native
     n = len(objs)
+    if native.available and STAR not in spec.path and \
+            spec.mode in ("str", "val", "num", "len", "present", "truthy"):
+        from gatekeeper_tpu.ir.encode import encode_value
+        cells = native.scalar_col(objs, spec.path,
+                                  native.MODE_CODES[spec.mode],
+                                  interner._ids, interner._strings,
+                                  encode_value)
+        if spec.mode in ("str", "val"):
+            return ScalarColumn(ids=np.asarray(cells, dtype=np.int32)
+                                if cells else np.full((0,), MISSING, np.int32))
+        if spec.mode in ("num", "len"):
+            fv = np.asarray(cells, dtype=np.float64) if cells \
+                else np.zeros((0,), dtype=np.float64)
+            pres = ~np.isnan(fv)
+            return NumColumn(values=np.nan_to_num(fv), present=pres)
+        return PresenceColumn(present=np.asarray(cells, dtype=bool)
+                              if cells else np.zeros((0,), dtype=bool))
     if spec.mode == "str":
         ids = np.full((n,), MISSING, dtype=np.int32)
         for i, o in enumerate(objs):
@@ -121,8 +141,11 @@ def build_column(spec: ColSpec, objs: list, interner: Interner):
                 continue
             v = get_path(o, spec.path)
             if isinstance(v, (int, float)) and not isinstance(v, bool):
-                vals[i] = float(v)
-                pres[i] = True
+                try:
+                    vals[i] = float(v)
+                    pres[i] = True
+                except OverflowError:
+                    pass   # beyond float64: absent (device columns are f64)
         return NumColumn(values=vals, present=pres)
     if spec.mode == "val":
         from gatekeeper_tpu.ir.encode import encode_value

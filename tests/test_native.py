@@ -1,0 +1,111 @@
+"""Native columnar extractor vs the pure-Python semantics contract.
+
+The Python bodies in store/columns.py and ir/prep.py are the contract;
+the C extension (gatekeeper_tpu/native) must produce bit-identical
+columns on arbitrary workloads, including tombstones, missing fields,
+wrong-typed values, nested '*' flattening, and compound val encoding."""
+
+import random
+
+import numpy as np
+import pytest
+
+from gatekeeper_tpu import native
+from gatekeeper_tpu.library import make_mixed
+from gatekeeper_tpu.store.columns import ColSpec, build_column
+from gatekeeper_tpu.store.interner import Interner
+from gatekeeper_tpu.ir import prep as prep_mod
+
+pytestmark = pytest.mark.skipif(not native.available,
+                                reason="native extension unavailable")
+
+
+def _objs(n=300):
+    rng = random.Random(7)
+    out = make_mixed(rng, n)
+    # adversarial extras: tombstones, nulls, wrong types, compounds
+    out += [
+        None,
+        {"metadata": {"name": None, "labels": "notadict"},
+         "spec": {"containers": "notalist", "replicas": True}},
+        {"metadata": {"name": 5, "labels": {"a": False, "b": "x", 3: "y"}},
+         "spec": {"replicas": 2.0, "sel": ["a", "b"],
+                  "containers": [{"name": "c", "resources": {"limits": None}},
+                                 "stray", {"env": [{"name": "E"}]}]}},
+    ]
+    return out
+
+
+def _mode_off():
+    import os
+    return os.environ.get("GATEKEEPER_NO_NATIVE") == "1"
+
+
+SCALAR_SPECS = [
+    (("metadata", "name"), "str"),
+    (("metadata", "name"), "val"),
+    (("spec", "replicas"), "num"),
+    (("spec", "replicas"), "val"),     # bool value lands here too
+    (("spec", "hostPID"), "val"),
+    (("spec", "containers"), "len"),
+    (("spec", "sel"), "val"),          # compound -> canonical encoding
+    (("spec", "hostPID"), "truthy"),
+    (("metadata", "labels"), "present"),
+    (("does", "not", "exist"), "str"),
+]
+
+
+def test_scalar_columns_match_python(monkeypatch):
+    objs = _objs()
+    for path, mode in SCALAR_SPECS:
+        it_n, it_p = Interner(), Interner()
+        got_n = build_column(ColSpec(path, mode), objs, it_n)
+        monkeypatch.setattr(native, "available", False)
+        got_p = build_column(ColSpec(path, mode), objs, it_p)
+        monkeypatch.setattr(native, "available", True)
+        assert it_n._strings == it_p._strings, (path, mode)
+        for attr in ("ids", "values", "present"):
+            a, b = getattr(got_n, attr, None), getattr(got_p, attr, None)
+            if a is not None or b is not None:
+                np.testing.assert_array_equal(a, b, err_msg=f"{path} {mode} {attr}")
+
+
+def test_elem_arrays_match_python(monkeypatch):
+    objs = _objs()
+    rels = [((), "val"), (("image",), "str"), (("name",), "str"),
+            (("securityContext", "privileged"), "val"),
+            (("resources", "limits", "cpu"), "val"),
+            (("resources", "limits"), "present"),
+            (("resources",), "truthy"), (("env",), "len"),
+            (("ports",), "num")]
+    for base in [("spec", "containers"), ("spec", "containers", "*", "env"),
+                 ("spec", "nope")]:
+        it_n, it_p = Interner(), Interner()
+        use = rels if base[-1] == "containers" else [((), "val"), (("name",), "str")]
+        cn, outs_n = prep_mod.build_elem_arrays(objs, base, use, it_n)
+        monkeypatch.setattr(native, "available", False)
+        cp, outs_p = prep_mod.build_elem_arrays(objs, base, use, it_p)
+        monkeypatch.setattr(native, "available", True)
+        np.testing.assert_array_equal(cn, cp, err_msg=str(base))
+        assert it_n._strings == it_p._strings
+        for key in outs_p:
+            def norm(xs):
+                return [x if x == x else "nan" for x in xs]  # NaN-safe
+            assert norm(outs_n[key]) == norm(outs_p[key]), (base, key)
+
+
+def test_membership_matches_python(monkeypatch):
+    objs = _objs()
+    it = Interner()
+    needed_keys = ["app", "env", "owner", "a", "b"]
+    gids = [it.intern(k) for k in needed_keys]
+    local = {g: i for i, g in enumerate(gids)}
+    m_n = np.zeros((8, len(objs) + 5), dtype=bool)
+    m_p = np.zeros((8, len(objs) + 5), dtype=bool)
+    prep_mod._fill_membership(m_n, objs, ("metadata", "labels"),
+                              gids, local, it)
+    monkeypatch.setattr(native, "available", False)
+    prep_mod._fill_membership(m_p, objs, ("metadata", "labels"),
+                              gids, local, it)
+    monkeypatch.setattr(native, "available", True)
+    np.testing.assert_array_equal(m_n, m_p)
