@@ -610,6 +610,184 @@ def walk_pairs(x):
     return out
 
 
+def _crypto_md5(s):
+    import hashlib
+    return hashlib.md5(_need_string(s, "crypto.md5").encode()).hexdigest()
+
+
+def _crypto_sha1(s):
+    import hashlib
+    return hashlib.sha1(_need_string(s, "crypto.sha1").encode()).hexdigest()
+
+
+def _crypto_sha256(s):
+    import hashlib
+    return hashlib.sha256(_need_string(s, "crypto.sha256").encode()).hexdigest()
+
+
+def _net_cidr_contains(cidr, ip):
+    import ipaddress
+    try:
+        net = ipaddress.ip_network(_need_string(cidr, "net.cidr_contains"),
+                                   strict=False)
+        addr = _need_string(ip, "net.cidr_contains")
+        if "/" in addr:
+            sub = ipaddress.ip_network(addr, strict=False)
+            return sub.subnet_of(net)
+        return ipaddress.ip_address(addr) in net
+    except (ValueError, TypeError) as e:   # TypeError: mixed IP versions
+        raise BuiltinError(f"net.cidr_contains: {e}")
+
+
+def _net_cidr_intersects(a, b):
+    import ipaddress
+    try:
+        na = ipaddress.ip_network(_need_string(a, "net.cidr_intersects"),
+                                  strict=False)
+        nb = ipaddress.ip_network(_need_string(b, "net.cidr_intersects"),
+                                  strict=False)
+        return na.overlaps(nb)
+    except (ValueError, TypeError) as e:
+        raise BuiltinError(f"net.cidr_intersects: {e}")
+
+
+_SEMVER_RE = _re.compile(
+    r"^(\d+)\.(\d+)\.(\d+)(?:-([0-9A-Za-z.-]+))?(?:\+[0-9A-Za-z.-]+)?$")
+
+
+def _semver_parse(s):
+    m = _SEMVER_RE.match(_need_string(s, "semver"))
+    if m is None:
+        raise BuiltinError(f"semver: invalid version {s!r}")
+    pre = m.group(4)
+    pre_ids: tuple = ()
+    if pre is not None:
+        pre_ids = tuple((0, int(p)) if p.isdigit() else (1, p)
+                        for p in pre.split("."))
+    return (int(m.group(1)), int(m.group(2)), int(m.group(3)),
+            pre is None, pre_ids)
+
+
+def _semver_compare(a, b):
+    va, vb = _semver_parse(a), _semver_parse(b)
+    if va[:3] != vb[:3]:
+        return -1 if va[:3] < vb[:3] else 1
+    # release > any pre-release of the same core
+    if va[3] != vb[3]:
+        return 1 if va[3] else -1
+    if va[4] == vb[4]:
+        return 0
+    return -1 if va[4] < vb[4] else 1
+
+
+def _semver_is_valid(s):
+    return isinstance(s, str) and _SEMVER_RE.match(s) is not None
+
+
+def _time_now_ns():
+    import time as _time
+    return _time.time_ns()
+
+
+def _time_parse_rfc3339_ns(s):
+    from datetime import datetime
+    raw = _need_string(s, "time.parse_rfc3339_ns")
+    try:
+        dt = datetime.fromisoformat(raw.replace("Z", "+00:00"))
+    except ValueError as e:
+        raise BuiltinError(f"time.parse_rfc3339_ns: {e}")
+    if dt.tzinfo is None:
+        raise BuiltinError(
+            f"time.parse_rfc3339_ns: missing timezone offset in {raw!r}")
+    # integer arithmetic: datetime holds microseconds; preserve the
+    # sub-microsecond digits from the raw string
+    ns_frac = 0
+    if "." in raw:
+        frac = raw.split(".", 1)[1]
+        digits = ""
+        for ch in frac:
+            if ch.isdigit():
+                digits += ch
+            else:
+                break
+        digits = (digits + "000000000")[:9]
+        ns_frac = int(digits)
+    whole = dt.replace(microsecond=0)
+    return int(whole.timestamp()) * 1_000_000_000 + ns_frac
+
+
+def _time_date(ns):
+    from datetime import datetime, timezone
+    dt = datetime.fromtimestamp(_need_number(ns, "time.date") / 1e9,
+                                tz=timezone.utc)
+    return (dt.year, dt.month, dt.day)
+
+
+def _time_clock(ns):
+    from datetime import datetime, timezone
+    dt = datetime.fromtimestamp(_need_number(ns, "time.clock") / 1e9,
+                                tz=timezone.utc)
+    return (dt.hour, dt.minute, dt.second)
+
+
+def _strings_replace_n(patterns, s):
+    """Single left-to-right pass (Go strings.NewReplacer semantics):
+    replaced text is never re-scanned; patterns try in sorted-key order
+    at each position."""
+    text = _need_string(s, "strings.replace_n")
+    if not isinstance(patterns, Obj):
+        raise BuiltinError("strings.replace_n: patterns must be object")
+    pairs = []
+    for old in sorted(patterns, key=str):
+        pairs.append((_need_string(old, "strings.replace_n"),
+                      _need_string(patterns[old], "strings.replace_n")))
+    out = []
+    i = 0
+    while i < len(text):
+        for old, new in pairs:
+            if old and text.startswith(old, i):
+                out.append(new)
+                i += len(old)
+                break
+        else:
+            out.append(text[i])
+            i += 1
+    return "".join(out)
+
+
+def _regex_is_valid(p):
+    if not isinstance(p, str):
+        return False
+    try:
+        compile_go_regex(p)
+        return True
+    except BuiltinError:
+        return False
+
+
+def _regex_find_n(pattern, s, n):
+    p = compile_go_regex(_need_string(pattern, "regex.find_n"))
+    limit = int(_need_number(n, "regex.find_n"))
+    out = [m.group(0) for m in p.finditer(_need_string(s, "regex.find_n"))]
+    return tuple(out if limit < 0 else out[:limit])
+
+
+def _yaml_marshal(v):
+    import yaml as _yaml
+    from gatekeeper_tpu.rego.values import thaw
+    return _yaml.safe_dump(thaw(v), default_flow_style=False)
+
+
+def _yaml_unmarshal(s):
+    import yaml as _yaml
+    try:
+        return freeze(_yaml.safe_load(_need_string(s, "yaml.unmarshal")))
+    except (_yaml.YAMLError, TypeError) as e:
+        # TypeError: YAML-native values with no Rego equivalent
+        # (unquoted dates/timestamps/binary)
+        raise BuiltinError(f"yaml.unmarshal: {e}")
+
+
 REGISTRY: dict[tuple[str, ...], Callable] = {
     # aggregates
     ("count",): _count,
@@ -670,6 +848,28 @@ REGISTRY: dict[tuple[str, ...], Callable] = {
     # numbers
     ("numbers", "range"): _numbers_range,
     ("regex", "split"): _regex_split,
+    ("regex", "is_valid"): _regex_is_valid,
+    ("regex", "find_n"): _regex_find_n,
+    ("strings", "replace_n"): _strings_replace_n,
+    # crypto digests
+    ("crypto", "md5"): _crypto_md5,
+    ("crypto", "sha1"): _crypto_sha1,
+    ("crypto", "sha256"): _crypto_sha256,
+    # net
+    ("net", "cidr_contains"): _net_cidr_contains,
+    ("net", "cidr_intersects"): _net_cidr_intersects,
+    ("net", "cidr_overlap"): _net_cidr_contains,   # OPA's old alias
+    # semver
+    ("semver", "is_valid"): _semver_is_valid,
+    ("semver", "compare"): _semver_compare,
+    # time
+    ("time", "now_ns"): _time_now_ns,
+    ("time", "parse_rfc3339_ns"): _time_parse_rfc3339_ns,
+    ("time", "date"): _time_date,
+    ("time", "clock"): _time_clock,
+    # yaml
+    ("yaml", "marshal"): _yaml_marshal,
+    ("yaml", "unmarshal"): _yaml_unmarshal,
     # json
     ("json", "marshal"): _json_marshal,
     ("json", "unmarshal"): _json_unmarshal,

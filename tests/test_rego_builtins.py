@@ -256,3 +256,83 @@ violation[{"msg": msg}] {
             res[nm] = sorted(r.msg for r in c.audit().results())
         assert res["local"] == res["jax"]
         assert res["local"] and "forbidden value at" in res["local"][0]
+
+
+class TestRound2BuiltinsTranche2:
+    def _reg(self):
+        from gatekeeper_tpu.rego.builtins import REGISTRY
+        return REGISTRY
+
+    def test_crypto(self):
+        R = self._reg()
+        assert R[("crypto", "sha256")]("abc").startswith("ba7816bf")
+        assert R[("crypto", "md5")]("abc") == "900150983cd24fb0d6963f7d28e17f72"
+        assert len(R[("crypto", "sha1")]("abc")) == 40
+
+    def test_net_cidr(self):
+        R = self._reg()
+        assert R[("net", "cidr_contains")]("10.0.0.0/8", "10.1.2.3")
+        assert not R[("net", "cidr_contains")]("10.0.0.0/8", "192.168.0.1")
+        assert R[("net", "cidr_contains")]("10.0.0.0/8", "10.1.0.0/16")
+        assert R[("net", "cidr_intersects")]("10.0.0.0/8", "10.255.0.0/16")
+        assert not R[("net", "cidr_intersects")]("10.0.0.0/8", "11.0.0.0/8")
+
+    def test_semver(self):
+        R = self._reg()
+        cmp = R[("semver", "compare")]
+        assert cmp("1.2.3", "1.2.3") == 0
+        assert cmp("1.2.3", "1.10.0") == -1
+        assert cmp("2.0.0", "2.0.0-rc.1") == 1      # release > pre-release
+        assert cmp("1.0.0-alpha.2", "1.0.0-alpha.10") == -1
+        assert R[("semver", "is_valid")]("1.2.3-rc.1+build5")
+        assert not R[("semver", "is_valid")]("1.2")
+
+    def test_time(self):
+        R = self._reg()
+        ns = R[("time", "parse_rfc3339_ns")]("2026-07-30T12:34:56Z")
+        assert R[("time", "date")](ns) == (2026, 7, 30)
+        assert R[("time", "clock")](ns) == (12, 34, 56)
+        assert R[("time", "now_ns")]() > 1_700_000_000 * 10**9
+
+    def test_regex_extras(self):
+        R = self._reg()
+        assert R[("regex", "is_valid")]("^a+$")
+        assert not R[("regex", "is_valid")]("([")
+        assert R[("regex", "find_n")]("[0-9]+", "a1b22c333", 2) == ("1", "22")
+        assert R[("regex", "find_n")]("[0-9]+", "a1b22c333", -1) == ("1", "22", "333")
+
+    def test_strings_replace_n(self):
+        R = self._reg()
+        pats = freeze({"a": "x", "b": "y"})
+        assert R[("strings", "replace_n")](pats, "aabb") in ("xxyy",)
+
+    def test_yaml_roundtrip(self):
+        R = self._reg()
+        v = freeze({"a": [1, 2], "b": "x"})
+        out = R[("yaml", "unmarshal")](R[("yaml", "marshal")](v))
+        assert out == v
+
+    def test_count_total(self):
+        assert len(self._reg()) >= 75
+
+    def test_builtin_error_not_crash(self):
+        """Bad inputs must become undefined (BuiltinError), not crash."""
+        from gatekeeper_tpu.rego.builtins import BuiltinError
+        import pytest
+        R = self._reg()
+        with pytest.raises(BuiltinError):
+            R[("net", "cidr_contains")]("10.0.0.0/8", "::1/64")
+        with pytest.raises(BuiltinError):
+            R[("yaml", "unmarshal")]("a: 2020-01-01")
+        with pytest.raises(BuiltinError):
+            R[("time", "parse_rfc3339_ns")]("2026-07-30T12:34:56")
+
+    def test_time_ns_precision(self):
+        R = self._reg()
+        ns = R[("time", "parse_rfc3339_ns")]("2026-07-30T12:34:56.123456789Z")
+        assert ns % 1_000_000_000 == 123456789
+
+    def test_replace_n_single_pass(self):
+        R = self._reg()
+        pats = freeze({"a": "b", "b": "c"})
+        assert R[("strings", "replace_n")](pats, "a") == "b"  # no re-scan
