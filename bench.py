@@ -99,15 +99,22 @@ def setup_north_star(driver, resources, rng):
 
 
 def timed_audit(driver, reps=3, cap=CAP):
+    """(best_seconds, first_seconds, n_results): best-of-reps is the
+    memoized steady state; the first rep re-formats after whatever state
+    the caller left (still executable/bindings-warm)."""
     best = float("inf")
+    first = None
     n_results = 0
     for _ in range(reps):
         t0 = time.perf_counter()
         results, _ = driver.query_audit(TARGET_NAME,
                                         QueryOpts(limit_per_constraint=cap))
-        best = min(best, time.perf_counter() - t0)
+        dt = time.perf_counter() - t0
+        if first is None:
+            first = dt
+        best = min(best, dt)
         n_results = len(results)
-    return best, n_results
+    return best, first, n_results
 
 
 def bench_north_star(detail):
@@ -123,10 +130,18 @@ def bench_north_star(detail):
     t0 = time.perf_counter()
     jd.query_audit(TARGET_NAME, QueryOpts(limit_per_constraint=CAP))
     cold_s = time.perf_counter() - t0
-    t_best, n_results = timed_audit(jd)
+    snap0 = jd.metrics.snapshot()
+    t_best, _t_first, n_results = timed_audit(jd)
     snap = jd.metrics.snapshot()
-    dev = snap.get("device_wait", {})
-    fmt = snap.get("host_format", {})
+
+    def delta_mean(key):
+        a, b = snap0.get(key, {}), snap.get(key, {})
+        n = (b.get("count") or 0) - (a.get("count") or 0)
+        tot = (b.get("total_seconds") or 0) - (a.get("total_seconds") or 0)
+        return tot / n if n else 0.0
+
+    dev = {"mean_seconds": delta_mean("device_wait")}
+    fmt = {"mean_seconds": delta_mean("host_format")}
     evals = N * n_constraints
     log(f"[north-star] ingest {ingest_s:.1f}s | first audit (cold) {cold_s:.1f}s"
         f" | steady {t_best*1e3:.0f}ms ({n_results} capped results)")
@@ -172,9 +187,10 @@ def bench_two_engines(detail, key, resources, templates, constraints,
         for r in sub:
             c.add_data(r)
         drv.query_audit(TARGET_NAME, QueryOpts(limit_per_constraint=CAP))
-        best, n_res = timed_audit(drv)
+        best, first, n_res = timed_audit(drv)
         scale = len(resources) / max(len(sub), 1)
         out[nm] = {"seconds": round(best * scale, 4),
+                   "first_rep_seconds": round(first * scale, 4),
                    "evals_per_sec": round(len(resources) * len(constraints) /
                                           (best * scale), 1),
                    "extrapolated": scale != 1.0}
@@ -226,7 +242,7 @@ def bench_library(detail):
     t0 = time.perf_counter()
     jd.query_audit(TARGET_NAME, QueryOpts(limit_per_constraint=CAP))
     cold_s = time.perf_counter() - t0
-    best, n_res = timed_audit(jd)
+    best, _first, n_res = timed_audit(jd)
     st = jd.state[TARGET_NAME]
     lowered = sum(1 for t in st.templates.values() if t.vectorized is not None)
     # oracle on a subsample

@@ -651,8 +651,12 @@ def _net_cidr_intersects(a, b):
         raise BuiltinError(f"net.cidr_intersects: {e}")
 
 
+_NUM = r"(?:0|[1-9]\d*)"
+_PRE_ID = r"(?:0|[1-9]\d*|\d*[A-Za-z-][0-9A-Za-z-]*)"
 _SEMVER_RE = _re.compile(
-    r"^(\d+)\.(\d+)\.(\d+)(?:-([0-9A-Za-z.-]+))?(?:\+[0-9A-Za-z.-]+)?$")
+    rf"^({_NUM})\.({_NUM})\.({_NUM})"
+    rf"(?:-({_PRE_ID}(?:\.{_PRE_ID})*))?"
+    r"(?:\+[0-9A-Za-z-]+(?:\.[0-9A-Za-z-]+)*)?$")
 
 
 def _semver_parse(s):
@@ -716,17 +720,21 @@ def _time_parse_rfc3339_ns(s):
     return int(whole.timestamp()) * 1_000_000_000 + ns_frac
 
 
-def _time_date(ns):
+def _ns_to_utc(ns, op):
     from datetime import datetime, timezone
-    dt = datetime.fromtimestamp(_need_number(ns, "time.date") / 1e9,
-                                tz=timezone.utc)
+    # integer seconds only: float division would round .999999999 up
+    # into the next second/day, and float64 ULP at ~1.8e18 ns is ~256ns
+    secs, _ = divmod(int(_need_number(ns, op)), 1_000_000_000)
+    return datetime.fromtimestamp(secs, tz=timezone.utc)
+
+
+def _time_date(ns):
+    dt = _ns_to_utc(ns, "time.date")
     return (dt.year, dt.month, dt.day)
 
 
 def _time_clock(ns):
-    from datetime import datetime, timezone
-    dt = datetime.fromtimestamp(_need_number(ns, "time.clock") / 1e9,
-                                tz=timezone.utc)
+    dt = _ns_to_utc(ns, "time.clock")
     return (dt.hour, dt.minute, dt.second)
 
 

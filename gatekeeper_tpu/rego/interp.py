@@ -74,7 +74,8 @@ class Interpreter:
                 self._constpath[id(term)] = tuple(keys)
         elif t is Call:
             name = term.name
-            if name not in (("trace",), ("internal", "compare")) and \
+            if name not in (("trace",), ("internal", "compare"),
+                            ("time", "now_ns")) and \
                     not (len(name) == 1 and name[0] in self.rules):
                 fn = bi.REGISTRY.get(name)
                 if fn is not None:
@@ -541,6 +542,15 @@ class Interpreter:
             for lv, env1 in self._eval_term(ctx, term.args[1], env):
                 for rv, env2 in self._eval_term(ctx, term.args[2], env1):
                     yield _compare(str(op_t.value), lv, rv), env2
+            return
+        if name == ("time", "now_ns"):
+            # OPA memoizes the clock per query: every reference within
+            # one evaluation sees the same instant
+            v = ctx.memo.get(("time.now_ns",))
+            if v is None:
+                v = bi.REGISTRY[("time", "now_ns")]()
+                ctx.memo[("time.now_ns",)] = v
+            yield v, env
             return
         if name == ("walk",):
             # relation builtin (vendor opa/topdown/walk.go): yields every

@@ -336,3 +336,27 @@ class TestRound2BuiltinsTranche2:
         R = self._reg()
         pats = freeze({"a": "b", "b": "c"})
         assert R[("strings", "replace_n")](pats, "a") == "b"  # no re-scan
+
+    def test_time_boundary_precision(self):
+        R = self._reg()
+        ns = R[("time", "parse_rfc3339_ns")]("2026-07-30T12:34:56.999999999Z")
+        assert R[("time", "clock")](ns) == (12, 34, 56)
+        ns2 = R[("time", "parse_rfc3339_ns")]("2026-07-31T23:59:59.999999999Z")
+        assert R[("time", "date")](ns2) == (2026, 7, 31)
+
+    def test_semver_leading_zeros_rejected(self):
+        R = self._reg()
+        assert not R[("semver", "is_valid")]("01.2.3")
+        assert not R[("semver", "is_valid")]("1.2.3-01")
+        assert R[("semver", "is_valid")]("1.2.3-0.x-1.alpha")
+
+    def test_now_ns_memoized_per_query(self):
+        m = parse_module("""
+package t
+violation[{"msg": "ok"}] {
+  a := time.now_ns()
+  b := time.now_ns()
+  a == b
+}
+""")
+        assert len(Interpreter(m).query_set("violation", {}, {})) == 1
