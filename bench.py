@@ -187,10 +187,9 @@ def bench_two_engines(detail, key, resources, templates, constraints,
         for r in sub:
             c.add_data(r)
         drv.query_audit(TARGET_NAME, QueryOpts(limit_per_constraint=CAP))
-        best, first, n_res = timed_audit(drv)
+        best, _first, n_res = timed_audit(drv)
         scale = len(resources) / max(len(sub), 1)
         out[nm] = {"seconds": round(best * scale, 4),
-                   "first_rep_seconds": round(first * scale, 4),
                    "evals_per_sec": round(len(resources) * len(constraints) /
                                           (best * scale), 1),
                    "extrapolated": scale != 1.0}

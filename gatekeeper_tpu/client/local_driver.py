@@ -11,6 +11,8 @@ everywhere.
 
 from __future__ import annotations
 
+import functools
+import threading
 from typing import Any, Iterator
 
 from gatekeeper_tpu.api.templates import CompiledTemplate
@@ -62,14 +64,95 @@ class TargetState:
         return frozen
 
 
+class RWLock:
+    """Readers-writer lock mirroring the reference drivers' RWMutex
+    (local.go:43-48): queries run concurrently, mutations are exclusive.
+    Same-thread re-entrance is allowed for writes (JaxDriver overrides
+    call super()) and for reads taken while holding the write lock.
+
+    Reader-side cache fills (mask/bindings/format memos) are safe
+    concurrently: with writers excluded the table is stable, so racing
+    readers compute identical values and last-write-wins is benign."""
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._readers = 0
+        self._writer: int | None = None
+        self._depth = 0
+
+    def acquire_read(self):
+        me = threading.get_ident()
+        with self._cond:
+            if self._writer == me:       # read within own write: nest
+                self._depth += 1
+                return
+            while self._writer is not None:
+                self._cond.wait()
+            self._readers += 1
+
+    def release_read(self):
+        me = threading.get_ident()
+        with self._cond:
+            if self._writer == me:
+                self._depth -= 1
+                return
+            self._readers -= 1
+            if self._readers == 0:
+                self._cond.notify_all()
+
+    def acquire_write(self):
+        me = threading.get_ident()
+        with self._cond:
+            if self._writer == me:
+                self._depth += 1
+                return
+            while self._writer is not None or self._readers:
+                self._cond.wait()
+            self._writer = me
+            self._depth = 1
+
+    def release_write(self):
+        with self._cond:
+            self._depth -= 1
+            if self._depth == 0:
+                self._writer = None
+                self._cond.notify_all()
+
+
+def locked(fn):
+    """Exclusive (writer) lock around a mutating Driver method."""
+    @functools.wraps(fn)
+    def wrapper(self, *args, **kwargs):
+        self._lock.acquire_write()
+        try:
+            return fn(self, *args, **kwargs)
+        finally:
+            self._lock.release_write()
+    return wrapper
+
+
+def locked_read(fn):
+    """Shared (reader) lock around a query Driver method."""
+    @functools.wraps(fn)
+    def wrapper(self, *args, **kwargs):
+        self._lock.acquire_read()
+        try:
+            return fn(self, *args, **kwargs)
+        finally:
+            self._lock.release_read()
+    return wrapper
+
+
 class LocalDriver(Driver):
     """Scalar reference engine (tracing mirrors local.New(local.Tracing(true)),
-    main.go:68: construction-time default, overridable per query)."""
+    main.go:68: construction-time default, overridable per query).
+    Thread-safe via one re-entrant instance lock (see `locked`)."""
 
     def __init__(self, tracing: bool = False):
         self.default_tracing = tracing
         self.targets: dict[str, TargetHandler] = {}
         self.state: dict[str, TargetState] = {}
+        self._lock = RWLock()
 
     # ------------------------------------------------------------------
 
@@ -84,9 +167,11 @@ class LocalDriver(Driver):
             raise ClientError(f"unknown target {target!r}")
         return st
 
+    @locked
     def put_template(self, target: str, kind: str, compiled: CompiledTemplate) -> None:
         self._state(target).templates[kind] = compiled
 
+    @locked
     def delete_template(self, target: str, kind: str) -> None:
         st = self._state(target)
         st.templates.pop(kind, None)
@@ -94,22 +179,27 @@ class LocalDriver(Driver):
         for k in [k for k in st._frozen_constraints if k[0] == kind]:
             del st._frozen_constraints[k]
 
+    @locked
     def put_constraint(self, target: str, kind: str, name: str, constraint: dict) -> None:
         st = self._state(target)
         st.constraints.setdefault(kind, {})[name] = constraint
         st._frozen_constraints[(kind, name)] = freeze(constraint)
 
+    @locked
     def delete_constraint(self, target: str, kind: str, name: str) -> None:
         st = self._state(target)
         st.constraints.get(kind, {}).pop(name, None)
         st._frozen_constraints.pop((kind, name), None)
 
+    @locked
     def put_data(self, target: str, key: str, meta: ResourceMeta, obj: dict) -> None:
         self._state(target).table.upsert(key, obj, meta)
 
+    @locked
     def delete_data(self, target: str, key: str) -> bool:
         return self._state(target).table.remove(key)
 
+    @locked
     def wipe_data(self, target: str) -> None:
         self._state(target).table.wipe()
 
@@ -145,6 +235,7 @@ class LocalDriver(Driver):
             for line in tracer:
                 trace.append(f"[{compiled.kind}/{cname}] {line}")
 
+    @locked_read
     def query_review(self, target: str, review: dict,
                      opts: QueryOpts | None = None) -> tuple[list[Result], str | None]:
         st = self._state(target)
@@ -170,6 +261,7 @@ class LocalDriver(Driver):
                                            frozen_review, c, trace))
         return results, ("\n".join(trace) if trace is not None else None)
 
+    @locked_read
     def query_audit(self, target: str,
                     opts: QueryOpts | None = None) -> tuple[list[Result], str | None]:
         """The audit cross-product (regolib src.go:38-52 +
@@ -196,14 +288,19 @@ class LocalDriver(Driver):
                                                frozen_review, c, trace))
         return results, ("\n".join(trace) if trace is not None else None)
 
+    @locked_read
     def dump(self) -> dict:
-        """All templates + constraints + data (local.go:251-284)."""
+        """All templates + constraints + data (local.go:251-284).
+        Deep-copied: the snapshot must stay consistent after the lock
+        is released, not alias live driver state."""
+        import copy
         out: dict = {}
         for tname, st in self.state.items():
             out[tname] = {
                 "templates": {k: t.source for k, t in st.templates.items()},
-                "constraints": st.constraints,
-                "data": {key: st.table.object_at(row)
-                         for key, row in sorted(st.table.rows_items())},
+                "constraints": copy.deepcopy(st.constraints),
+                "data": copy.deepcopy(
+                    {key: st.table.object_at(row)
+                     for key, row in sorted(st.table.rows_items())}),
             }
         return out

@@ -40,7 +40,8 @@ import numpy as np
 
 from gatekeeper_tpu.api.templates import CompiledTemplate
 from gatekeeper_tpu.client.interface import QueryOpts
-from gatekeeper_tpu.client.local_driver import LocalDriver, TargetState
+from gatekeeper_tpu.client.local_driver import (LocalDriver, TargetState,
+                                                locked, locked_read)
 from gatekeeper_tpu.client.types import Result
 from gatekeeper_tpu.engine.veval import ProgramExecutor
 from gatekeeper_tpu.ir.lower import CannotLower, lower_template
@@ -88,6 +89,7 @@ class JaxDriver(LocalDriver):
         for name in targets:
             self.state.setdefault(name, JaxTargetState())
 
+    @locked
     def put_template(self, target: str, kind: str, compiled: CompiledTemplate) -> None:
         if compiled.vectorized is None:
             try:
@@ -98,15 +100,18 @@ class JaxDriver(LocalDriver):
         st.templates[kind] = compiled
         st.bump(kind)
 
+    @locked
     def delete_template(self, target: str, kind: str) -> None:
         super().delete_template(target, kind)
         st = self._state(target)
         st.bump(kind)
 
+    @locked
     def put_constraint(self, target: str, kind: str, name: str, constraint: dict) -> None:
         super().put_constraint(target, kind, name, constraint)
         self._state(target).bump(kind)
 
+    @locked
     def delete_constraint(self, target: str, kind: str, name: str) -> None:
         super().delete_constraint(target, kind, name)
         self._state(target).bump(kind)
@@ -146,6 +151,7 @@ class JaxDriver(LocalDriver):
 
     # ------------------------------------------------------------------
 
+    @locked_read
     def query_audit(self, target: str,
                     opts: QueryOpts | None = None) -> tuple[list[Result], str | None]:
         import time as _time
@@ -242,6 +248,7 @@ class JaxDriver(LocalDriver):
         m.gauge("audit_resources").set(len(ordered_rows))
         return [r for _, r in tagged], ("\n".join(trace) if trace is not None else None)
 
+    @locked_read
     def explain_pair(self, target: str, kind: str, constraint_name: str,
                      resource_key: str) -> str:
         """Device-path mask dump for one (constraint, resource) pair:

@@ -256,8 +256,14 @@ class Interpreter:
                     new_data = value
             else:
                 raise EvalError(f"unsupported with target: {'.'.join(names)}")
+        memo: dict = {}
+        # rule/value memos are invalid under overridden documents, but
+        # the per-query clock instant is document-independent (OPA's
+        # builtin cache also survives `with`)
+        if ("time.now_ns",) in ctx.memo:
+            memo[("time.now_ns",)] = ctx.memo[("time.now_ns",)]
         return dataclasses.replace(ctx, input=new_input, data=new_data,
-                                   memo={})  # memo invalidated under overrides
+                                   memo=memo)
 
     def _eval_expr(self, ctx: _Ctx, expr, env: dict) -> Iterator[dict]:
         if isinstance(expr, Assign):

@@ -1,0 +1,107 @@
+"""Concurrency safety: reviews, audits, and lifecycle churn in parallel.
+
+The reference's safety story is RWMutexes around the driver and client
+(local.go:43, client.go:147); SURVEY §5 notes no stress tests exist.
+Here: hammer one client from many threads — admission reviews through
+the micro-batcher, capped audits, template/constraint/data churn — and
+assert no exceptions, no torn state, and a consistent final audit."""
+
+import random
+import threading
+
+from gatekeeper_tpu.client.client import Backend
+from gatekeeper_tpu.client.interface import QueryOpts
+from gatekeeper_tpu.client.local_driver import LocalDriver
+from gatekeeper_tpu.engine.jax_driver import JaxDriver
+from gatekeeper_tpu.target.k8s import K8sValidationTarget
+from gatekeeper_tpu.webhook.batcher import MicroBatcher
+from tests.test_jax_driver import _rand_pod, constraint_doc, template_doc
+from tests.test_lowering import ALLOWED_REPOS, REQUIRED_LABELS
+
+
+def _client():
+    c = Backend(JaxDriver()).new_client([K8sValidationTarget()])
+    c.add_template(template_doc("K8sRequiredLabels", REQUIRED_LABELS))
+    c.add_template(template_doc("K8sAllowedRepos", ALLOWED_REPOS))
+    c.add_constraint(constraint_doc("K8sRequiredLabels", "need-app",
+                                    {"labels": ["app"]}))
+    c.add_constraint(constraint_doc("K8sAllowedRepos", "gcr-only",
+                                    {"repos": ["gcr.io/"]}))
+    for i in range(40):
+        c.add_data(_rand_pod(random.Random(i), i))
+    return c
+
+
+def test_concurrent_reviews_audits_and_churn():
+    c = _client()
+    batcher = MicroBatcher(lambda reqs: c.review_batch(reqs),
+                           max_batch=16, max_wait=0.001)
+    batcher.start()
+    errors: list = []
+    stop = threading.Event()
+
+    def reviewer(seed):
+        rng = random.Random(seed)
+        while not stop.is_set():
+            pod = _rand_pod(rng, rng.randrange(1000))
+            req = {"kind": {"group": "", "version": "v1", "kind": "Pod"},
+                   "name": pod["metadata"]["name"],
+                   "namespace": pod["metadata"]["namespace"],
+                   "operation": "CREATE", "object": pod}
+            try:
+                batcher.submit(req)
+            except Exception as e:   # noqa: BLE001 - collecting for assert
+                errors.append(("review", e))
+
+    def auditor():
+        while not stop.is_set():
+            try:
+                c.driver.query_audit("admission.k8s.gatekeeper.sh",
+                                     QueryOpts(limit_per_constraint=5))
+            except Exception as e:
+                errors.append(("audit", e))
+
+    def churner(seed):
+        rng = random.Random(1000 + seed)
+        n = 0
+        while not stop.is_set():
+            n += 1
+            try:
+                if n % 7 == 0:
+                    c.remove_constraint(constraint_doc(
+                        "K8sAllowedRepos", "gcr-only"))
+                    c.add_constraint(constraint_doc(
+                        "K8sAllowedRepos", "gcr-only", {"repos": ["gcr.io/"]}))
+                else:
+                    c.add_data(_rand_pod(rng, rng.randrange(80)))
+            except Exception as e:
+                errors.append(("churn", e))
+
+    threads = [threading.Thread(target=reviewer, args=(i,)) for i in range(4)]
+    threads += [threading.Thread(target=auditor) for _ in range(2)]
+    threads += [threading.Thread(target=churner, args=(i,)) for i in range(2)]
+    for t in threads:
+        t.start()
+    threading.Event().wait(2.5)
+    stop.set()
+    for t in threads:
+        t.join(timeout=20)
+        assert not t.is_alive(), "thread wedged"
+    batcher.stop()
+    assert not errors, errors[:3]
+    # final state is consistent: audit matches a fresh oracle replay
+    final = c.audit().results()
+    oracle = Backend(LocalDriver()).new_client([K8sValidationTarget()])
+    dump = c.driver.dump()["admission.k8s.gatekeeper.sh"]
+    oracle.add_template(template_doc("K8sRequiredLabels", REQUIRED_LABELS))
+    oracle.add_template(template_doc("K8sAllowedRepos", ALLOWED_REPOS))
+    st = c.driver.state["admission.k8s.gatekeeper.sh"]
+    for kind in st.constraints:
+        for name, con in st.constraints[kind].items():
+            oracle.add_constraint(con)
+    for key, row in sorted(st.table.rows_items()):
+        obj = st.table.object_at(row)
+        oracle.add_data(obj)
+    ores = oracle.audit().results()
+    key = lambda r: (r.msg, r.constraint["metadata"]["name"])
+    assert sorted(map(key, final)) == sorted(map(key, ores))

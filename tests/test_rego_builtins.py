@@ -360,3 +360,15 @@ violation[{"msg": "ok"}] {
 }
 """)
         assert len(Interpreter(m).query_set("violation", {}, {})) == 1
+
+    def test_now_ns_survives_with_override(self):
+        m = parse_module("""
+package t
+helper = t { t := time.now_ns() }
+violation[{"msg": "ok"}] {
+  a := time.now_ns()
+  b := helper with input as {"x": 1}
+  a == b
+}
+""")
+        assert len(Interpreter(m).query_set("violation", {}, {})) == 1
