@@ -1,0 +1,7 @@
+#!/usr/bin/env bash
+# demo/basic: the reference's demo flow (demo/basic/demo.sh) against the
+# in-memory cluster — sync config -> template -> constraint -> 1k
+# namespaces -> one audit sweep -> constraint status written.
+set -euo pipefail
+cd "$(dirname "$0")/../.."
+exec python -m gatekeeper_tpu.cmd.manager --demo

@@ -101,9 +101,22 @@ class _Evaluator:
             vmap = self.arrays[tname + ".vmap"]            # [T] -> dense u
             tbl = self.arrays[tname + (".any" if op == "ptable_any" else ".all")]
             sentinel = tbl.shape[1] - 1
-            u = jnp.where(idx >= 0, vmap[jnp.clip(idx, 0, None)], sentinel)
+            in_rng = (idx >= 0) & (idx < vmap.shape[0])
+            u = jnp.where(in_rng, vmap[jnp.clip(idx, 0, vmap.shape[0] - 1)],
+                          sentinel)
             v = tbl[:, u[0]]                               # [C, R, E]
             return d_i & jnp.ones_like(v), v
+        if op == "keyed_val":
+            # per-constraint dynamic-key dict lookup (ir/prep.KeyedValReq):
+            # value id of dict[key_c] per (constraint, row); undefined
+            # where the constraint's key or the row's entry is absent
+            (name,) = n.meta
+            kv = self.arrays[name + ".kv"]                 # [K, R] int32
+            sel = self.arrays[name + ".sel"]               # [C] int32
+            picked = kv[jnp.clip(sel, 0, None)]            # [C, R]
+            v = picked[:, :, None]                         # [C, R, 1]
+            d = (sel >= 0)[:, None, None] & (v >= 0)
+            return d, v
         if op == "cmp":
             (cop,) = n.meta
             da, va = self.node(n.args[0])
@@ -136,12 +149,20 @@ class _Evaluator:
         if op == "in_cset":
             (cname,) = n.meta
             d_i, idx = self.node(n.args[0])
-            # idx must be r/e-axis ([1, R, E]); the lowerer guarantees this
+            # idx is [1, R, E] (shared leaf) or [C, R, E] (per-constraint,
+            # e.g. a keyed_val lookup)
             vmap = self.arrays[cname + ".vmap"]            # [T] -> dense u
             bitmap = self.arrays[cname + ".bitmap"]        # [C, U]
             sentinel = bitmap.shape[1] - 1
-            u = jnp.where(idx >= 0, vmap[jnp.clip(idx, 0, None)], sentinel)
-            v = bitmap[:, u[0]]                            # [C, R, E]
+            in_rng = (idx >= 0) & (idx < vmap.shape[0])
+            u = jnp.where(in_rng, vmap[jnp.clip(idx, 0, vmap.shape[0] - 1)],
+                          sentinel)
+            if u.shape[0] == 1:
+                v = bitmap[:, u[0]]                        # [C, R, E]
+            else:
+                c, r, e = u.shape
+                v = jnp.take_along_axis(bitmap, u.reshape(c, r * e),
+                                        axis=1).reshape(c, r, e)
             return d_i & jnp.ones_like(v), v
         if op in ("cset_not_subset_memb", "cset_subset_memb"):
             # required-keys subset test as a bf16 matmul on the MXU:

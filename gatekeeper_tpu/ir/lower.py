@@ -44,7 +44,8 @@ import itertools
 from typing import Any, Callable
 
 from gatekeeper_tpu.ir.prep import (
-    CSetReq, CValReq, EColReq, MembReq, PrepSpec, PTableReq, RColReq, TableReq)
+    CSetReq, CValReq, EColReq, KeyedValReq, MembReq, PrepSpec, PTableReq,
+    RColReq, TableReq)
 from gatekeeper_tpu.ir.program import CMP_OPS, Node, Program, RuleSpec
 from gatekeeper_tpu.rego import builtins as bi
 from gatekeeper_tpu.rego.ast_nodes import (
@@ -327,6 +328,7 @@ class Lowerer:
         self.csets: list[CSetReq] = []
         self.cvals: list[CValReq] = []
         self.membs: list[MembReq] = []
+        self.keyed_vals: list[KeyedValReq] = []
         self.cvalid_fns: list[Callable] = []
         self._leaf_nodes: dict[tuple, int] = {}
         self._fn_purity: dict[str, bool] = {}
@@ -366,7 +368,8 @@ class Lowerer:
             axes=tuple(sorted(self.axes.items())),
             tables=tuple(self.tables), ptables=tuple(self.ptables),
             csets=tuple(self.csets), cvals=tuple(self.cvals),
-            membs=tuple(self.membs), cvalid_fns=tuple(self.cvalid_fns))
+            membs=tuple(self.membs), keyed_vals=tuple(self.keyed_vals),
+            cvalid_fns=tuple(self.cvalid_fns))
         return LoweredProgram(
             program=Program(nodes=tuple(self.nodes), rules=tuple(self.rules_out)),
             spec=spec, n_rules_total=n_total, n_rules_lowered=len(self.rules_out))
@@ -527,6 +530,14 @@ class Lowerer:
                         # iteration point — only valid inside recognized
                         # patterns; deps-wise it's still this leaf
                         continue
+                    if isinstance(p, Var) and p.name in self.env:
+                        psym = self.env[p.name]
+                        pd = self._sym_deps(psym)
+                        if pd.constraint_only:
+                            # constraint-param key (labels[key]): the
+                            # keyed-lookup recognizer handles it
+                            d.constraint = True
+                            continue
                     raise CannotLower("computed key under review.object")
                 scal = tuple(p.value for p in rest[1:] if isinstance(p, Scalar))
                 d.leaves.add(LeafId("obj", scal))
@@ -1089,6 +1100,9 @@ class Lowerer:
         it = self._try_citer(rhs)
         if it is not None:
             return it
+        kl = self._try_keyed_lookup(rhs)
+        if kl is not None:
+            return kl
         return self._lower_value(rhs)
 
     def _try_elem_binding(self, rhs: Term) -> Sym | None:
@@ -1156,6 +1170,55 @@ class Lowerer:
         self.axes[key] = base
         self._retired_axes.add(parent_key)
         return SLeaf(LeafId(key, ()))
+
+    def _try_keyed_lookup(self, rhs: Term) -> Sym | None:
+        """``value := <review.object path>[key]`` with a constraint-only
+        key var — per-(constraint, row) dynamic dict lookup, lowered to
+        the keyed_val op over a [needed_keys, rows] value-id matrix
+        (ir/prep.KeyedValReq).  Exact: values are val-encoded
+        (compounds included) and definedness tracks both the
+        constraint's key and the row's entry."""
+        if not isinstance(rhs, Ref) or not isinstance(rhs.base, Var) \
+                or rhs.base.name != "input":
+            return None
+        path = rhs.path
+        if len(path) < 4:
+            return None
+        if not (isinstance(path[0], Scalar) and path[0].value == "review"
+                and isinstance(path[1], Scalar) and path[1].value == "object"):
+            return None
+        last = path[-1]
+        if not (isinstance(last, Var) and not last.is_wildcard):
+            return None
+        ksym = self.env.get(last.name)
+        if not isinstance(ksym, (SCTerm, SConst)):
+            return None
+        mid = path[2:-1]
+        if not all(isinstance(p, Scalar) and isinstance(p.value, str)
+                   for p in mid):
+            return None
+        dict_path = tuple(p.value for p in mid)
+        if isinstance(ksym, SConst) and isinstance(ksym.value, str):
+            # statically-known string key: identical to labels["env"],
+            # which the leaf machinery already handles (deduped column)
+            return SLeaf(LeafId("obj", dict_path + (ksym.value,)))
+        name = f"kl{next(self.serial)}"
+        if isinstance(ksym, SConst):
+            v = ksym.value
+
+            def key_fn(c, _v=v):
+                return _v
+        else:
+            env_map = dict(self.env)
+            self._check_cenv(ksym.env_vars, env_map)
+
+            def key_fn(c, _t=ksym.term, _ev=ksym.env_vars, _em=env_map):
+                val = self._ceval_term(self._cinput(c), _t, _ev, _em)
+                return _thaw_scalar(val) if val is not UNDEFINED else None
+
+        self.keyed_vals.append(KeyedValReq(name, dict_path, key_fn))
+        nid = self._emit("keyed_val", (), (name,))
+        return SNode(nid, "id_val")
 
     def _try_citer(self, rhs: Term) -> Sym | None:
         if not isinstance(rhs, Ref):
@@ -1485,14 +1548,17 @@ class Lowerer:
             kleaf = _resolve_ref_leaf(key, self.axes, self.env)
             if kleaf is not None:
                 ks = SLeaf(kleaf)
-        if not isinstance(ks, (SLeaf, SLeafExpr)):
-            return None
-        if isinstance(ks, SLeaf):
+        if isinstance(ks, SNode) and ks.kind == "id_val":
+            ns = "val"
+            idx = ks.nid
+        elif isinstance(ks, SLeaf):
             ns = "str" if ks.leaf.root == "meta" else "val"
             idx = self._emit_leaf(ks.leaf, ns)
-        else:
+        elif isinstance(ks, SLeafExpr):
             ns = "val"
             idx = self._table_node(ks, "id_val")
+        else:
+            return None
         csname = self._make_cset(bsym.term, bsym.env_vars, iterate=False,
                                  encode=ns, member_ref=True)
         return SNode(self._emit("in_cset", (idx,), (csname,)), "bool")

@@ -68,6 +68,10 @@ def binding_axes(name: str) -> tuple:
         return ("r",)
     if base.startswith("m") and base[1:].isdigit():
         return (None, "r")                       # memb [L, R]
+    if base.startswith("kl") and base[2:].isdigit():
+        if name.endswith(".kv"):
+            return (None, "r")                   # keyed values [K, R]
+        return ("c",)                            # .sel [C]
     if base.startswith("cs") and base[2:].isdigit():
         if name.endswith(".vmap"):
             return (None,)                       # global id -> dense u [T]
@@ -180,6 +184,22 @@ class CValReq:
 
 
 @dataclasses.dataclass(frozen=True)
+class KeyedValReq:
+    """Per-constraint dynamic-key lookup into a per-resource dict
+    (``value := labels[key]`` with a constraint-param key).
+
+    key_fn(constraint) -> str key or None (undefined).  Builds:
+      .kv  [K_pad, r_pad] int32 — val-encoded id of dict[k] per needed
+           key k and row (MISSING when the key/dict is absent);
+      .sel [c_pad] int32 — each constraint's local key index (-1 =
+           undefined key for that constraint)."""
+
+    name: str
+    path: tuple[str, ...]
+    key_fn: Callable[[dict], Any] = dataclasses.field(compare=False, hash=False)
+
+
+@dataclasses.dataclass(frozen=True)
 class MembReq:
     """Membership matrix vs a ragged per-resource key set.
 
@@ -202,6 +222,7 @@ class PrepSpec:
     csets: tuple[CSetReq, ...] = ()
     cvals: tuple[CValReq, ...] = ()
     membs: tuple[MembReq, ...] = ()
+    keyed_vals: tuple[KeyedValReq, ...] = ()
     # constraint-only conjuncts, folded into one validity vector
     cvalid_fns: tuple[Callable[[dict], bool], ...] = ()
 
@@ -410,6 +431,55 @@ def build_bindings(spec: PrepSpec, table: ResourceTable,
                 if flat:
                     b[idx_r, idx_e] = np.asarray(flat, dtype=bool)
                 out[ec.name] = b
+
+    # ---- dynamic-key container lookups
+    #
+    # Built BEFORE any table/cset/ptable: the value fill interns new
+    # ids, and those builders size their lookup arrays by
+    # bucket(len(interner)) — interning after sizing would make device
+    # gathers go out of bounds (XLA clamps, silently aliasing unseen
+    # values onto the last table entry).
+    #
+    # Key/container semantics mirror the oracle's _walk_ref ground
+    # branch: dict -> key membership (any scalar key), list -> int
+    # (non-bool) in-range index, anything else -> undefined.
+    for kl in spec.keyed_vals:
+        from gatekeeper_tpu.rego.values import canon_num
+        keys = []
+        for c in constraints:
+            k = _eval_host(kl.key_fn, c)
+            if isinstance(k, (int, float)) and not isinstance(k, bool):
+                k = canon_num(k)           # 1.0 and 1 index identically
+            elif not isinstance(k, (str, bool)):
+                k = None                   # non-scalar key: undefined
+            keys.append(k)
+        needed = sorted({k for k in keys if k is not None}, key=repr)
+        local = {k: i for i, k in enumerate(needed)}
+        k_pad = bucket(max(len(needed), 1), minimum=2)
+        kv = np.full((k_pad, r_pad), MISSING, dtype=np.int32)
+        for row, o in enumerate(objs):
+            if o is None:
+                continue
+            d = get_path(o, kl.path)
+            if isinstance(d, dict):
+                for k in needed:
+                    if k in d:
+                        ekey = encode_value(d[k])
+                        if ekey is not None:
+                            kv[local[k], row] = interner.intern(ekey)
+            elif isinstance(d, list):
+                for k in needed:
+                    if isinstance(k, int) and not isinstance(k, bool) \
+                            and 0 <= k < len(d):
+                        ekey = encode_value(d[k])
+                        if ekey is not None:
+                            kv[local[k], row] = interner.intern(ekey)
+        sel = np.full((c_pad,), -1, dtype=np.int32)
+        for ci, k in enumerate(keys):
+            if k is not None:
+                sel[ci] = local[k]
+        out[kl.name + ".kv"] = kv
+        out[kl.name + ".sel"] = sel
 
     # ---- unary tables over distinct column values
     for tr in spec.tables:

@@ -360,3 +360,146 @@ violation[{"msg": "img"}] {
             ct = compile_target_rego("T", "admission.k8s.gatekeeper.sh", rego)
             lp = lower_template(ct.module, ct.interp)
             assert lp.n_rules_lowered == 2, rego
+
+    def test_keyed_label_lookup_parity(self):
+        """labels[key] with a constraint-param key: per-constraint
+        dynamic dict lookup must match the oracle, including absent
+        keys, non-string values, false values, and missing dicts."""
+        rego = """package vlv
+violation[{"msg": msg}] {
+  key := input.constraint.spec.parameters.key
+  value := input.review.object.metadata.labels[key]
+  allowed := {v | v := input.constraint.spec.parameters.allowed[_]}
+  not allowed[value]
+  msg := sprintf("label <%v> value <%v> not allowed", [key, value])
+}
+"""
+        local, jx = self._pair()
+        objs = [
+            {"apiVersion": "v1", "kind": "Pod",
+             "metadata": {"name": "a", "namespace": "d",
+                          "labels": {"env": "prod", "tier": "web"}},
+             "spec": {"containers": []}},
+            {"apiVersion": "v1", "kind": "Pod",
+             "metadata": {"name": "b", "namespace": "d",
+                          "labels": {"env": "weird"}},
+             "spec": {"containers": []}},
+            {"apiVersion": "v1", "kind": "Pod",   # key absent
+             "metadata": {"name": "c", "namespace": "d", "labels": {"x": "y"}},
+             "spec": {"containers": []}},
+            {"apiVersion": "v1", "kind": "Pod",   # no labels dict
+             "metadata": {"name": "d", "namespace": "d"},
+             "spec": {"containers": []}},
+            {"apiVersion": "v1", "kind": "Pod",   # false label value
+             "metadata": {"name": "e", "namespace": "d",
+                          "labels": {"env": False}},
+             "spec": {"containers": []}},
+        ]
+        for c in (local, jx):
+            c.add_template(self._tdoc("Vlv", rego))
+            c.add_constraint(self._cdoc("Vlv", "env-check",
+                                        {"key": "env",
+                                         "allowed": ["prod", "dev"]}))
+            c.add_constraint(self._cdoc("Vlv", "tier-check",
+                                        {"key": "tier", "allowed": ["web"]}))
+            for o in objs:
+                c.add_data(o)
+        st = jx.driver.state["admission.k8s.gatekeeper.sh"]
+        assert st.templates["Vlv"].vectorized is not None
+        l = sorted((r.msg, r.constraint["metadata"]["name"])
+                   for r in local.audit().results())
+        j = sorted((r.msg, r.constraint["metadata"]["name"])
+                   for r in jx.audit().results())
+        assert l == j
+        # b: env "weird" not allowed; e: env False -> not in allowed set
+        assert ("label <env> value <weird> not allowed", "env-check") in l
+        assert len([x for x in l if x[1] == "env-check"]) == 2
+
+    def test_keyed_lookup_sharded(self):
+        """keyed_val bindings shard correctly over the (c, r) mesh."""
+        from gatekeeper_tpu.engine.veval import ProgramExecutor
+        from gatekeeper_tpu.ir.prep import build_bindings
+        from gatekeeper_tpu.parallel.sharding import make_mesh, run_sharded_audit
+        from gatekeeper_tpu.api.templates import compile_target_rego
+        from gatekeeper_tpu.ir.lower import lower_template
+        rego = """package vlv
+violation[{"msg": "bad"}] {
+  key := input.constraint.spec.parameters.key
+  value := input.review.object.metadata.labels[key]
+  value != input.constraint.spec.parameters.want
+}
+"""
+        objs = [{"kind": "Pod",
+                 "metadata": {"name": f"p{i:03d}",
+                              "labels": {"env": ["prod", "dev", "x"][i % 3]}}}
+                for i in range(40)]
+        table = _mk_table(objs)
+        cons = [{"kind": "Vlv", "metadata": {"name": "c0"},
+                 "spec": {"parameters": {"key": "env", "want": "prod"}}},
+                {"kind": "Vlv", "metadata": {"name": "c1"},
+                 "spec": {"parameters": {"key": "env", "want": "dev"}}}]
+        ct = compile_target_rego("Vlv", "k8s", rego)
+        lp = lower_template(ct.module, ct.interp)
+        b = build_bindings(lp.spec, table, cons)
+        c1, _, _ = ProgramExecutor().run_topk(lp.program, b, 5)
+        c8, _, _ = run_sharded_audit(lp.program, b, make_mesh(8), k=5)
+        assert c1.tolist() == c8.tolist()
+        assert c1[0] > 0
+
+    def test_keyed_int_index_into_array(self):
+        """Integer constraint keys index arrays (oracle _walk_ref tuple
+        semantics) — must not silently drop violations."""
+        rego = """package idx
+violation[{"msg": msg}] {
+  i := input.constraint.spec.parameters.i
+  val := input.review.object.spec.args[i]
+  val == "forbidden"
+  msg := sprintf("arg %v is forbidden", [i])
+}
+"""
+        local, jx = self._pair()
+        for c in (local, jx):
+            c.add_template(self._tdoc("Idx", rego))
+            c.add_constraint(self._cdoc("Idx", "i1", {"i": 1}))
+            c.add_data({"apiVersion": "v1", "kind": "Pod",
+                        "metadata": {"name": "p", "namespace": "d"},
+                        "spec": {"args": ["x", "forbidden", "y"],
+                                 "containers": []}})
+            c.add_data({"apiVersion": "v1", "kind": "Pod",
+                        "metadata": {"name": "q", "namespace": "d"},
+                        "spec": {"args": ["forbidden"], "containers": []}})
+        l = sorted(r.msg for r in local.audit().results())
+        j = sorted(r.msg for r in jx.audit().results())
+        assert l == j == ["arg 1 is forbidden"]
+
+    def test_keyed_lookup_interner_boundary_no_alias(self):
+        """Values first interned by the keyed fill must not alias onto
+        cset members when the interner crosses a bucket boundary."""
+        rego = """package vlv
+violation[{"msg": msg}] {
+  key := input.constraint.spec.parameters.key
+  value := input.review.object.metadata.labels[key]
+  allowed := {v | v := input.constraint.spec.parameters.allowed[_]}
+  not allowed[value]
+  msg := sprintf("bad %v", [value])
+}
+"""
+        for pad in range(24):   # sweep interner sizes across a boundary
+            local, jx = self._pair()
+            allowed = [f"ok-{i}" for i in range(7)]
+            for c in (local, jx):
+                c.add_template(self._tdoc("Vlv", rego))
+                c.add_constraint(self._cdoc("Vlv", "k",
+                                            {"key": "env", "allowed": allowed}))
+                for i in range(pad):   # vary interner fill
+                    c.add_data({"apiVersion": "v1", "kind": "Namespace",
+                                "metadata": {"name": f"fill-{i:02d}"}})
+                for i in range(6):
+                    c.add_data({"apiVersion": "v1", "kind": "Pod",
+                                "metadata": {"name": f"p{i}", "namespace": "d",
+                                             "labels": {"env": f"new-{i}"}},
+                                "spec": {"containers": []}})
+            l = sorted(r.msg for r in local.audit().results())
+            j = sorted(r.msg for r in jx.audit().results())
+            assert l == j, f"pad={pad}: {l} != {j}"
+            assert len([m for m in l if m.startswith("bad new-")]) == 6
