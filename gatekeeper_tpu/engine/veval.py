@@ -182,6 +182,21 @@ class _Evaluator:
             else:
                 v = (miss < 0.5)[:, :, None]
             return jnp.ones_like(v), v
+        if op == "elem_keys_missing":
+            # ∃ required key (per constraint) absent/false in the element
+            # dict: B [C, K] x ~ekm [K, R, E] as a matmul over the small
+            # K axis (same MXU trick as the label-subset ops)
+            cname, ekname = n.meta
+            ekm = self.arrays[ekname]                      # [K, R, E] bool
+            B = self.arrays[cname + ".B"]                  # [C, K]
+            k, r, e = ekm.shape
+            mm = jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32
+            miss = jax.lax.dot_general(
+                B.astype(mm), (~ekm).reshape(k, r * e).astype(mm),
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)        # [C, R*E]
+            v = (miss > 0.5).reshape(B.shape[0], r, e)
+            return jnp.ones_like(v), v
         if op in ("any_e", "all_e", "count_e"):
             (axis,) = n.meta
             pres = self.arrays[f"__elem__:{axis}"][None]   # [1, R, E]

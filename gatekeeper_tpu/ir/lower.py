@@ -44,8 +44,8 @@ import itertools
 from typing import Any, Callable
 
 from gatekeeper_tpu.ir.prep import (
-    CSetReq, CValReq, EColReq, KeyedValReq, MembReq, PrepSpec, PTableReq,
-    RColReq, TableReq)
+    CSetReq, CValReq, EColReq, ElemKeysReq, KeyedValReq, MembReq, PrepSpec,
+    PTableReq, RColReq, TableReq)
 from gatekeeper_tpu.ir.program import CMP_OPS, Node, Program, RuleSpec
 from gatekeeper_tpu.rego import builtins as bi
 from gatekeeper_tpu.rego.ast_nodes import (
@@ -328,6 +328,7 @@ class Lowerer:
         self.csets: list[CSetReq] = []
         self.cvals: list[CValReq] = []
         self.membs: list[MembReq] = []
+        self.elem_keys: list[ElemKeysReq] = []
         self.keyed_vals: list[KeyedValReq] = []
         self.cvalid_fns: list[Callable] = []
         self._leaf_nodes: dict[tuple, int] = {}
@@ -368,7 +369,8 @@ class Lowerer:
             axes=tuple(sorted(self.axes.items())),
             tables=tuple(self.tables), ptables=tuple(self.ptables),
             csets=tuple(self.csets), cvals=tuple(self.cvals),
-            membs=tuple(self.membs), keyed_vals=tuple(self.keyed_vals),
+            membs=tuple(self.membs), elem_keys=tuple(self.elem_keys),
+            keyed_vals=tuple(self.keyed_vals),
             cvalid_fns=tuple(self.cvalid_fns))
         return LoweredProgram(
             program=Program(nodes=tuple(self.nodes), rules=tuple(self.rules_out)),
@@ -452,6 +454,15 @@ class Lowerer:
                                         sym.leaf.path
                                         + tuple(p.value for p in mid)))
                     return d
+                if not mid and isinstance(lastp, Var) \
+                        and lastp.name in self.env:
+                    kd = self._sym_deps(self.env[lastp.name])
+                    if kd.constraint_only:
+                        # <elem>[<constraint key>]: handled by the
+                        # elem-key-missing / keyed recognizers
+                        d.leaves.add(sym.leaf)
+                        d.constraint = True
+                        return d
                 raise CannotLower("dynamic path under a leaf binding")
             db = self._deps(base, bound)
             d.merge(db)
@@ -978,6 +989,11 @@ class Lowerer:
             self.conjuncts.append(self._emit("not", (nid,)) if lit.negated else nid)
             return
         # plain term statement
+        if lit.negated:
+            ekn = self._try_elem_key_missing(e)
+            if ekn is not None:
+                self.conjuncts.append(ekn)
+                return
         sym = self._lower_value(e)
         nid = self._as_conjunct(sym, negated=lit.negated)
         if nid is not None:
@@ -1170,6 +1186,34 @@ class Lowerer:
         self.axes[key] = base
         self._retired_axes.add(parent_key)
         return SLeaf(LeafId(key, ()))
+
+    def _try_elem_key_missing(self, e: Term) -> int | None:
+        """``not <elem>[<probe>]`` with probe := params[_] — fires iff
+        SOME required key fails the coll[key] statement for the element
+        (the K8sRequiredProbes pattern; `not` applies per generator
+        binding of probe).  Exact for every element type: prep mirrors
+        the oracle's coll[key] semantics (dict -> truthy string key,
+        list -> truthy int index, other -> undefined); the device does
+        a B x ~ekm matmul over the key axis.  This node is consumed
+        directly as a conjunct — it must NOT be re-negated (that would
+        need the all-keys-present dual, not `not` of this node)."""
+        if not (isinstance(e, Ref) and isinstance(e.base, Var)
+                and len(e.path) == 1 and isinstance(e.path[0], Var)):
+            return None
+        esym = self.env.get(e.base.name)
+        if not (isinstance(esym, SLeaf) and esym.leaf.root not in ("obj", "meta")
+                and esym.leaf.path == ()):
+            return None
+        ksym = self.env.get(e.path[0].name)
+        if not isinstance(ksym, SCIter):
+            return None
+        axis = esym.leaf.root
+        self._emit_leaf(esym.leaf, "present")   # registers axis columns
+        csname = self._make_cset(ksym.term, ksym.env_vars, iterate=True,
+                                 encode="str")
+        ekname = f"ek{next(self.serial)}"
+        self.elem_keys.append(ElemKeysReq(ekname, csname, axis))
+        return self._emit("elem_keys_missing", (), (csname, ekname))
 
     def _try_keyed_lookup(self, rhs: Term) -> Sym | None:
         """``value := <review.object path>[key]`` with a constraint-only

@@ -72,6 +72,8 @@ def binding_axes(name: str) -> tuple:
         if name.endswith(".kv"):
             return (None, "r")                   # keyed values [K, R]
         return ("c",)                            # .sel [C]
+    if base.startswith("ek") and base[2:].isdigit():
+        return (None, "r", None)                 # elem keys [K, R, E]
     if base.startswith("cs") and base[2:].isdigit():
         if name.endswith(".vmap"):
             return (None,)                       # global id -> dense u [T]
@@ -200,6 +202,20 @@ class KeyedValReq:
 
 
 @dataclasses.dataclass(frozen=True)
+class ElemKeysReq:
+    """Element-axis truthy-key membership vs a per-constraint key set
+    (``not container[probe]`` with probe := params[_]).
+
+    keys come from the paired cset (re-indexed local like MembReq);
+    output ``ekm`` [K_pad, r_pad, e_pad] bool: key k present AND not
+    literal false in element (r, e) of the axis."""
+
+    name: str
+    cset: str
+    axis: str
+
+
+@dataclasses.dataclass(frozen=True)
 class MembReq:
     """Membership matrix vs a ragged per-resource key set.
 
@@ -222,6 +238,7 @@ class PrepSpec:
     csets: tuple[CSetReq, ...] = ()
     cvals: tuple[CValReq, ...] = ()
     membs: tuple[MembReq, ...] = ()
+    elem_keys: tuple[ElemKeysReq, ...] = ()
     keyed_vals: tuple[KeyedValReq, ...] = ()
     # constraint-only conjuncts, folded into one validity vector
     cvalid_fns: tuple[Callable[[dict], bool], ...] = ()
@@ -570,7 +587,7 @@ def build_bindings(spec: PrepSpec, table: ResourceTable,
 
     # ---- per-constraint id sets
     #
-    # Two consumption forms, both K-axis-free on device:
+    # Three consumption forms, all K-axis-free on device:
     # - with a paired membership matrix (subset ops): a [c_pad, l_pad]
     #   indicator ``B`` — the subset test becomes one bf16 matmul
     #   B @ ~memb on the MXU (engine/veval.py);
@@ -578,6 +595,7 @@ def build_bindings(spec: PrepSpec, table: ResourceTable,
     #   over the union of set values, plus a [c_pad, U] ``bitmap``
     #   (sentinel column U-1 = not in any constraint's set).
     memb_by_cset = {m.cset: m for m in spec.membs}
+    ekeys_by_cset = {e.cset: e for e in spec.elem_keys}
     for cs in spec.csets:
         per_con = []
         for c in constraints:
@@ -594,13 +612,51 @@ def build_bindings(spec: PrepSpec, table: ResourceTable,
                             lst.append(interner.intern(key))
             per_con.append(lst)
         m = memb_by_cset.get(cs.name)
+        ek = ekeys_by_cset.get(cs.name)
         needed = sorted({i for lst in per_con for i in lst})
         local = {gid: li for li, gid in enumerate(needed)}
-        if m is not None:
-            l_pad = bucket(max(len(needed), 1), minimum=2)
-            memb = np.zeros((l_pad, r_pad), dtype=bool)
-            _fill_membership(memb, objs, m.keys_path, needed, local, interner)
-            out[m.name] = memb
+        if ek is not None:
+            # elem-axis truthy-key membership + per-constraint indicator.
+            # Element semantics mirror the oracle's coll[key] statement:
+            # dict -> string key present and not false; list -> int
+            # (non-bool) index in range and element not false; any other
+            # element type has no keys (coll[key] undefined).
+            e_pad = e_pads[ek.axis]
+            k_pad = bucket(max(len(needed), 1), minimum=2)
+            ekm = np.zeros((k_pad, r_pad, e_pad), dtype=bool)
+            key_vals = {}
+            for gid in needed:
+                ks = interner.string(gid)
+                key_vals[gid] = decode_value(ks) if ks.startswith("\x00") else ks
+            base_path = dict(spec.axes)[ek.axis]
+            for row, o in enumerate(objs):
+                if o is None:
+                    continue
+                for ei, elem in enumerate(_elem_rows(o, base_path)):
+                    if ei >= e_pad:
+                        continue
+                    if isinstance(elem, dict):
+                        for gid, k in key_vals.items():
+                            if isinstance(k, str) and k in elem \
+                                    and elem[k] is not False:
+                                ekm[local[gid], row, ei] = True
+                    elif isinstance(elem, list):
+                        for gid, k in key_vals.items():
+                            if isinstance(k, int) and not isinstance(k, bool) \
+                                    and 0 <= k < len(elem) \
+                                    and elem[k] is not False:
+                                ekm[local[gid], row, ei] = True
+            out[ek.name] = ekm
+        if ek is not None or m is not None:
+            if m is not None:
+                l_pad = bucket(max(len(needed), 1), minimum=2)
+                memb = np.zeros((l_pad, r_pad), dtype=bool)
+                _fill_membership(memb, objs, m.keys_path, needed, local,
+                                 interner)
+                out[m.name] = memb
+            else:
+                l_pad = bucket(max(len(needed), 1), minimum=2)
+            # shared per-constraint key/label indicator
             B = np.zeros((c_pad, l_pad), dtype=bool)
             for ci, lst in enumerate(per_con):
                 for gid in lst:

@@ -503,3 +503,72 @@ violation[{"msg": msg}] {
             j = sorted(r.msg for r in jx.audit().results())
             assert l == j, f"pad={pad}: {l} != {j}"
             assert len([m for m in l if m.startswith("bad new-")]) == 6
+
+    def test_required_probes_elem_key_missing(self):
+        """`not container[probe]` with probe := params[_]: fires per
+        (container, missing probe); false values count as missing
+        (statement truthiness); non-dict elements lack every key."""
+        rego = """package probes
+violation[{"msg": msg}] {
+  container := input.review.object.spec.containers[_]
+  probe := input.constraint.spec.parameters.probes[_]
+  not container[probe]
+  msg := sprintf("container <%v> has no <%v>", [container.name, probe])
+}
+"""
+        local, jx = self._pair()
+        pods = [
+            {"apiVersion": "v1", "kind": "Pod",
+             "metadata": {"name": "a", "namespace": "d"},
+             "spec": {"containers": [
+                 {"name": "full", "livenessProbe": {"httpGet": {}},
+                  "readinessProbe": {"httpGet": {}}},
+                 {"name": "half", "livenessProbe": {"httpGet": {}}},
+                 {"name": "none"}]}},
+            {"apiVersion": "v1", "kind": "Pod",
+             "metadata": {"name": "b", "namespace": "d"},
+             "spec": {"containers": [
+                 {"name": "falsy", "livenessProbe": False,
+                  "readinessProbe": {"x": 1}}]}},
+        ]
+        for c in (local, jx):
+            c.add_template(self._tdoc("Probes", rego))
+            c.add_constraint(self._cdoc("Probes", "p",
+                                        {"probes": ["livenessProbe",
+                                                    "readinessProbe"]}))
+            for p in pods:
+                c.add_data(p)
+        st = jx.driver.state["admission.k8s.gatekeeper.sh"]
+        assert st.templates["Probes"].vectorized is not None
+        l = sorted(r.msg for r in local.audit().results())
+        j = sorted(r.msg for r in jx.audit().results())
+        assert l == j
+        assert "container <half> has no <readinessProbe>" in l
+        assert "container <falsy> has no <livenessProbe>" in l  # false = fails
+        assert "container <none> has no <livenessProbe>" in l
+        assert not any("full" in m for m in l)
+
+    def test_elem_key_missing_on_array_elements(self):
+        """coll[key] semantics per element type: array elements honor
+        int-index probes exactly (no over-approximation)."""
+        rego = """package arrp
+violation[{"msg": msg}] {
+  item := input.review.object.spec.items[_]
+  idx := input.constraint.spec.parameters.idxs[_]
+  not item[idx]
+  msg := sprintf("missing %v", [idx])
+}
+"""
+        local, jx = self._pair()
+        for c in (local, jx):
+            c.add_template(self._tdoc("ArrP", rego))
+            c.add_constraint(self._cdoc("ArrP", "a", {"idxs": [0, 2]}))
+            c.add_data({"apiVersion": "v1", "kind": "Pod",
+                        "metadata": {"name": "p", "namespace": "d"},
+                        "spec": {"items": [["a", "b", "c"], ["x"]],
+                                 "containers": []}})
+        st = jx.driver.state["admission.k8s.gatekeeper.sh"]
+        assert st.templates["ArrP"].vectorized is not None
+        l = sorted(r.msg for r in local.audit().results())
+        j = sorted(r.msg for r in jx.audit().results())
+        assert l == j == ["missing 2"]   # ["x"] lacks index 2; index 0 ok
