@@ -1016,6 +1016,7 @@ class Lowerer:
         name = f"cb{next(self.serial)}"
         interp = self.interp
         env_map = dict(self.env)
+        self._check_cenv(env_vars, env_map)
 
         def fn(c, _lit=lit, _ev=env_vars, _em=env_map):
             env = self._ceval_env(self._cinput(c), _ev, _em)
@@ -1197,6 +1198,13 @@ class Lowerer:
         a B x ~ekm matmul over the key axis.  This node is consumed
         directly as a conjunct — it must NOT be re-negated (that would
         need the all-keys-present dual, not `not` of this node)."""
+        if self._inline_depth > 0:
+            # inside an inlined function clause the node would be wrapped
+            # in the clause SNode and may be re-negated (`not f(c, p)`),
+            # flipping the existential-over-probes into an
+            # under-approximation — decline; the dynamic path then fails
+            # normal lowering and the template takes the scalar fallback
+            return None
         if not (isinstance(e, Ref) and isinstance(e.base, Var)
                 and len(e.path) == 1 and isinstance(e.path[0], Var)):
             return None
@@ -1208,7 +1216,9 @@ class Lowerer:
         if not isinstance(ksym, SCIter):
             return None
         axis = esym.leaf.root
-        self._emit_leaf(esym.leaf, "present")   # registers axis columns
+        if axis in self._retired_axes:
+            raise CannotLower("conjunct on the parent of a nested axis")
+        self._rule_axis_leaves.add(axis)
         csname = self._make_cset(ksym.term, ksym.env_vars, iterate=True,
                                  encode="str")
         ekname = f"ek{next(self.serial)}"

@@ -624,10 +624,15 @@ def build_bindings(spec: PrepSpec, table: ResourceTable,
             e_pad = e_pads[ek.axis]
             k_pad = bucket(max(len(needed), 1), minimum=2)
             ekm = np.zeros((k_pad, r_pad, e_pad), dtype=bool)
-            key_vals = {}
+            str_local: dict = {}
+            int_local: dict = {}
             for gid in needed:
                 ks = interner.string(gid)
-                key_vals[gid] = decode_value(ks) if ks.startswith("\x00") else ks
+                k = decode_value(ks) if ks.startswith("\x00") else ks
+                if isinstance(k, str):
+                    str_local[k] = local[gid]
+                elif isinstance(k, int) and not isinstance(k, bool):
+                    int_local[k] = local[gid]
             base_path = dict(spec.axes)[ek.axis]
             for row, o in enumerate(objs):
                 if o is None:
@@ -636,16 +641,16 @@ def build_bindings(spec: PrepSpec, table: ResourceTable,
                     if ei >= e_pad:
                         continue
                     if isinstance(elem, dict):
-                        for gid, k in key_vals.items():
-                            if isinstance(k, str) and k in elem \
-                                    and elem[k] is not False:
-                                ekm[local[gid], row, ei] = True
+                        # iterate the element's own keys against the
+                        # (usually tiny) needed-key map
+                        for k, v in elem.items():
+                            li = str_local.get(k) if isinstance(k, str) else None
+                            if li is not None and v is not False:
+                                ekm[li, row, ei] = True
                     elif isinstance(elem, list):
-                        for gid, k in key_vals.items():
-                            if isinstance(k, int) and not isinstance(k, bool) \
-                                    and 0 <= k < len(elem) \
-                                    and elem[k] is not False:
-                                ekm[local[gid], row, ei] = True
+                        for k, li in int_local.items():
+                            if 0 <= k < len(elem) and elem[k] is not False:
+                                ekm[li, row, ei] = True
             out[ek.name] = ekm
         if ek is not None or m is not None:
             if m is not None:

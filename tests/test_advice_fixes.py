@@ -402,3 +402,55 @@ def test_negated_embedded_iteration_is_not_exists():
     b = jx.driver._kind_bindings(st, "EmbNeg", compiled, cons)
     mask = ProgramExecutor().run(compiled.vectorized.program, b)
     assert mask.sum() == 1
+
+
+INLINED_PROBE_NEG = """package inlp
+f(c, probe) { not c[probe] }
+violation[{"msg": msg}] {
+  container := input.review.object.spec.containers[_]
+  probe := input.constraint.spec.parameters.probes[_]
+  not f(container, probe)
+  msg := sprintf("has %v on %v", [probe, container.name])
+}
+"""
+
+CVALID_AFTER_PROBE = """package cvp
+violation[{"msg": msg}] {
+  container := input.review.object.spec.containers[_]
+  probe := input.constraint.spec.parameters.probes[_]
+  not container[probe]
+  probe != "startupProbe"
+  msg := sprintf("missing %v", [probe])
+}
+"""
+
+
+def test_elem_key_missing_not_renegated_through_inline():
+    """`not f(container, probe)` with f wrapping `not c[probe]`: the
+    double negation must not under-approximate (template falls back)."""
+    local, jx = _pair()
+    for c in (local, jx):
+        c.add_template(template_doc("InlP", INLINED_PROBE_NEG))
+        c.add_constraint(constraint_doc("InlP", "i", {"probes": ["a", "b"]}))
+        c.add_data({"apiVersion": "v1", "kind": "Pod",
+                    "metadata": {"name": "p", "namespace": "d"},
+                    "spec": {"containers": [{"name": "c1", "b": {"x": 1}}]}})
+    l = sorted(r.msg for r in local.audit().results())
+    j = sorted(r.msg for r in jx.audit().results())
+    assert l == j == ["has b on c1"]
+
+
+def test_constraint_literal_on_generator_var_falls_back_cleanly():
+    """A constraint-only literal referencing the generator-bound probe
+    must reject at lower time (scalar fallback), not crash the sweep."""
+    local, jx = _pair()
+    for c in (local, jx):
+        c.add_template(template_doc("Cvp", CVALID_AFTER_PROBE))
+        c.add_constraint(constraint_doc(
+            "Cvp", "c", {"probes": ["livenessProbe", "startupProbe"]}))
+        c.add_data({"apiVersion": "v1", "kind": "Pod",
+                    "metadata": {"name": "p", "namespace": "d"},
+                    "spec": {"containers": [{"name": "c1"}]}})
+    l = sorted(r.msg for r in local.audit().results())
+    j = sorted(r.msg for r in jx.audit().results())
+    assert l == j == ["missing livenessProbe"]
