@@ -332,6 +332,7 @@ class Lowerer:
         self.keyed_vals: list[KeyedValReq] = []
         self.cvalid_fns: list[Callable] = []
         self._leaf_nodes: dict[tuple, int] = {}
+        self._no_negate_nodes: set[int] = set()
         self._fn_purity: dict[str, bool] = {}
         # per-rule state
         self.env: dict[str, Sym] = {}
@@ -911,6 +912,10 @@ class Lowerer:
         if isinstance(sym, SLeaf):
             nid = self._emit_leaf(sym.leaf, "truthy")
         elif isinstance(sym, SNode):
+            if negated and sym.nid in self._no_negate_nodes:
+                raise CannotLower(
+                    "negation of an existential-over-params node "
+                    "(elem_keys_missing) would under-approximate")
             if negated and not sym.exact:
                 raise CannotLower(
                     "negation of an over-approximating inlined function "
@@ -1198,13 +1203,6 @@ class Lowerer:
         a B x ~ekm matmul over the key axis.  This node is consumed
         directly as a conjunct — it must NOT be re-negated (that would
         need the all-keys-present dual, not `not` of this node)."""
-        if self._inline_depth > 0:
-            # inside an inlined function clause the node would be wrapped
-            # in the clause SNode and may be re-negated (`not f(c, p)`),
-            # flipping the existential-over-probes into an
-            # under-approximation — decline; the dynamic path then fails
-            # normal lowering and the template takes the scalar fallback
-            return None
         if not (isinstance(e, Ref) and isinstance(e.base, Var)
                 and len(e.path) == 1 and isinstance(e.path[0], Var)):
             return None
@@ -1223,7 +1221,13 @@ class Lowerer:
                                  encode="str")
         ekname = f"ek{next(self.serial)}"
         self.elem_keys.append(ElemKeysReq(ekname, csname, axis))
-        return self._emit("elem_keys_missing", (), (csname, ekname))
+        nid = self._emit("elem_keys_missing", (), (csname, ekname))
+        # the node is existential over the probe bindings: negating it
+        # computes all-present, NOT per-binding not-not — any enclosing
+        # negation (e.g. `not f(c, p)` around an inlined clause) must
+        # refuse and take the scalar fallback
+        self._no_negate_nodes.add(nid)
+        return nid
 
     def _try_keyed_lookup(self, rhs: Term) -> Sym | None:
         """``value := <review.object path>[key]`` with a constraint-only
@@ -1478,6 +1482,8 @@ class Lowerer:
             out = clause_nodes[0]
             for nid in clause_nodes[1:]:
                 out = self._emit("or", (out, nid))
+            if any(nid in self._no_negate_nodes for nid in clause_nodes):
+                self._no_negate_nodes.add(out)
             return SNode(out, "bool", exact=not inexact)
         finally:
             self._inline_depth -= 1
@@ -1507,6 +1513,8 @@ class Lowerer:
         out = parts[0]
         for nid in parts[1:]:
             out = self._emit("and", (out, nid))
+        if any(nid in self._no_negate_nodes for nid in parts):
+            self._no_negate_nodes.add(out)
         return out
 
     # -- comparisons ---------------------------------------------------

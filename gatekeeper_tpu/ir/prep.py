@@ -641,11 +641,10 @@ def build_bindings(spec: PrepSpec, table: ResourceTable,
                     if ei >= e_pad:
                         continue
                     if isinstance(elem, dict):
-                        # iterate the element's own keys against the
-                        # (usually tiny) needed-key map
-                        for k, v in elem.items():
-                            li = str_local.get(k) if isinstance(k, str) else None
-                            if li is not None and v is not False:
+                        # O(|needed|): probe the tiny key map against
+                        # the element, not the other way around
+                        for k, li in str_local.items():
+                            if k in elem and elem[k] is not False:
                                 ekm[li, row, ei] = True
                     elif isinstance(elem, list):
                         for k, li in int_local.items():

@@ -454,3 +454,31 @@ def test_constraint_literal_on_generator_var_falls_back_cleanly():
     l = sorted(r.msg for r in local.audit().results())
     j = sorted(r.msg for r in jx.audit().results())
     assert l == j == ["missing livenessProbe"]
+
+
+POSITIVE_INLINED_PROBE = """package posp
+lacks(c, probe) { not c[probe] }
+violation[{"msg": msg}] {
+  container := input.review.object.spec.containers[_]
+  probe := input.constraint.spec.parameters.probes[_]
+  lacks(container, probe)
+  msg := sprintf("lacks %v on %v", [probe, container.name])
+}
+"""
+
+
+def test_positive_inlined_probe_stays_vectorized():
+    """A POSITIVE inlined wrapper around `not c[probe]` keeps the
+    device path (only re-negation must fall back)."""
+    local, jx = _pair()
+    for c in (local, jx):
+        c.add_template(template_doc("PosP", POSITIVE_INLINED_PROBE))
+        c.add_constraint(constraint_doc("PosP", "p", {"probes": ["a", "b"]}))
+        c.add_data({"apiVersion": "v1", "kind": "Pod",
+                    "metadata": {"name": "p", "namespace": "d"},
+                    "spec": {"containers": [{"name": "c1", "b": {"x": 1}}]}})
+    st = jx.driver.state["admission.k8s.gatekeeper.sh"]
+    assert st.templates["PosP"].vectorized is not None
+    l = sorted(r.msg for r in local.audit().results())
+    j = sorted(r.msg for r in jx.audit().results())
+    assert l == j == ["lacks a on c1"]

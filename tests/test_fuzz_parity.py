@@ -105,6 +105,31 @@ def gen_pod(rng, i):
             "spec": spec}
 
 
+def gen_match(rng):
+    """Random spec.match block (kinds/namespaces/labelSelector) — half
+    the constraints get one, exercising the vectorized match engine."""
+    if rng.random() < 0.5:
+        return None
+    m = {}
+    if rng.random() < 0.5:
+        m["kinds"] = [{"apiGroups": [""],
+                       "kinds": [rng.choice(["Pod", "Namespace"])]}]
+    if rng.random() < 0.4:
+        m["namespaces"] = rng.sample(["d", "p", "q"], k=rng.randint(1, 2))
+    if rng.random() < 0.4:
+        sel = {}
+        if rng.random() < 0.7:
+            sel["matchLabels"] = {rng.choice(LABELS): rng.choice(VALUES)}
+        else:
+            op = rng.choice(["In", "NotIn", "Exists", "DoesNotExist"])
+            expr = {"key": rng.choice(LABELS), "operator": op}
+            if op in ("In", "NotIn"):
+                expr["values"] = rng.sample(VALUES, k=2)
+            sel["matchExpressions"] = [expr]
+        m["labelSelector"] = sel
+    return m or None
+
+
 def tdoc(kind, rego):
     return {"apiVersion": "templates.gatekeeper.sh/v1alpha1",
             "kind": "ConstraintTemplate", "metadata": {"name": kind.lower()},
@@ -113,9 +138,12 @@ def tdoc(kind, rego):
                                   "rego": rego}]}}
 
 
-def cdoc(kind, name, params):
+def cdoc(kind, name, params, match=None):
+    spec = {"parameters": params}
+    if match is not None:
+        spec["match"] = match
     return {"apiVersion": "constraints.gatekeeper.sh/v1alpha1", "kind": kind,
-            "metadata": {"name": name}, "spec": {"parameters": params}}
+            "metadata": {"name": name}, "spec": spec}
 
 
 @pytest.mark.parametrize("seed", range(8))
@@ -131,11 +159,16 @@ def test_fuzz_driver_parity(seed):
                   "repos": rng.sample(REPOS, k=rng.randint(1, 2)),
                   "probes": rng.sample(PROBES, k=rng.randint(1, 2)),
                   "allowed": [rng.choice(REPOS) + f"app{k}" for k in range(2)]}
+        match = gen_match(rng)
         for c in (local, jx):
             c.add_template(tdoc(kind, src))
-            c.add_constraint(cdoc(kind, f"f{i}", params))
+            c.add_constraint(cdoc(kind, f"f{i}", params, match))
     pods = [gen_pod(rng, i) for i in range(60)]
     for c in (local, jx):
+        for ns in ("d", "p"):
+            c.add_data({"apiVersion": "v1", "kind": "Namespace",
+                        "metadata": {"name": ns,
+                                     "labels": {"team": ns}}})
         for p in pods:
             c.add_data(p)
     key = lambda r: (r.msg, r.constraint["metadata"]["name"])
