@@ -125,7 +125,7 @@ def bench_north_star(detail):
 
     jd = JaxDriver()
     t0 = time.perf_counter()
-    setup_north_star(jd, resources, random.Random(7))
+    client = setup_north_star(jd, resources, random.Random(7))
     ingest_s = time.perf_counter() - t0
     t0 = time.perf_counter()
     jd.query_audit(TARGET_NAME, QueryOpts(limit_per_constraint=CAP))
@@ -133,6 +133,29 @@ def bench_north_star(detail):
     snap0 = jd.metrics.snapshot()
     t_best, _t_first, n_results = timed_audit(jd)
     snap = jd.metrics.snapshot()
+
+    # churn: upsert 1% of rows (label/image edits on existing names),
+    # then sweep — delta-maintained columns/bindings/masks must keep the
+    # sweep near steady state instead of re-paying full prep
+    churn_rng = random.Random(1234)
+    n_churn = max(N // 100, 1)
+    churn_times = []
+    for _rep in range(3):
+        t0 = time.perf_counter()
+        for i in churn_rng.sample(range(N), n_churn):
+            o = resources[i]
+            o["metadata"]["labels"] = {
+                k: "v" for k in [f"l{j}" for j in range(10)]
+                if churn_rng.random() < 0.35}
+            client.add_data(o)
+        t_upsert = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        _res, _ = jd.query_audit(TARGET_NAME,
+                                 QueryOpts(limit_per_constraint=CAP))
+        churn_times.append(time.perf_counter() - t0)
+        log(f"[north-star] churn rep: upsert {n_churn} rows {t_upsert:.2f}s,"
+            f" sweep {churn_times[-1]:.2f}s")
+    churn_s = min(churn_times)
 
     def delta_mean(key):
         a, b = snap0.get(key, {}), snap.get(key, {})
@@ -144,7 +167,8 @@ def bench_north_star(detail):
     fmt = {"mean_seconds": delta_mean("host_format")}
     evals = N * n_constraints
     log(f"[north-star] ingest {ingest_s:.1f}s | first audit (cold) {cold_s:.1f}s"
-        f" | steady {t_best*1e3:.0f}ms ({n_results} capped results)")
+        f" | steady {t_best*1e3:.0f}ms ({n_results} capped results)"
+        f" | 1%-churn sweep {churn_s*1e3:.0f}ms")
     log(f"[north-star] breakdown: device-wait mean "
         f"{(dev.get('mean_seconds') or 0)*1e3:.0f}ms/kind, host-format mean "
         f"{(fmt.get('mean_seconds') or 0)*1e3:.0f}ms/kind | format-memo "
@@ -167,6 +191,7 @@ def bench_north_star(detail):
         "n_resources": N, "n_constraints": n_constraints,
         "steady_seconds": round(t_best, 4), "cold_seconds": round(cold_s, 2),
         "ingest_seconds": round(ingest_s, 2),
+        "churn_1pct_sweep_seconds": round(churn_s, 4),
         "device_wait_mean_s": dev.get("mean_seconds"),
         "host_format_mean_s": fmt.get("mean_seconds"),
         "capped_results": n_results,

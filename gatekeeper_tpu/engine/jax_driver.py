@@ -79,7 +79,18 @@ class JaxDriver(LocalDriver):
 
     def __init__(self, tracing: bool = False):
         super().__init__(tracing=tracing)
-        self.executor = ProgramExecutor()
+        mesh = None
+        try:
+            import jax
+            n_dev = len(jax.devices())
+        except RuntimeError as e:       # backend init failure: no devices
+            n_dev = 0
+            print(f"gatekeeper-tpu: jax device probe failed ({e}); "
+                  f"single-device engine", flush=True)
+        if n_dev > 1:
+            from gatekeeper_tpu.parallel.sharding import make_mesh
+            mesh = make_mesh()          # a real failure here should raise
+        self.executor = ProgramExecutor(mesh=mesh)
         self.metrics = Metrics()
 
     # ------------------------------------------------------------------
@@ -127,27 +138,105 @@ class JaxDriver(LocalDriver):
         return [st.constraints[kind][n] for n in sorted(st.constraints.get(kind, {}))]
 
     def _kind_mask(self, st: JaxTargetState, target: str, kind: str,
-                   constraints: list[dict]) -> np.ndarray | None:
+                   constraints: list[dict]):
+        """(mask [C, n_rows] view, dirty rows | None, padded).  The mask
+        is kept in its padded [c_pad, r_pad] form (the device layout) and
+        delta-maintained under churn: one copy + dirty-column writes per
+        generation instead of full re-matching + re-padding.  Delta is
+        bypassed when a Namespace object changed (namespaceSelector
+        results of unchanged rows may shift, table.namespaces_dirty_since)
+        or rows were remapped."""
+        from gatekeeper_tpu.ir.prep import audit_pads
+        from gatekeeper_tpu.store.table import delta_worthwhile
         engine = self._match_engine(st, target)
         if engine is None:
-            return None
-        key = (st.table.generation, self.con_version_of(st, kind))
+            return None, None, None
+        table = st.table
+        gen, remap = table.generation, table.remap_generation
+        conver = self.con_version_of(st, kind)
+        n = table.n_rows
+        n_con = len(constraints)
+        r_pad, c_pad = audit_pads(n, n_con)
         hit = st.mask_cache.get(kind)
-        if hit is not None and hit[0] == key:
-            return hit[1]
-        mask = engine.mask(constraints)
-        st.mask_cache[kind] = (key, mask)
-        return mask
+        if hit is not None and hit[0] == (gen, conver):
+            padded = hit[2]
+            return padded[:n_con, :n], None, padded
+        if hit is not None and hit[1] == (conver, remap) \
+                and hit[2].shape == (c_pad, r_pad) \
+                and not table.namespaces_dirty_since(hit[0][0]):
+            dirty = table.dirty_rows_since(hit[0][0])
+            if delta_worthwhile(len(dirty), n):
+                padded = hit[2].copy()
+                if len(dirty):
+                    padded[:n_con, dirty] = engine.mask_rows(constraints,
+                                                             dirty)
+                st.mask_cache[kind] = ((gen, conver), (conver, remap), padded)
+                return padded[:n_con, :n], dirty, padded
+        padded = np.zeros((c_pad, r_pad), dtype=bool)
+        padded[:n_con, :n] = engine.mask(constraints)
+        st.mask_cache[kind] = ((gen, conver), (conver, remap), padded)
+        return padded[:n_con, :n], None, padded
 
     def _kind_bindings(self, st: JaxTargetState, kind: str,
                        compiled: CompiledTemplate, constraints: list[dict]):
+        from gatekeeper_tpu.ir.prep import update_bindings
         key = (st.table.generation, self.con_version_of(st, kind))
         hit = st.bindings_cache.get(kind)
         if hit is not None and hit[0] == key:
             return hit[1]
+        if hit is not None and hit[0][1] == key[1]:
+            b = update_bindings(compiled.vectorized.spec, st.table,
+                                constraints, hit[1])
+            if b is not None:
+                # carry the gate-source identities so unchanged gates
+                # keep their device copies through the delta chain
+                for attr in ("_match_src", "_rank_src"):
+                    if attr in hit[1].__dict__:
+                        b.__dict__[attr] = hit[1].__dict__[attr]
+                self.metrics.counter("bindings_delta_updates").inc()
+                st.bindings_cache[kind] = (key, b)
+                return b
         bindings = build_bindings(compiled.vectorized.spec, st.table, constraints)
+        self.metrics.counter("bindings_full_builds").inc()
         st.bindings_cache[kind] = (key, bindings)
         return bindings
+
+    def _install_gates(self, bindings, mask: np.ndarray | None,
+                       mask_dirty: np.ndarray | None,
+                       rank: np.ndarray | None,
+                       padded: np.ndarray | None = None) -> None:
+        """Attach the padded match mask and rank as regular bindings
+        arrays ("__match__", "__rank__") so they ride the same per-name
+        device cache + scatter-update path as the columns (the executor
+        then needs no separate match/rank plumbing, and the sharded
+        path shards them by their declared axes).  `padded` is the
+        mask's canonical padded form from _kind_mask — installed without
+        any copy when its shape matches the bindings buckets."""
+        # NOTE: bindings.arrays / base_dirty are REBOUND (never mutated
+        # in place): concurrent readers (RWLock shares queries) may be
+        # iterating the old dicts — racing installs produce identical
+        # dicts and last-write-wins is benign, mid-iteration mutation
+        # would not be.
+        d = bindings.__dict__
+        if mask is not None and bindings.arrays.get("__match__") is not padded \
+                and d.get("_match_src") is not mask:
+            if padded is None or \
+                    padded.shape != (bindings.c_pad, bindings.r_pad):
+                padded = np.zeros((bindings.c_pad, bindings.r_pad),
+                                  dtype=bool)
+                padded[: mask.shape[0], : mask.shape[1]] = mask
+            old = bindings.arrays.get("__match__")
+            bindings.arrays = {**bindings.arrays, "__match__": padded}
+            d["_match_src"] = mask
+            if bindings.base is not None and mask_dirty is not None \
+                    and old is not None and old.shape == padded.shape:
+                bindings.base_dirty = {**bindings.base_dirty,
+                                       "__match__": mask_dirty}
+        if rank is not None and d.get("_rank_src") is not rank:
+            from gatekeeper_tpu.engine.veval import pad_rank
+            bindings.arrays = {**bindings.arrays,
+                               "__rank__": pad_rank(rank, bindings.r_pad)}
+            d["_rank_src"] = rank
 
     # ------------------------------------------------------------------
 
@@ -166,14 +255,15 @@ class JaxDriver(LocalDriver):
 
         # row ordering matches the scalar driver (sorted cache keys) so
         # both drivers return identical result lists; the 1M-row sort +
-        # index dict are generation-cached (steady-state sweeps reuse)
-        gen = st.table.generation
-        if st.order_cache is not None and st.order_cache[0] == gen:
+        # index dict are keyed on key_generation — pure updates (the
+        # dominant churn in a live cluster) never re-sort
+        kgen = st.table.key_generation
+        if st.order_cache is not None and st.order_cache[0] == kgen:
             _, ordered_rows, row_order = st.order_cache
         else:
             ordered_rows = [row for _, row in sorted(st.table.rows_items())]
             row_order = {row: i for i, row in enumerate(ordered_rows)}
-            st.order_cache = (gen, ordered_rows, row_order)
+            st.order_cache = (kgen, ordered_rows, row_order)
         rank = self._row_rank(st, row_order)
 
         # phase 1: dispatch every kind's device evaluation without
@@ -189,10 +279,12 @@ class JaxDriver(LocalDriver):
             constraints = self._kind_constraints(st, kind)
             if not constraints:
                 continue
-            mask = self._kind_mask(st, target, kind, constraints)
+            mask, mask_dirty, padded = self._kind_mask(st, target, kind,
+                                                       constraints)
             small = len(ordered_rows) * len(constraints) < SMALL_WORKLOAD_EVALS
             if compiled.vectorized is not None and mask is not None and not small:
                 bindings = self._kind_bindings(st, kind, compiled, constraints)
+                self._install_gates(bindings, mask, mask_dirty, rank, padded)
                 prog = compiled.vectorized.program
                 mode = "topk" if limit is not None else "mask"
                 specs.append((mode, kind, compiled, constraints, prog,
@@ -205,11 +297,11 @@ class JaxDriver(LocalDriver):
 
         def dispatch(spec):
             mode, _, _, _, prog, bindings, mask = spec
+            # match/rank gates ride bindings.arrays (_install_gates)
             if mode == "topk":
-                return self.executor.run_topk_async(prog, bindings, limit,
-                                                    match=mask, rank=rank)
+                return self.executor.run_topk_async(prog, bindings, limit)
             if mode == "mask":
-                return self.executor.run_async(prog, bindings, match=mask)
+                return self.executor.run_async(prog, bindings)
             return None
 
         n_dev = sum(1 for sp in specs if sp[0] != "scalar")
@@ -271,7 +363,7 @@ class JaxDriver(LocalDriver):
         if compiled.vectorized is None:
             return f"template {kind!r} runs on the scalar engine (not lowered)"
         bindings = self._kind_bindings(st, kind, compiled, constraints)
-        mask = self._kind_mask(st, target, kind, constraints)
+        mask, _, _ = self._kind_mask(st, target, kind, constraints)
         out = explain(compiled.vectorized.program, bindings, ci, row,
                       match=mask)
         handler = self.targets[target]
@@ -371,16 +463,19 @@ class JaxDriver(LocalDriver):
         """[n_rows] int32: row -> sorted-cache-key rank.  The device
         top-k scores by this rank so the capped subset matches the
         scalar driver's cap order (not raw table row order, which
-        diverges after deletes/re-inserts).  Cached per generation so
-        steady-state sweeps reuse one array instance (device cache)."""
-        gen = st.table.generation
-        if st.rank_cache is not None and st.rank_cache[0] == gen:
+        diverges after deletes/re-inserts).  Keyed on key_generation —
+        pure updates reuse one array instance (device cache stays hot)."""
+        kgen = st.table.key_generation
+        if st.rank_cache is not None and st.rank_cache[0] == kgen:
             return st.rank_cache[1]
         n = st.table.n_rows
         rank = np.full((n,), n - 1, dtype=np.int32)
-        for row, i in row_order.items():
-            rank[row] = i
-        st.rank_cache = (gen, rank)
+        if row_order:
+            rows = np.fromiter(row_order.keys(), dtype=np.int64,
+                               count=len(row_order))
+            rank[rows] = np.fromiter(row_order.values(), dtype=np.int32,
+                                     count=len(row_order))
+        st.rank_cache = (kgen, rank)
         return rank
 
     def _format_topk(self, st, target, handler, compiled, constraints,
@@ -394,8 +489,7 @@ class JaxDriver(LocalDriver):
         mask for that constraint."""
         import time as _time
         if handle is None:
-            handle = self.executor.run_topk_async(prog, bindings, limit,
-                                                  match=mask, rank=rank)
+            handle = self.executor.run_topk_async(prog, bindings, limit)
         _tw = _time.perf_counter()
         counts, rows, valid = handle.get()
         self.metrics.timer("device_wait").observe(_time.perf_counter() - _tw)
@@ -410,8 +504,7 @@ class JaxDriver(LocalDriver):
                                       rcache)
             if emitted < limit and int(counts[ci]) > len(sel):
                 if full_cand is None:
-                    full_cand = self.executor.run(prog, bindings, match=mask,
-                                                  rank=rank)
+                    full_cand = self.executor.run(prog, bindings)
                 sel_set = set(sel)
                 rest = sorted((ri for ri in map(int, np.nonzero(full_cand[ci])[0])
                                if ri in row_order and ri not in sel_set),

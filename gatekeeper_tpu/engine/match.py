@@ -13,6 +13,11 @@ label CSR), and each constraint combines primitive columns.
 The mask gates template evaluation: device violation masks are ANDed
 with it, and the scalar fallback only visits candidate pairs.
 
+``mask_rows`` evaluates the same semantics over a row *subset* — the
+delta path for steady-state churn (only dirty rows re-match; sound
+unless a Namespace object changed, which shifts namespaceSelector
+results of other rows — the caller checks ``namespaces_dirty_since``).
+
 Semantics notes mirrored from the scalar matcher:
 - absent `kinds` field -> wildcard; explicit empty list matches nothing;
 - `namespaces`: review.namespace must be listed (cluster-scoped
@@ -34,7 +39,7 @@ from gatekeeper_tpu.store.table import ResourceTable
 
 
 class _LabelIndex:
-    """Per-generation vectorized label lookups over the CSR columns."""
+    """Vectorized label lookups over a (possibly row-subset) CSR."""
 
     def __init__(self, keys: np.ndarray, vals: np.ndarray,
                  offsets: np.ndarray, n: int):
@@ -61,52 +66,64 @@ class _LabelIndex:
         return self.value_of(key_id) != MISSING
 
 
-class MatchEngine:
-    def __init__(self, table: ResourceTable):
+class _View:
+    """Identity/label/namespace columns for one row set (all rows or a
+    dirty subset), with the selector primitives evaluated over it."""
+
+    def __init__(self, table: ResourceTable, rows: np.ndarray | None):
+        ident = table.identity()
         self.table = table
-        self._gen = -1
-        self._ident = None
-        self._labels: _LabelIndex | None = None
+        self.rows = rows
+        if rows is None:
+            self.n = len(ident.alive)
+            self.alive = ident.alive
+            self.group_ids = ident.group_ids
+            self.kind_ids = ident.kind_ids
+            self.ns_ids = ident.ns_ids
+            keys, vals, offs = table.labels_csr()
+            self.labels = _LabelIndex(keys, vals, offs, self.n)
+        else:
+            self.n = len(rows)
+            self.alive = ident.alive[rows]
+            self.group_ids = ident.group_ids[rows]
+            self.kind_ids = ident.kind_ids[rows]
+            self.ns_ids = ident.ns_ids[rows]
+            # labels for the subset come straight from the objects —
+            # O(|rows|), never forcing the full-CSR delta splice
+            from gatekeeper_tpu.store.columns import ColSpec, build_column
+            col = build_column(ColSpec(("metadata", "labels"), "items"),
+                               [table._objs[int(r)] for r in rows],
+                               table.interner)
+            vals2 = col.values2 if col.values2 is not None else col.values
+            self.labels = _LabelIndex(col.values, vals2, col.offsets, self.n)
         self._ns_index: tuple | None = None
 
-    # -- columns -------------------------------------------------------
-
-    def _refresh(self) -> None:
-        gen = self.table.generation
-        if gen == self._gen:
-            return
-        self._gen = gen
-        self._ident = self.table.identity()
-        n = len(self._ident.alive)
-        self._labels = _LabelIndex(self._ident.label_keys,
-                                   self._ident.label_vals,
-                                   self._ident.label_offsets, n)
-        self._ns_index = None
+    # -- namespace labels ---------------------------------------------
 
     def _namespace_labels(self):
-        """(ns name ids [K], per-resource slot [n] into 0..K or -1,
-        label dicts per slot)."""
+        """(ns name ids [K] sorted, per-resource slot [n] into 0..K or
+        -1, label dicts per slot)."""
         if self._ns_index is not None:
             return self._ns_index
         items = self.table.namespace_label_items()
         ns_ids = np.asarray(sorted(items), dtype=np.int32)
-        slot_of = {int(i): s for s, i in enumerate(ns_ids)}
-        col = self._ident.ns_ids
-        slots = np.full(col.shape, -1, dtype=np.int32)
+        col = self.ns_ids
         if len(ns_ids):
-            for i in np.unique(col):
-                if int(i) in slot_of:
-                    slots[col == i] = slot_of[int(i)]
+            pos = np.searchsorted(ns_ids, col)
+            pos = np.clip(pos, 0, len(ns_ids) - 1)
+            slots = np.where(ns_ids[pos] == col, pos, -1).astype(np.int32)
+        else:
+            slots = np.full(col.shape, -1, dtype=np.int32)
         dicts = [dict(items[int(i)]) for i in ns_ids]
         self._ns_index = (ns_ids, slots, dicts)
         return self._ns_index
 
     # -- selector primitives -------------------------------------------
 
-    def _selector_ok_obj(self, selector: dict) -> np.ndarray:
+    def selector_ok_obj(self, selector: dict) -> np.ndarray:
         """matches_label_selector over object labels, vectorized [n]."""
         it = self.table.interner
-        lab = self._labels
+        lab = self.labels
         ok = np.ones((lab.n,), dtype=bool)
         for k, v in (selector.get("matchLabels") or {}).items():
             vid = it.lookup(v) if isinstance(v, str) else MISSING
@@ -118,7 +135,7 @@ class MatchEngine:
 
     def _expr_violated_obj(self, expr: dict) -> np.ndarray:
         it = self.table.interner
-        lab = self._labels
+        lab = self.labels
         op = expr.get("operator", "")
         key = expr.get("key", "")
         kid = it.lookup(key) if isinstance(key, str) else MISSING
@@ -140,7 +157,7 @@ class MatchEngine:
             return has & in_vals if values else np.zeros((lab.n,), dtype=bool)
         return np.zeros((lab.n,), dtype=bool)  # unknown operator: no clause
 
-    def _selector_ok_ns(self, selector: dict) -> np.ndarray:
+    def selector_ok_ns(self, selector: dict) -> np.ndarray:
         """namespaceSelector: resolve per-namespace then gather; uncached
         namespace (slot -1) -> False."""
         from gatekeeper_tpu.target.k8s import matches_label_selector
@@ -153,18 +170,16 @@ class MatchEngine:
             per_ns[s] = matches_label_selector(selector, labels)
         return per_ns[np.where(slots >= 0, slots, len(ns_ids))] & (slots >= 0)
 
-    # -- the mask ------------------------------------------------------
+    # -- the mask over this view --------------------------------------
 
     def mask(self, constraints: list[dict]) -> np.ndarray:
-        """bool [len(constraints), n_rows]; tombstoned rows are False."""
-        self._refresh()
-        ident = self._ident
+        """bool [len(constraints), self.n]; tombstoned rows are False."""
         it = self.table.interner
-        n = len(ident.alive)
+        n = self.n
         out = np.zeros((len(constraints), n), dtype=bool)
         for ci, c in enumerate(constraints):
             match = (c.get("spec") or {}).get("match") or {}
-            m = ident.alive.copy()
+            m = self.alive.copy()
 
             if "kinds" in match:
                 kinds = match["kinds"] if isinstance(match["kinds"], list) else []
@@ -173,11 +188,11 @@ class MatchEngine:
                     groups = ks.get("apiGroups") or []
                     knames = ks.get("kinds") or []
                     gm = np.ones((n,), dtype=bool) if "*" in groups else \
-                        np.isin(ident.group_ids, np.asarray(
+                        np.isin(self.group_ids, np.asarray(
                             [it.lookup(g) for g in groups if isinstance(g, str)],
                             dtype=np.int32))
                     nm = np.ones((n,), dtype=bool) if "*" in knames else \
-                        np.isin(ident.kind_ids, np.asarray(
+                        np.isin(self.kind_ids, np.asarray(
                             [it.lookup(k) for k in knames if isinstance(k, str)],
                             dtype=np.int32))
                     km |= gm & nm
@@ -186,15 +201,41 @@ class MatchEngine:
             if "namespaces" in match and match["namespaces"] is not None:
                 nss = [it.lookup(s) for s in match["namespaces"]
                        if isinstance(s, str)]
-                m &= np.isin(ident.ns_ids, np.asarray(nss, dtype=np.int32)) \
-                    & (ident.ns_ids != MISSING)
+                m &= np.isin(self.ns_ids, np.asarray(nss, dtype=np.int32)) \
+                    & (self.ns_ids != MISSING)
 
             if "namespaceSelector" in match and match["namespaceSelector"] is not None:
-                m &= self._selector_ok_ns(match["namespaceSelector"])
+                m &= self.selector_ok_ns(match["namespaceSelector"])
 
             selector = match.get("labelSelector") or {}
             if selector:
-                m &= self._selector_ok_obj(selector)
+                m &= self.selector_ok_obj(selector)
 
             out[ci] = m
         return out
+
+
+class MatchEngine:
+    def __init__(self, table: ResourceTable):
+        self.table = table
+        self._gen = -1
+        self._view: _View | None = None
+
+    def _full_view(self) -> _View:
+        gen = self.table.generation
+        if self._view is None or gen != self._gen:
+            self._gen = gen
+            self._view = _View(self.table, None)
+        return self._view
+
+    def mask(self, constraints: list[dict]) -> np.ndarray:
+        """bool [len(constraints), n_rows]; tombstoned rows are False."""
+        return self._full_view().mask(constraints)
+
+    def mask_rows(self, constraints: list[dict],
+                  rows: np.ndarray) -> np.ndarray:
+        """bool [len(constraints), len(rows)] over a row subset — the
+        churn delta path.  NOT sound across Namespace-object changes
+        (namespaceSelector results of unchanged rows shift); callers
+        gate on table.namespaces_dirty_since."""
+        return _View(self.table, rows).mask(constraints)

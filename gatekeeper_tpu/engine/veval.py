@@ -493,7 +493,7 @@ class ProgramExecutor:
     compilation cache (utils/compile_cache) — a restart re-traces but
     skips the multi-second XLA compile per (template, bucket)."""
 
-    def __init__(self):
+    def __init__(self, mesh=None):
         from gatekeeper_tpu.utils.compile_cache import enable_persistent_cache
         enable_persistent_cache()
         self._cache: dict[tuple, Any] = {}
@@ -501,38 +501,157 @@ class ProgramExecutor:
         self._trace_lock = __import__("threading").Lock()
         self.compiles = 0      # executable-cache misses (trace+compile)
         self.cache_hits = 0    # executable-cache hits
+        # multi-chip: a (c, r) jax.sharding.Mesh — bindings device_put
+        # with NamedShardings per ir/prep.binding_axes, executables built
+        # via shard_map (parallel/sharding.py).  None = single device.
+        self.mesh = mesh
+
+    def _sharding_of(self, name: str):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from gatekeeper_tpu.ir.prep import binding_axes
+        return NamedSharding(self.mesh, P(*binding_axes(name)))
+
+    def _mesh_divides(self, arrays: dict) -> bool:
+        """Every c/r-sharded dim must divide by its mesh axis (always
+        true for power-of-two buckets >= the mesh axis; tiny toy shapes
+        fall back to single-device execution)."""
+        from gatekeeper_tpu.ir.prep import binding_axes
+        cs, rs = self.mesh.shape["c"], self.mesh.shape["r"]
+        for nm, a in arrays.items():
+            for d, ax in enumerate(binding_axes(nm)):
+                if ax == "c" and a.shape[d] % cs:
+                    return False
+                if ax == "r" and a.shape[d] % rs:
+                    return False
+        return True
+
+    def _sharded_for(self, bindings: Bindings) -> bool:
+        """Whether this bindings set executes on the mesh (memoized per
+        (executor, Bindings) — different executors may carry different
+        meshes, e.g. the driver's vs a test's)."""
+        d = bindings.__dict__.setdefault("_sharded_by", {})
+        hit = d.get(id(self))
+        if hit is None:
+            hit = d[id(self)] = self.mesh is not None and \
+                self._mesh_divides(bindings.arrays)
+        return hit
+
+    def _put(self, name: str, host: np.ndarray, sharded: bool) -> jax.Array:
+        if sharded:
+            return jax.device_put(host, self._sharding_of(name))
+        return jax.device_put(host)
+
+    def _scatter_rows(self, name: str, dev: jax.Array, host: np.ndarray,
+                      rows: np.ndarray, sharded: bool) -> jax.Array:
+        """Device-side delta: replace `rows` along the resource axis of
+        the cached device array with the new host values.  Ships
+        O(|dirty|) bytes instead of the whole column — behind a
+        high-latency tunnel this is what keeps churned steady-state
+        sweeps from re-paying full column uploads."""
+        from gatekeeper_tpu.ir.prep import bucket
+        ax = _r_axis(name)
+        # pad the dirty set to a power-of-two bucket (repeat the first
+        # row; duplicate scatter of identical values is a no-op) so the
+        # scatter kernel compiles once per bucket, not once per sweep
+        b = bucket(max(len(rows), 1), minimum=8)
+        rows = np.concatenate(
+            [rows, np.full((b - len(rows),), rows[0] if len(rows) else 0,
+                           dtype=rows.dtype)])
+        idx = [slice(None)] * host.ndim
+        idx[ax] = rows
+        vals = np.ascontiguousarray(host[tuple(idx)])
+        out = dev.at[tuple(idx)].set(jax.device_put(vals))
+        if sharded:
+            # scatter output placement follows XLA's choice; pin it back
+            # to the canonical named sharding (no-op when already there)
+            out = jax.device_put(out, self._sharding_of(name))
+        return out
+
+    def _migrate(self, bindings: Bindings, depth: int = 0) -> dict:
+        """Per-name device cache for `bindings`, seeded from its delta
+        base when present: unchanged arrays keep their device copies,
+        r-axis-dirty arrays are scatter-updated on device, and only
+        genuinely new arrays are uploaded whole."""
+        caches = bindings.__dict__.setdefault("_device_caches", {})
+        cache = caches.get(id(self))
+        if cache is not None:
+            return cache
+        # snapshot the lineage once: a concurrent reader may sever the
+        # chain (bindings.base = None) while we migrate — racing readers
+        # compute identical caches, and setdefault below keeps whichever
+        # landed first instead of clobbering a populated cache with an
+        # empty one (RWLock contract: reader-side fills must be benign)
+        base = bindings.base
+        base_dirty = bindings.base_dirty
+        arrays = bindings.arrays
+        cache = {}
+        if base is not None and depth < 8:
+            sharded = self._sharded_for(bindings)
+            base_cache = self._migrate(base, depth + 1)
+            for name, (href, dev) in base_cache.items():
+                cur = arrays.get(name)
+                if cur is None:
+                    continue
+                if cur is href:
+                    cache[name] = (href, dev)
+                elif name in base_dirty and cur.shape == dev.shape \
+                        and href is base.arrays.get(name):
+                    cache[name] = (cur, self._scatter_rows(
+                        name, dev, cur, base_dirty[name], sharded))
+        cache = caches.setdefault(id(self), cache)
+        bindings.base = None          # sever the chain; keep memory flat
+        bindings.base_dirty = {}
+        return cache
 
     def _arrays(self, bindings: Bindings, match: np.ndarray | None,
                 rank: np.ndarray | None = None):
-        """Device-resident view of the bindings, memoized on the
-        Bindings instance: steady-state audits (unchanged generation)
-        re-run the executable without re-uploading columns."""
-        cache = bindings.__dict__.setdefault("_device_cache", {})
-        key = (id(match), id(rank))
-        hit = cache.get(key)
-        if hit is not None and hit[0] is match and hit[1] is rank:
-            return hit[2]
-        arrays = {k: jax.device_put(v) for k, v in bindings.arrays.items()}
-        if match is not None:
-            padded = np.zeros((bindings.c_pad, bindings.r_pad), dtype=bool)
-            padded[: match.shape[0], : match.shape[1]] = match
-            arrays["__match__"] = jax.device_put(padded)
-        if rank is not None:
-            arrays["__rank__"] = jax.device_put(pad_rank(rank, bindings.r_pad))
-        cache.clear()  # one live (bindings, match, rank) triple at a time
-        cache[key] = (match, rank, arrays)
+        """Device-resident view of the bindings, memoized per array name
+        on the Bindings instance (identity-keyed): steady-state audits
+        re-run the executable without re-uploading columns, and
+        delta-derived bindings (update_bindings) migrate the previous
+        generation's device arrays via on-device row scatter."""
+        cache = self._migrate(bindings)
+        sharded = self._sharded_for(bindings)
+        arrays: dict[str, jax.Array] = {}
+        for name, host in bindings.arrays.items():
+            hit = cache.get(name)
+            if hit is None or hit[0] is not host:
+                cache[name] = hit = (host, self._put(name, host, sharded))
+            arrays[name] = hit[1]
+        if match is not None and "__match__" not in bindings.arrays:
+            hit = cache.get("__match__")
+            if hit is None or hit[0] is not match:
+                padded = np.zeros((bindings.c_pad, bindings.r_pad), dtype=bool)
+                padded[: match.shape[0], : match.shape[1]] = match
+                cache["__match__"] = hit = (
+                    match, self._put("__match__", padded, sharded))
+            arrays["__match__"] = hit[1]
+        if rank is not None and "__rank__" not in bindings.arrays:
+            hit = cache.get("__rank__")
+            if hit is None or hit[0] is not rank:
+                cache["__rank__"] = hit = (
+                    rank, self._put("__rank__", pad_rank(rank, bindings.r_pad),
+                                    sharded))
+            arrays["__rank__"] = hit[1]
         return arrays
 
-    def _compiled(self, program: Program, arrays: dict, topk: int | None):
+    def _compiled(self, program: Program, arrays: dict, topk: int | None,
+                  sharded: bool = False):
         """Callable for (program, shape bucket).  Tracing/lowering is
         pure Python and GIL-bound — running it from the dispatch thread
         pool just thrashes the GIL (measured 4-5x slower than serial) —
         so it is serialized under `_trace_lock`; the XLA compile
         (`lowered.compile()`, C++ — releases the GIL and hits the
         persistent on-disk cache) runs outside it, which is what the
-        thread pool actually parallelizes on a cold start."""
+        thread pool actually parallelizes on a cold start.
+
+        With `sharded`, the executable is the shard_map multi-chip twin
+        (parallel/sharding.py) over the executor's mesh — same packed
+        output shapes, counts/top-k merged across shards via XLA
+        collectives (psum / all_gather over ICI)."""
         names = tuple(sorted(arrays))
-        key = (program.cache_key(), topk, R_CHUNK,
+        mesh_key = tuple(self.mesh.shape.items()) if sharded else None
+        key = (program.cache_key(), topk, R_CHUNK, mesh_key,
                tuple((nm,) + tuple(arrays[nm].shape)
                      + (str(arrays[nm].dtype),) for nm in names))
         with self._lock:
@@ -540,7 +659,20 @@ class ProgramExecutor:
             if fn is not None:
                 self.cache_hits += 1
         if fn is None:
-            if topk is None:
+            if sharded:
+                from jax.sharding import PartitionSpec as P
+                from gatekeeper_tpu.ir.prep import binding_axes
+                from gatekeeper_tpu.parallel.sharding import (
+                    make_sharded_mask_fn, make_sharded_topk_packed)
+                specs = {nm: P(*binding_axes(nm)) for nm in names}
+                r_pad = arrays["__alive__"].shape[0]
+                if topk is None:
+                    raw = make_sharded_mask_fn(program, names, specs,
+                                               self.mesh)
+                else:
+                    raw = make_sharded_topk_packed(program, names, specs,
+                                                   self.mesh, topk, r_pad)
+            elif topk is None:
                 def raw(args: tuple):
                     return _eval_mask(program, dict(zip(names, args)))
             else:
@@ -551,7 +683,9 @@ class ProgramExecutor:
                     return jnp.concatenate(
                         [counts[:, None], rows, valid], axis=1)  # [C, 1+2k]
             example = tuple(
-                jax.ShapeDtypeStruct(arrays[nm].shape, arrays[nm].dtype)
+                jax.ShapeDtypeStruct(arrays[nm].shape, arrays[nm].dtype,
+                                     sharding=arrays[nm].sharding
+                                     if sharded else None)
                 for nm in names)
             with self._trace_lock:
                 # double-check: a concurrent miss on the same key may
@@ -578,7 +712,8 @@ class ProgramExecutor:
         n_resources].  Like run_topk_async, the host copy starts
         eagerly so per-kind fetch round-trips overlap."""
         arrays = self._arrays(bindings, match, rank)
-        fn, names = self._compiled(program, arrays, None)
+        fn, names = self._compiled(program, arrays, None,
+                                   self._sharded_for(bindings))
         mask = fn(tuple(arrays[nm] for nm in names))
         try:
             mask.copy_to_host_async()
@@ -610,7 +745,8 @@ class ProgramExecutor:
         one audit sweep pays one round-trip per kind — all overlapping —
         instead of three serialized fetches per kind."""
         arrays = self._arrays(bindings, match, rank)
-        fn, names = self._compiled(program, arrays, k)
+        fn, names = self._compiled(program, arrays, k,
+                                   self._sharded_for(bindings))
         packed = fn(tuple(arrays[nm] for nm in names))
         try:
             packed.copy_to_host_async()

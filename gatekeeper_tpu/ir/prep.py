@@ -48,6 +48,14 @@ def bucket(n: int, minimum: int = 8) -> int:
     return b
 
 
+def audit_pads(n_rows: int, n_constraints: int) -> tuple[int, int]:
+    """(r_pad, c_pad) device shape buckets for an audit matrix — the
+    single source of the padding formulas (build_bindings and the
+    driver's padded match-mask cache must agree, or every sweep would
+    silently re-pad and re-upload the mask)."""
+    return bucket(max(n_rows, 1)), bucket(max(n_constraints, 1), minimum=4)
+
+
 def binding_axes(name: str) -> tuple:
     """Logical axes of one bound array, by the prep naming convention:
     'c' (constraints), 'r' (resources), or None (replicated/table) per
@@ -337,7 +345,16 @@ def _rel_has(e: Any, rel: tuple[str, ...]) -> bool:
 @dataclasses.dataclass
 class Bindings:
     """name -> np.ndarray, plus shape info.  Split into device-bound
-    arrays (``arrays``) and host-only metadata."""
+    arrays (``arrays``) and host-only metadata.
+
+    Delta lineage (steady-state churn, SURVEY §7.5 / inmem txn.go
+    precedent): ``base`` points at the Bindings this one was derived
+    from by ``update_bindings`` and ``base_dirty`` maps each changed
+    r-axis array name to the dirty row indices — the device executor
+    uses it to scatter-update cached device arrays instead of
+    re-uploading whole columns.  ``delta_state`` carries the host-side
+    bookkeeping (evaluated table ids, ptable slot maps, element counts)
+    that makes the next incremental update possible."""
 
     arrays: dict[str, np.ndarray]
     n_constraints: int
@@ -345,6 +362,9 @@ class Bindings:
     c_pad: int
     r_pad: int
     e_pads: dict[str, int]
+    delta_state: dict = dataclasses.field(default_factory=dict)
+    base: "Bindings | None" = None
+    base_dirty: dict[str, np.ndarray] = dataclasses.field(default_factory=dict)
 
     def shapes_key(self) -> tuple:
         return tuple(sorted((k, v.shape, str(v.dtype)) for k, v in self.arrays.items()))
@@ -371,9 +391,12 @@ def build_bindings(spec: PrepSpec, table: ResourceTable,
     objs = table._objs
     n = len(objs)
     n_con = len(constraints)
-    r_pad = bucket(max(n, 1))
-    c_pad = bucket(max(n_con, 1), minimum=4)
+    r_pad, c_pad = audit_pads(n, n_con)
     out: dict[str, np.ndarray] = {}
+    # bookkeeping that makes the next update_bindings() possible
+    state: dict = {"gen": table.generation, "remap": table.remap_generation,
+                   "tables": {}, "ptables": {}, "csets": {},
+                   "elem_counts": {}, "interner_size": len(interner)}
 
     alive = np.zeros((r_pad,), dtype=bool)
     for i, m in enumerate(table._metas):
@@ -418,6 +441,7 @@ def build_bindings(spec: PrepSpec, table: ResourceTable,
         ecs = axis_cols.get(axis, [])
         rels = sorted({(ec.rel, ec.mode) for ec in ecs})
         counts, cols = build_elem_arrays(objs, base, rels, interner)
+        state["elem_counts"][axis] = counts
         e_max = int(counts.max()) if n else 0
         e_pad = bucket(max(e_max, 1), minimum=2)
         e_pads[axis] = e_pad
@@ -535,6 +559,7 @@ def build_bindings(spec: PrepSpec, table: ResourceTable,
                 vals[uid] = bool(v) if isinstance(v, bool) else True
         out[tr.name + ".ok"] = ok
         out[tr.name + ".v"] = vals
+        state["tables"][tr.name] = set(uniq.tolist())
 
     # ---- parametric tables, pre-combined per constraint
     #
@@ -584,6 +609,9 @@ def build_bindings(spec: PrepSpec, table: ResourceTable,
         out[pt.name + ".vmap"] = vmap
         out[pt.name + ".any"] = t_any
         out[pt.name + ".all"] = t_all
+        state["ptables"][pt.name] = {
+            "u_of": {int(g): u for u, g in enumerate(uniq.tolist())},
+            "distinct": dict(distinct), "per_con": per_con, "tbl": tbl}
 
     # ---- per-constraint id sets
     #
@@ -596,6 +624,7 @@ def build_bindings(spec: PrepSpec, table: ResourceTable,
     #   (sentinel column U-1 = not in any constraint's set).
     memb_by_cset = {m.cset: m for m in spec.membs}
     ekeys_by_cset = {e.cset: e for e in spec.elem_keys}
+    cset_state = state["csets"]
     for cs in spec.csets:
         per_con = []
         for c in constraints:
@@ -615,6 +644,7 @@ def build_bindings(spec: PrepSpec, table: ResourceTable,
         ek = ekeys_by_cset.get(cs.name)
         needed = sorted({i for lst in per_con for i in lst})
         local = {gid: li for li, gid in enumerate(needed)}
+        cset_state[cs.name] = {"needed": needed, "local": local}
         if ek is not None:
             # elem-axis truthy-key membership + per-constraint indicator.
             # Element semantics mirror the oracle's coll[key] statement:
@@ -726,7 +756,302 @@ def build_bindings(spec: PrepSpec, table: ResourceTable,
     out["__cvalid__"] = cvalid
 
     return Bindings(arrays=out, n_constraints=n_con, n_resources=n,
-                    c_pad=c_pad, r_pad=r_pad, e_pads=e_pads)
+                    c_pad=c_pad, r_pad=r_pad, e_pads=e_pads,
+                    delta_state=state)
+
+
+def update_bindings(spec: PrepSpec, table: ResourceTable,
+                    constraints: list[dict],
+                    prev: Bindings) -> Bindings | None:
+    """Incrementally derive a new Bindings from `prev` by re-extracting
+    only the rows dirty since prev was built (prev.delta_state["gen"]).
+
+    Returns None when a full rebuild is required: row-id remap
+    (wipe/compact), shape-bucket growth (rows, element widths, interner
+    past its table bucket, new ptable value slots), or a dirty set too
+    large for the delta to pay off.  The caller must treat None as
+    "call build_bindings".
+
+    Copy-on-write: prev and its arrays are never mutated — changed
+    arrays get fresh identities and their dirty rows are recorded in
+    ``base_dirty`` so the device cache can scatter-update instead of
+    re-uploading (engine/veval.ProgramExecutor._arrays).  Constraint-set
+    changes are NOT handled here (caller keys on the constraint version
+    and rebuilds) — all per-constraint arrays are shared as-is."""
+    from gatekeeper_tpu.store.table import delta_worthwhile
+    st0 = prev.delta_state
+    if not st0 or st0.get("remap") != table.remap_generation:
+        return None
+    objs = table._objs
+    n = len(objs)
+    if audit_pads(n, 0)[0] != prev.r_pad:
+        return None
+    prev_gen = st0["gen"]
+    dirty = table.dirty_rows_since(prev_gen)
+    if not delta_worthwhile(len(dirty), n):
+        return None
+    interner = table.interner
+    r_pad, c_pad = prev.r_pad, prev.c_pad
+    out = dict(prev.arrays)
+    base_dirty: dict[str, np.ndarray] = {}
+    state: dict = {"gen": table.generation, "remap": table.remap_generation,
+                   "tables": {}, "ptables": {}, "csets": st0["csets"],
+                   "elem_counts": {}, "interner_size": 0}
+    if len(dirty) == 0:
+        st1 = dict(st0)
+        st1["gen"] = table.generation
+        return dataclasses.replace(prev, delta_state=st1, base=prev,
+                                   base_dirty={})
+    dirty_objs = [objs[int(i)] for i in dirty]
+
+    def cow(name: str) -> np.ndarray:
+        arr = out[name] = out[name].copy()
+        base_dirty[name] = dirty
+        return arr
+
+    alive = cow("__alive__")
+    alive[dirty] = [table._metas[int(i)] is not None for i in dirty]
+
+    # ---- per-resource scalar columns (table.column is itself delta-
+    # maintained, so the slice below costs O(dirty))
+    for rc in spec.r_cols:
+        if rc.path and rc.path[0] == "$meta":
+            cow(rc.name)[dirty] = _meta_ids(table, rc.path[1:])[dirty]
+        elif rc.mode in ("str", "val"):
+            col = table.column(ColSpec(rc.path, rc.mode))
+            cow(rc.name)[dirty] = col.ids[dirty]
+        elif rc.mode in ("num", "len"):
+            col = table.column(ColSpec(rc.path, rc.mode))
+            cow(rc.name + ".v")[dirty] = col.values[dirty].astype(np.float32)
+            cow(rc.name + ".p")[dirty] = col.present[dirty]
+        else:  # present / truthy
+            col = table.column(ColSpec(rc.path, rc.mode))
+            cow(rc.name)[dirty] = col.present[dirty]
+
+    # ---- element axes: re-extract dirty rows only
+    axis_cols: dict[str, list[EColReq]] = {}
+    for ec in spec.e_cols:
+        axis_cols.setdefault(ec.axis, []).append(ec)
+    for axis, base in spec.axes:
+        ecs = axis_cols.get(axis, [])
+        rels = sorted({(ec.rel, ec.mode) for ec in ecs})
+        counts_sub, cols_sub = build_elem_arrays(dirty_objs, base, rels,
+                                                 interner)
+        e_pad = prev.e_pads[axis]
+        if len(counts_sub) and int(counts_sub.max()) > e_pad:
+            return None                      # element bucket outgrown
+        old_counts = st0["elem_counts"][axis]
+        counts = np.zeros((n,), dtype=np.int32)
+        counts[: len(old_counts)] = old_counts
+        counts[dirty] = counts_sub
+        state["elem_counts"][axis] = counts
+        offs = np.zeros((len(dirty) + 1,), dtype=np.int64)
+        np.cumsum(counts_sub, out=offs[1:])
+        total = int(offs[-1])
+        idx_r = dirty[np.repeat(np.arange(len(dirty)), counts_sub)]
+        idx_e = np.arange(total, dtype=np.int64) - \
+            np.repeat(offs[:-1], counts_sub)
+        pres = cow(f"__elem__:{axis}")
+        pres[dirty] = False
+        pres[idx_r, idx_e] = True
+        for ec in ecs:
+            flat = cols_sub[(ec.rel, ec.mode)]
+            if ec.mode in ("str", "val"):
+                arr = cow(ec.name)
+                arr[dirty] = MISSING
+                if flat:
+                    arr[idx_r, idx_e] = np.asarray(flat, dtype=np.int32)
+            elif ec.mode in ("num", "len"):
+                fv = np.asarray(flat, dtype=np.float64) if flat else np.zeros((0,))
+                v = cow(ec.name + ".v")
+                p = cow(ec.name + ".p")
+                v[dirty] = 0.0
+                p[dirty] = False
+                if flat:
+                    v[idx_r, idx_e] = np.nan_to_num(fv).astype(np.float32)
+                    p[idx_r, idx_e] = ~np.isnan(fv)
+            else:
+                b = cow(ec.name)
+                b[dirty] = False
+                if flat:
+                    b[idx_r, idx_e] = np.asarray(flat, dtype=bool)
+
+    # ---- dynamic-key container lookups: refill dirty columns
+    for kl in spec.keyed_vals:
+        from gatekeeper_tpu.rego.values import canon_num
+        keys = []
+        for c in constraints:
+            k = _eval_host(kl.key_fn, c)
+            if isinstance(k, (int, float)) and not isinstance(k, bool):
+                k = canon_num(k)
+            elif not isinstance(k, (str, bool)):
+                k = None
+            keys.append(k)
+        needed = sorted({k for k in keys if k is not None}, key=repr)
+        local = {k: i for i, k in enumerate(needed)}
+        kv = cow(kl.name + ".kv")
+        kv[:, dirty] = MISSING
+        for di, o in zip(dirty, dirty_objs):
+            if o is None:
+                continue
+            d = get_path(o, kl.path)
+            if isinstance(d, dict):
+                for k in needed:
+                    if k in d:
+                        ekey = encode_value(d[k])
+                        if ekey is not None:
+                            kv[local[k], di] = interner.intern(ekey)
+            elif isinstance(d, list):
+                for k in needed:
+                    if isinstance(k, int) and not isinstance(k, bool) \
+                            and 0 <= k < len(d):
+                        ekey = encode_value(d[k])
+                        if ekey is not None:
+                            kv[local[k], di] = interner.intern(ekey)
+
+    # ---- unary tables: evaluate fn only for ids never seen before
+    for tr in spec.tables:
+        src = out[tr.src]                     # id column (str/val mode)
+        cand = np.unique(src[dirty].ravel())
+        cand = cand[cand >= 0]
+        evaluated = st0["tables"][tr.name]
+        new_ids = [int(u) for u in cand.tolist() if u not in evaluated]
+        t_pad = out[tr.name + ".ok"].shape[0]
+        if new_ids and max(new_ids) >= t_pad:
+            return None                      # interner outgrew the bucket
+        if new_ids:
+            ok = out[tr.name + ".ok"] = out[tr.name + ".ok"].copy()
+            vals = out[tr.name + ".v"] = out[tr.name + ".v"].copy()
+            for uid in new_ids:
+                key = interner.string(uid)
+                arg = decode_value(key) if tr.src_val else key
+                v = _eval_host(tr.fn, arg)
+                if v is None:
+                    continue
+                if tr.out == "num":
+                    if isinstance(v, (int, float)) and not isinstance(v, bool):
+                        ok[uid] = True
+                        vals[uid] = np.float32(v)
+                elif tr.out == "id_str":
+                    if isinstance(v, str):
+                        ok[uid] = True
+                        vals[uid] = interner.intern(v)
+                elif tr.out == "id_val":
+                    ekey = encode_value(v)
+                    if ekey is not None:
+                        ok[uid] = True
+                        vals[uid] = interner.intern(ekey)
+                else:
+                    ok[uid] = True
+                    vals[uid] = bool(v) if isinstance(v, bool) else True
+        state["tables"][tr.name] = evaluated | set(new_ids)
+
+    # ---- parametric tables: new distinct values get new dense slots
+    for pt in spec.ptables:
+        pst = st0["ptables"][pt.name]
+        src = out[pt.src]
+        cand = np.unique(src[dirty].ravel())
+        cand = cand[cand >= 0]
+        u_of = pst["u_of"]
+        new_ids = [int(g) for g in cand.tolist() if g not in u_of]
+        vmap_arr = out[pt.name + ".vmap"]
+        t_pad = vmap_arr.shape[0]
+        u_pad = out[pt.name + ".any"].shape[1]
+        if new_ids and (max(new_ids) >= t_pad
+                        or len(u_of) + len(new_ids) > u_pad - 1):
+            return None                      # value-slot bucket outgrown
+        if new_ids:
+            u_of = dict(u_of)
+            vmap_arr = out[pt.name + ".vmap"] = vmap_arr.copy()
+            tbl = pst["tbl"].copy()           # already [n_distinct, u_pad]
+            t_any = out[pt.name + ".any"] = out[pt.name + ".any"].copy()
+            t_all = out[pt.name + ".all"] = out[pt.name + ".all"].copy()
+            distinct = pst["distinct"]
+            for gid in new_ids:
+                u = len(u_of)
+                u_of[gid] = u
+                vmap_arr[gid] = u
+                key = interner.string(gid)
+                arg = decode_value(key) if pt.src_val else key
+                col = np.zeros((len(distinct),), dtype=bool)
+                for pstr, pi in distinct.items():
+                    v = _eval_host(pt.fn, arg, pstr)
+                    col[pi] = bool(v) if v is not None and v is not False else False
+                if tbl.shape[0]:
+                    tbl[:, u] = col
+                for ci, lst in enumerate(pst["per_con"]):
+                    if lst:
+                        t_any[ci, u] = col[lst].any()
+                        t_all[ci, u] = col[lst].all()
+                    else:
+                        t_all[ci, u] = True
+            pst = {"u_of": u_of, "distinct": pst["distinct"],
+                   "per_con": pst["per_con"], "tbl": tbl}
+        state["ptables"][pt.name] = pst
+
+    # ---- membership matrices / element-key membership: refill dirty
+    memb_by_cset = {m.cset: m for m in spec.membs}
+    ekeys_by_cset = {e.cset: e for e in spec.elem_keys}
+    axis_base = dict(spec.axes)
+    for cs in spec.csets:
+        cstate = st0["csets"][cs.name]
+        needed, local = cstate["needed"], cstate["local"]
+        m = memb_by_cset.get(cs.name)
+        ek = ekeys_by_cset.get(cs.name)
+        if m is not None:
+            memb = cow(m.name)
+            memb[:, dirty] = False
+            if needed:
+                sub = np.zeros((memb.shape[0], len(dirty)), dtype=bool)
+                _fill_membership(sub, dirty_objs, m.keys_path, needed, local,
+                                 interner)
+                memb[:, dirty] = sub
+        if ek is not None:
+            ekm = cow(ek.name)
+            ekm[:, dirty, :] = False
+            e_pad = prev.e_pads[ek.axis]
+            str_local: dict = {}
+            int_local: dict = {}
+            for gid in needed:
+                ks = interner.string(gid)
+                k = decode_value(ks) if ks.startswith("\x00") else ks
+                if isinstance(k, str):
+                    str_local[k] = local[gid]
+                elif isinstance(k, int) and not isinstance(k, bool):
+                    int_local[k] = local[gid]
+            base_path = axis_base[ek.axis]
+            for di, o in zip(dirty, dirty_objs):
+                if o is None:
+                    continue
+                for ei, elem in enumerate(_elem_rows(o, base_path)):
+                    if ei >= e_pad:
+                        continue
+                    if isinstance(elem, dict):
+                        for k, li in str_local.items():
+                            if k in elem and elem[k] is not False:
+                                ekm[li, di, ei] = True
+                    elif isinstance(elem, list):
+                        for k, li in int_local.items():
+                            if 0 <= k < len(elem) and elem[k] is not False:
+                                ekm[li, di, ei] = True
+
+    # validity: every table-indexed array must still cover the interner
+    # (late interning past the bucket would alias clamped device gathers)
+    if (spec.tables or spec.ptables or
+            any(cs.name not in memb_by_cset and cs.name not in ekeys_by_cset
+                for cs in spec.csets)):
+        sized = [out[tr.name + ".ok"].shape[0] for tr in spec.tables]
+        sized += [out[pt.name + ".vmap"].shape[0] for pt in spec.ptables]
+        sized += [out[cs.name + ".vmap"].shape[0] for cs in spec.csets
+                  if cs.name + ".vmap" in out]
+        if sized and len(interner) > min(sized):
+            return None
+    state["interner_size"] = len(interner)
+
+    return Bindings(arrays=out, n_constraints=prev.n_constraints,
+                    n_resources=n, c_pad=c_pad, r_pad=r_pad,
+                    e_pads=prev.e_pads, delta_state=state,
+                    base=prev, base_dirty=base_dirty)
 
 
 _META_FIELDS = {

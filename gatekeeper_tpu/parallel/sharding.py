@@ -94,13 +94,9 @@ def make_mesh(n_devices: int | None = None) -> Mesh:
     return Mesh(devices.reshape(c, n // c), axis_names=("c", "r"))
 
 
-def make_sharded_audit_fn(program: Program, names: tuple[str, ...],
-                          specs: dict[str, P], mesh: Mesh, k: int,
-                          r_pad: int):
-    """Jitted multi-chip audit step: args (in `names` order, sharded per
-    `specs`) -> (counts [C], rows [C, k], valid [C, k]), replicated over
-    r, sharded over c."""
-    r_shards = mesh.shape["r"]
+def _topk_local_step(program: Program, names: tuple[str, ...], k: int,
+                     r_pad: int, r_shards: int):
+    """Per-shard body of the sharded capped audit."""
     r_local = r_pad // r_shards
     k_local = min(k, r_local)     # lax.top_k needs k <= axis size
 
@@ -132,11 +128,65 @@ def make_sharded_audit_fn(program: Program, names: tuple[str, ...],
             rows = jnp.pad(rows, ((0, 0), (0, k - k_final)))
         return counts, rows, top_vals > 0
 
+    return local_step
+
+
+def make_sharded_audit_fn(program: Program, names: tuple[str, ...],
+                          specs: dict[str, P], mesh: Mesh, k: int,
+                          r_pad: int):
+    """Jitted multi-chip audit step: args (in `names` order, sharded per
+    `specs`) -> (counts [C], rows [C, k], valid [C, k]), replicated over
+    r, sharded over c."""
+    local_step = _topk_local_step(program, names, k, r_pad,
+                                  mesh.shape["r"])
     in_specs = tuple(specs[nm] for nm in names)
     out_specs = (P("c"), P("c", None), P("c", None))
     stepped = shard_map(local_step, mesh=mesh, in_specs=in_specs,
                         out_specs=out_specs, check_vma=False)
     return jax.jit(stepped)
+
+
+def make_sharded_topk_packed(program: Program, names: tuple[str, ...],
+                             specs: dict[str, P], mesh: Mesh, k: int,
+                             r_pad: int):
+    """Unjitted shard-mapped capped audit packing (counts, rows, valid)
+    into ONE [C, 1+2k] int32 array — the multi-chip twin of the
+    executor's single-device topk raw fn (one fetch round-trip per
+    kind through a tunneled accelerator)."""
+    local_step = _topk_local_step(program, names, k, r_pad,
+                                  mesh.shape["r"])
+
+    def packed_step(*args):
+        counts, rows, valid = local_step(*args)
+        return jnp.concatenate(
+            [counts[:, None], rows, valid.astype(jnp.int32)], axis=1)
+
+    in_specs = tuple(specs[nm] for nm in names)
+    stepped = shard_map(packed_step, mesh=mesh, in_specs=in_specs,
+                        out_specs=P("c", None), check_vma=False)
+
+    def raw(args: tuple):
+        return stepped(*args)
+    return raw
+
+
+def make_sharded_mask_fn(program: Program, names: tuple[str, ...],
+                         specs: dict[str, P], mesh: Mesh):
+    """Unjitted shard-mapped full violation mask [C, R] (sharded over
+    both mesh axes) — the multi-chip twin of the executor's mask-mode
+    raw fn (the capped path's under-fill fallback)."""
+    from gatekeeper_tpu.engine.veval import _eval_mask
+
+    def local_step(*args):
+        return _eval_mask(program, dict(zip(names, args)))
+
+    in_specs = tuple(specs[nm] for nm in names)
+    stepped = shard_map(local_step, mesh=mesh, in_specs=in_specs,
+                        out_specs=P("c", "r"), check_vma=False)
+
+    def raw(args: tuple):
+        return stepped(*args)
+    return raw
 
 
 def run_sharded_audit(program: Program, bindings: Bindings, mesh: Mesh,

@@ -229,3 +229,79 @@ def build_column(spec: ColSpec, objs: list, interner: Interner):
             offs[i + 1] = len(fout)
         return CSRColumn(values=np.asarray(fout, dtype=np.float64), offsets=offs)
     raise ValueError(f"unknown column mode {spec.mode!r}")
+
+
+# ---------------------------------------------------------------------------
+# delta maintenance
+#
+# Incremental column updates: re-extract only the rows touched since the
+# cached build and splice them into the cached arrays.  This is what lets
+# steady-state audit sweeps survive data churn without re-paying the full
+# O(n) extraction (the reference's inmem store likewise writes paths in
+# place inside a txn rather than rebuilding documents,
+# vendor opa/storage/inmem/txn.go).  Copy-on-write: the cached arrays are
+# never mutated — derived consumers (device-array caches) key on array
+# identity.
+
+
+def _grow(arr: np.ndarray, n: int, fill) -> np.ndarray:
+    """Copy of `arr` grown to length n (new tail = fill)."""
+    out = np.empty((n,) + arr.shape[1:], dtype=arr.dtype)
+    out[: len(arr)] = arr
+    if n > len(arr):
+        out[len(arr):] = fill
+    return out
+
+
+def _splice_csr(old: CSRColumn, n: int, dirty: np.ndarray,
+                sub: CSRColumn) -> CSRColumn:
+    """New CSR with the dirty rows' segments replaced by `sub`'s rows
+    (sub is a CSR over the dirty rows only, in `dirty` order).  One
+    vectorized gather over the combined value pool — O(total) numpy,
+    O(|dirty|) python."""
+    n_old = len(old.offsets) - 1
+    lengths = np.zeros((n,), dtype=np.int64)
+    lengths[:n_old] = np.diff(old.offsets.astype(np.int64))
+    sub_lens = np.diff(sub.offsets.astype(np.int64))
+    lengths[dirty] = sub_lens
+    offsets = np.zeros((n + 1,), dtype=np.int32)
+    np.cumsum(lengths, out=offsets[1:])
+    total = int(offsets[-1])
+    # per-row base index into the combined [old.values | sub.values] pool
+    base = np.zeros((n,), dtype=np.int64)
+    base[:n_old] = old.offsets[:-1]
+    base[dirty] = len(old.values) + sub.offsets[:-1]
+    src = np.repeat(base, lengths) + \
+        (np.arange(total, dtype=np.int64) - np.repeat(offsets[:-1].astype(np.int64), lengths))
+    values = np.concatenate([old.values, sub.values])[src] if total else \
+        old.values[:0]
+    values2 = None
+    if old.values2 is not None:
+        values2 = np.concatenate([old.values2, sub.values2])[src] if total \
+            else old.values2[:0]
+    return CSRColumn(values=values, offsets=offsets, values2=values2)
+
+
+def delta_column(spec: ColSpec, old, objs: list, dirty: np.ndarray,
+                 interner: Interner):
+    """Updated column: `old` built over a prefix of `objs`, `dirty` =
+    row indices changed since (including appended rows).  Runs the same
+    extractor (native when available) over just the dirty rows."""
+    n = len(objs)
+    sub = build_column(spec, [objs[int(i)] for i in dirty], interner)
+    if spec.mode in ("str", "val"):
+        ids = _grow(old.ids, n, MISSING)
+        ids[dirty] = sub.ids
+        return ScalarColumn(ids=ids)
+    if spec.mode in ("num", "len"):
+        vals = _grow(old.values, n, 0.0)
+        pres = _grow(old.present, n, False)
+        vals[dirty] = sub.values
+        pres[dirty] = sub.present
+        return NumColumn(values=vals, present=pres)
+    if spec.mode in ("present", "truthy"):
+        pres = _grow(old.present, n, False)
+        pres[dirty] = sub.present
+        return PresenceColumn(present=pres)
+    # CSR modes
+    return _splice_csr(old, n, dirty, sub)

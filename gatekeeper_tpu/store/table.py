@@ -16,8 +16,16 @@ from typing import Any
 
 import numpy as np
 
-from gatekeeper_tpu.store.columns import ColSpec, build_column
+from gatekeeper_tpu.store.columns import (ColSpec, build_column,
+                                          delta_column)
 from gatekeeper_tpu.store.interner import Interner, MISSING
+
+DELTA_MAX_FRAC = 0.125
+"""Above this dirty fraction a full rebuild beats the delta path."""
+
+
+def delta_worthwhile(n_dirty: int, n: int) -> bool:
+    return n_dirty <= max(64, int(n * DELTA_MAX_FRAC))
 
 
 @dataclasses.dataclass(frozen=True)
@@ -44,9 +52,6 @@ class IdentityColumns:
     name_ids: np.ndarray
     ns_ids: np.ndarray         # MISSING for cluster-scoped
     alive: np.ndarray          # bool [n]
-    label_keys: np.ndarray     # CSR over metadata.labels
-    label_vals: np.ndarray
-    label_offsets: np.ndarray
 
 
 class ResourceTable:
@@ -54,12 +59,25 @@ class ResourceTable:
         self.interner = interner or Interner()
         self._objs: list[Any] = []
         self._metas: list[ResourceMeta | None] = []
-        self._versions: list[int] = []       # generation at last modify
+        # generation at last modify, per row (numpy so dirty-row scans
+        # vectorize); _ver has capacity >= n_rows, amortized doubling
+        self._ver = np.zeros((16,), dtype=np.int64)
         self._rows: dict[str, int] = {}      # path key -> row
         self._free: list[int] = []
         self.generation = 0
-        self._col_cache: dict[ColSpec, tuple[int, Any]] = {}
-        self._identity_cache: tuple[int, IdentityColumns] | None = None
+        # bumped when row ids are remapped (wipe/compact): per-row delta
+        # updates keyed on an older remap are invalid, not just stale
+        self.remap_generation = 0
+        # bumped only when the key set changes (insert of a new key,
+        # remove, wipe, compact) — pure updates keep sorted-key order
+        # caches (audit row order/rank) valid
+        self.key_generation = 0
+        self._ns_rows: set[int] = set()      # rows holding v1/Namespace
+        self.ns_generation = 0               # last change to any ns row
+        self._ns_touched = False
+        self._col_cache: dict[ColSpec, tuple[int, int, Any]] = {}
+        self._identity_cache: tuple[int, int, IdentityColumns] | None = None
+        self._ns_items_cache: tuple[int, dict] | None = None
 
     # ------------------------------------------------------------------
 
@@ -70,7 +88,14 @@ class ResourceTable:
     def n_rows(self) -> int:
         return len(self._objs)
 
-    def upsert(self, key: str, obj: dict, meta: ResourceMeta) -> int:
+    def _ensure_ver(self, n: int) -> None:
+        if len(self._ver) < n:
+            cap = max(len(self._ver) * 2, n)
+            grown = np.zeros((cap,), dtype=np.int64)
+            grown[: len(self._ver)] = self._ver
+            self._ver = grown
+
+    def _place(self, key: str, obj: dict, meta: ResourceMeta) -> int:
         row = self._rows.get(key)
         if row is None:
             if self._free:
@@ -81,37 +106,38 @@ class ResourceTable:
                 row = len(self._objs)
                 self._objs.append(obj)
                 self._metas.append(meta)
-                self._versions.append(0)
+                self._ensure_ver(row + 1)
             self._rows[key] = row
+            self.key_generation += 1
         else:
             self._objs[row] = obj
             self._metas[row] = meta
+        if meta.kind == "Namespace" and meta.api_version == "v1":
+            self._ns_rows.add(row)
+            self._ns_touched = True
+        elif row in self._ns_rows:
+            self._ns_rows.discard(row)
+            self._ns_touched = True
+        return row
+
+    def upsert(self, key: str, obj: dict, meta: ResourceMeta) -> int:
+        row = self._place(key, obj, meta)
         self.generation += 1
-        self._versions[row] = self.generation
+        self._ver[row] = self.generation
+        if self._ns_touched:
+            self.ns_generation = self.generation
+            self._ns_touched = False
         return row
 
     def bulk_upsert(self, entries: list[tuple[str, dict, ResourceMeta]]) -> None:
         dirty: list[int] = []
         for key, obj, meta in entries:
-            row = self._rows.get(key)
-            if row is None:
-                if self._free:
-                    row = self._free.pop()
-                    self._objs[row] = obj
-                    self._metas[row] = meta
-                else:
-                    row = len(self._objs)
-                    self._objs.append(obj)
-                    self._metas.append(meta)
-                    self._versions.append(0)
-                self._rows[key] = row
-            else:
-                self._objs[row] = obj
-                self._metas[row] = meta
-            dirty.append(row)
+            dirty.append(self._place(key, obj, meta))
         self.generation += 1
-        for row in dirty:
-            self._versions[row] = self.generation
+        self._ver[dirty] = self.generation
+        if self._ns_touched:
+            self.ns_generation = self.generation
+            self._ns_touched = False
 
     def remove(self, key: str) -> bool:
         row = self._rows.pop(key, None)
@@ -120,8 +146,12 @@ class ResourceTable:
         self._objs[row] = None
         self._metas[row] = None
         self._free.append(row)
+        if row in self._ns_rows:
+            self._ns_rows.discard(row)
+            self.ns_generation = self.generation + 1
         self.generation += 1
-        self._versions[row] = self.generation
+        self.key_generation += 1
+        self._ver[row] = self.generation
         if len(self._free) > 64 and len(self._free) > len(self._rows):
             self.compact()
         return True
@@ -129,12 +159,17 @@ class ResourceTable:
     def wipe(self) -> None:
         self._objs.clear()
         self._metas.clear()
-        self._versions.clear()
+        self._ver = np.zeros((16,), dtype=np.int64)
         self._rows.clear()
         self._free.clear()
+        self._ns_rows.clear()
         self._col_cache.clear()
         self._identity_cache = None
+        self._ns_items_cache = None
         self.generation += 1
+        self.remap_generation += 1
+        self.key_generation += 1
+        self.ns_generation = self.generation
 
     def compact(self) -> None:
         """Drop tombstoned rows; row ids are reassigned."""
@@ -146,9 +181,23 @@ class ResourceTable:
         self._objs, self._metas, self._rows = new_objs, new_metas, new_rows
         self._free = []
         self.generation += 1
+        self.remap_generation += 1
+        self.key_generation += 1
+        self.ns_generation = self.generation
+        self._ns_rows = {row for row, m in enumerate(new_metas)
+                         if m is not None and m.kind == "Namespace"
+                         and m.api_version == "v1"}
         # row ids were reassigned: stamp everything with the new
         # generation so (row, version) pairs can't alias across compaction
-        self._versions = [self.generation] * len(new_objs)
+        self._ver = np.full((max(len(new_objs), 16),), self.generation,
+                            dtype=np.int64)
+
+    def dirty_rows_since(self, gen: int) -> np.ndarray:
+        """Row indices modified (upserted/tombstoned) after generation
+        `gen` — the delta set for every incremental consumer.  Only valid
+        while remap_generation is unchanged (row ids stable)."""
+        n = len(self._objs)
+        return np.nonzero(self._ver[:n] > gen)[0]
 
     # ------------------------------------------------------------------
 
@@ -161,7 +210,7 @@ class ResourceTable:
     def version_at(self, row: int) -> int:
         """Generation at the row's last modify — cache-invalidation key
         for per-row derived results (e.g. formatted violations)."""
-        return self._versions[row]
+        return int(self._ver[row])
 
     def rows_items(self):
         """(key, row) pairs for live rows."""
@@ -177,50 +226,91 @@ class ResourceTable:
     def column(self, spec: ColSpec):
         hit = self._col_cache.get(spec)
         if hit is not None and hit[0] == self.generation:
-            return hit[1]
+            return hit[2]
+        if hit is not None and hit[1] == self.remap_generation:
+            dirty = self.dirty_rows_since(hit[0])
+            if delta_worthwhile(len(dirty), len(self._objs)):
+                col = delta_column(spec, hit[2], self._objs, dirty,
+                                   self.interner)
+                self._col_cache[spec] = (self.generation,
+                                         self.remap_generation, col)
+                return col
         col = build_column(spec, self._objs, self.interner)
-        self._col_cache[spec] = (self.generation, col)
+        self._col_cache[spec] = (self.generation, self.remap_generation, col)
         return col
 
     def identity(self) -> IdentityColumns:
-        if self._identity_cache is not None and \
-                self._identity_cache[0] == self.generation:
-            return self._identity_cache[1]
+        hit = self._identity_cache
+        if hit is not None and hit[0] == self.generation:
+            return hit[2]
         n = len(self._objs)
         it = self.interner
-        gi = np.full((n,), MISSING, dtype=np.int32)
-        vi = np.full((n,), MISSING, dtype=np.int32)
-        ki = np.full((n,), MISSING, dtype=np.int32)
-        ni = np.full((n,), MISSING, dtype=np.int32)
-        si = np.full((n,), MISSING, dtype=np.int32)
-        alive = np.zeros((n,), dtype=bool)
-        for i, m in enumerate(self._metas):
+        dirty = None
+        if hit is not None and hit[1] == self.remap_generation:
+            d = self.dirty_rows_since(hit[0])
+            if delta_worthwhile(len(d), n):
+                dirty = d
+        if dirty is not None:
+            old = hit[2]
+            from gatekeeper_tpu.store.columns import _grow
+            gi = _grow(old.group_ids, n, MISSING)
+            vi = _grow(old.version_ids, n, MISSING)
+            ki = _grow(old.kind_ids, n, MISSING)
+            ni = _grow(old.name_ids, n, MISSING)
+            si = _grow(old.ns_ids, n, MISSING)
+            alive = _grow(old.alive, n, False)
+            rows = dirty.tolist()
+        else:
+            gi = np.full((n,), MISSING, dtype=np.int32)
+            vi = np.full((n,), MISSING, dtype=np.int32)
+            ki = np.full((n,), MISSING, dtype=np.int32)
+            ni = np.full((n,), MISSING, dtype=np.int32)
+            si = np.full((n,), MISSING, dtype=np.int32)
+            alive = np.zeros((n,), dtype=bool)
+            rows = range(n)
+        for i in rows:
+            m = self._metas[i]
             if m is None:
+                gi[i] = vi[i] = ki[i] = ni[i] = si[i] = MISSING
+                alive[i] = False
                 continue
             alive[i] = True
             gi[i] = it.intern(m.group)
             vi[i] = it.intern(m.version)
             ki[i] = it.intern(m.kind)
             ni[i] = it.intern(m.name)
-            if m.namespace is not None:
-                si[i] = it.intern(m.namespace)
-        labels = self.column(ColSpec(("metadata", "labels"), "items"))
+            si[i] = it.intern(m.namespace) if m.namespace is not None \
+                else MISSING
         ident = IdentityColumns(
             group_ids=gi, version_ids=vi, kind_ids=ki, name_ids=ni, ns_ids=si,
-            alive=alive, label_keys=labels.values,
-            label_vals=labels.values2 if labels.values2 is not None else labels.values,
-            label_offsets=labels.offsets)
-        self._identity_cache = (self.generation, ident)
+            alive=alive)
+        self._identity_cache = (self.generation, self.remap_generation, ident)
         return ident
+
+    def labels_csr(self):
+        """The full metadata.labels CSR (keys, values, offsets) —
+        delta-maintained like any column, but deliberately NOT part of
+        identity(): subset consumers (the churn-delta match path) build
+        their own slice from the dirty objects instead of forcing a
+        full-CSR splice every generation."""
+        col = self.column(ColSpec(("metadata", "labels"), "items"))
+        vals2 = col.values2 if col.values2 is not None else col.values
+        return col.values, vals2, col.offsets
 
     def namespace_label_items(self) -> dict[int, list[tuple[int, int]]]:
         """ns name id -> [(label key id, label value id)] for every cached
         v1/Namespace resource — feeds namespaceSelector matching
-        (target.go:236-255) and the autoreject uncached-namespace check."""
+        (target.go:236-255) and the autoreject uncached-namespace check.
+        O(#namespaces) per generation (the Namespace row set is tracked
+        at ingest), cached across unchanged generations."""
+        if self._ns_items_cache is not None and \
+                self._ns_items_cache[0] == self.generation:
+            return self._ns_items_cache[1]
         out: dict[int, list[tuple[int, int]]] = {}
         it = self.interner
-        for i, m in enumerate(self._metas):
-            if m is None or m.kind != "Namespace" or m.api_version != "v1":
+        for i in self._ns_rows:
+            m = self._metas[i]
+            if m is None:
                 continue
             obj = self._objs[i]
             labels = obj.get("metadata", {}).get("labels", {}) if isinstance(obj, dict) else {}
@@ -231,4 +321,12 @@ class ResourceTable:
                     if isinstance(k, str):
                         items.append((it.intern(k), it.intern(v) if isinstance(v, str) else MISSING))
             out[it.intern(m.name)] = items
+        self._ns_items_cache = (self.generation, out)
         return out
+
+    def namespaces_dirty_since(self, gen: int) -> bool:
+        """True if any v1/Namespace row changed (upsert OR remove) after
+        `gen` — namespace label edits change namespaceSelector matching
+        of OTHER rows in that namespace, so per-row delta updates of the
+        match mask are only sound when this is False."""
+        return self.ns_generation > gen

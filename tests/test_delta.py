@@ -1,0 +1,253 @@
+"""Delta-maintained bindings: ``ir/prep.update_bindings`` must be
+indistinguishable from a full ``build_bindings`` rebuild under churn
+(the oracle-twin rule) — exercised across every lowerable library
+template so all binding kinds (r/e cols, tables, ptables, csets, memb,
+ekm, keyed_vals) take the incremental path.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from gatekeeper_tpu.api.templates import compile_target_rego
+from gatekeeper_tpu.engine.veval import ProgramExecutor
+from gatekeeper_tpu.ir.lower import CannotLower, lower_template
+from gatekeeper_tpu.ir.prep import build_bindings, update_bindings
+from gatekeeper_tpu.library.templates import LIBRARY, all_docs
+from gatekeeper_tpu.library.workload import make_mixed
+from gatekeeper_tpu.store.table import ResourceMeta, ResourceTable
+
+
+def _fill(table, objs, start=0):
+    for i, o in enumerate(objs, start=start):
+        meta = ResourceMeta(
+            api_version=o.get("apiVersion", "v1"),
+            kind=o.get("kind", "Pod"),
+            name=o.get("metadata", {}).get("name", f"r{i}"),
+            namespace=o.get("metadata", {}).get("namespace"))
+        table.upsert(f"k{i:05d}", o, meta)
+
+
+def _lowered_library():
+    out = []
+    for kind, (rego, _params) in sorted(LIBRARY.items()):
+        ct = compile_target_rego(kind, "admission.k8s.gatekeeper.sh", rego)
+        try:
+            out.append((kind, lower_template(ct.module, ct.interp)))
+        except CannotLower:
+            pass
+    return out
+
+
+def _constraints_for(kind):
+    return [c for t, c in all_docs() if t["spec"]["crd"]["spec"]["names"]["kind"] == kind]
+
+
+class TestUpdateBindingsParity:
+
+    def _compare(self, prog, spec, b_delta, b_fresh, kind):
+        # exact equality for everything whose layout is deterministic;
+        # ptables may permute dense value slots between delta and fresh
+        pt_names = {p.name for p in spec.ptables}
+        for nm, fresh in b_fresh.arrays.items():
+            base = nm.split(".")[0]
+            if base in pt_names:
+                continue
+            got = b_delta.arrays[nm]
+            assert got.shape == fresh.shape, (kind, nm)
+            np.testing.assert_array_equal(got, fresh, err_msg=f"{kind} {nm}")
+        # ptables (and everything else) compared via the real contract:
+        # identical violation masks
+        ex = ProgramExecutor()
+        m1 = ex.run(prog, b_delta)
+        m2 = ex.run(prog, b_fresh)
+        np.testing.assert_array_equal(m1, m2, err_msg=f"{kind} mask")
+
+    def test_library_churn_parity(self):
+        rng = random.Random(11)
+        table = ResourceTable()
+        objs = make_mixed(rng, 150)
+        _fill(table, objs)
+        lowered = _lowered_library()
+        assert len(lowered) >= 25
+        cases = []
+        for kind, lp in lowered:
+            cons = _constraints_for(kind)
+            assert cons, kind
+            cases.append((kind, lp, cons,
+                          build_bindings(lp.spec, table, cons)))
+        for round_ in range(3):
+            # churn: updates (some new strings/images), adds, deletes
+            upd = make_mixed(rng, 10)
+            for i, o in zip(rng.sample(range(150), 10), upd):
+                if round_ == 2:
+                    # inject brand-new strings to force table/ptable
+                    # delta slots
+                    o.setdefault("metadata", {}).setdefault(
+                        "labels", {})[f"fresh{round_}{i}"] = f"val{i}"
+                _fill(table, [o], start=i)
+            _fill(table, make_mixed(rng, 2), start=1000 + round_ * 2)
+            table.remove(f"k{rng.randrange(150):05d}")
+            nxt = []
+            for kind, lp, cons, prev in cases:
+                b = update_bindings(lp.spec, table, cons, prev)
+                if b is None:
+                    # legal (bucket outgrown); rebuild and continue
+                    b = build_bindings(lp.spec, table, cons)
+                else:
+                    fresh = build_bindings(lp.spec, table, cons)
+                    self._compare(lp.program, lp.spec, b, fresh, kind)
+                nxt.append((kind, lp, cons, b))
+            cases = nxt
+
+    def test_update_declines_on_remap(self):
+        table = ResourceTable()
+        _fill(table, make_mixed(random.Random(1), 80))
+        kind, lp = _lowered_library()[0]
+        cons = _constraints_for(kind)
+        prev = build_bindings(lp.spec, table, cons)
+        table.wipe()
+        _fill(table, make_mixed(random.Random(2), 10))
+        assert update_bindings(lp.spec, table, cons, prev) is None
+
+    def test_update_declines_on_growth_past_bucket(self):
+        table = ResourceTable()
+        _fill(table, make_mixed(random.Random(3), 60))
+        kind, lp = _lowered_library()[0]
+        cons = _constraints_for(kind)
+        prev = build_bindings(lp.spec, table, cons)
+        # grow past the r_pad bucket
+        _fill(table, make_mixed(random.Random(4), prev.r_pad), start=500)
+        assert update_bindings(lp.spec, table, cons, prev) is None
+
+    def test_base_dirty_covers_changes(self):
+        """Every array that differs from the base must either carry a
+        base_dirty entry covering the differing rows (r-axis delta) or
+        be a fresh full array — the device-cache contract."""
+        from gatekeeper_tpu.ir.prep import binding_axes
+        rng = random.Random(5)
+        table = ResourceTable()
+        _fill(table, make_mixed(rng, 100))
+        for kind, lp in _lowered_library()[:8]:
+            cons = _constraints_for(kind)
+            prev = build_bindings(lp.spec, table, cons)
+            upd = make_mixed(rng, 5)
+            rows = rng.sample(range(100), 5)
+            for i, o in zip(rows, upd):
+                _fill(table, [o], start=i)
+            b = update_bindings(lp.spec, table, cons, prev)
+            assert b is not None
+            assert b.base is prev
+            for nm, arr in b.arrays.items():
+                old = prev.arrays[nm]
+                if arr is old:
+                    np.testing.assert_array_equal(arr, old)
+                    continue
+                if nm in b.base_dirty:
+                    axes = binding_axes(nm)
+                    ax = axes.index("r")
+                    mask = np.ones(arr.shape[ax], dtype=bool)
+                    mask[b.base_dirty[nm]] = False
+                    sl = [slice(None)] * arr.ndim
+                    sl[ax] = mask
+                    np.testing.assert_array_equal(
+                        arr[tuple(sl)], old[tuple(sl)],
+                        err_msg=f"{kind} {nm}: differs outside base_dirty")
+
+
+class TestDriverChurnParity:
+    """End-to-end: the JaxDriver's delta-maintained audit (bindings +
+    match mask + device scatter) must match the scalar LocalDriver
+    exactly across churn rounds — including mixed update/add/delete
+    churn and Namespace-object churn (which forces full mask rebuilds)."""
+
+    def _clients(self):
+        from gatekeeper_tpu.client.client import Backend
+        from gatekeeper_tpu.client.local_driver import LocalDriver
+        from gatekeeper_tpu.engine.jax_driver import JaxDriver
+        from gatekeeper_tpu.target.k8s import K8sValidationTarget
+        return (Backend(LocalDriver()).new_client([K8sValidationTarget()]),
+                Backend(JaxDriver()).new_client([K8sValidationTarget()]))
+
+    @staticmethod
+    def _results(client, limit=None):
+        resp = client.audit(limit_per_constraint=limit)
+        return sorted(
+            (r.msg, r.constraint["metadata"]["name"],
+             (r.review or {}).get("name") if isinstance(r.review, dict) else None)
+            for r in resp.results())
+
+    @staticmethod
+    def _assert_capped_prefix(local, jx, limit):
+        """The capped device subset must be, per constraint, a prefix of
+        the scalar driver's full sorted-cache-key order (the LocalDriver
+        ignores the cap by design — the audit manager truncates)."""
+        from gatekeeper_tpu.client.interface import QueryOpts
+        key = lambda r: (r.msg, r.constraint["metadata"]["name"],
+                         (r.review or {}).get("name")
+                         if isinstance(r.review, dict) else None)
+        lraw = local.driver.query_audit("admission.k8s.gatekeeper.sh")[0]
+        jcap = jx.driver.query_audit(
+            "admission.k8s.gatekeeper.sh",
+            QueryOpts(limit_per_constraint=limit))[0]
+        by_full: dict = {}
+        for r in lraw:
+            by_full.setdefault(key(r)[1], []).append(key(r))
+        by_cap: dict = {}
+        for r in jcap:
+            by_cap.setdefault(key(r)[1], []).append(key(r))
+        for name, rs in by_cap.items():
+            assert rs == by_full[name][: len(rs)], name
+
+    def test_library_driver_churn_parity(self):
+        rng = random.Random(23)
+        local, jx = self._clients()
+        docs = all_docs()
+        for t, c in docs:
+            for cl in (local, jx):
+                cl.add_template(t)
+                cl.add_constraint(c)
+        objs = make_mixed(rng, 120)
+        # namespaces so namespaceSelector/ns matching has cached targets
+        for ns in ("default", "prod", "dev"):
+            objs.append({"apiVersion": "v1", "kind": "Namespace",
+                         "metadata": {"name": ns,
+                                      "labels": {"env": ns}}})
+        for o in objs:
+            local.add_data(o)
+            jx.add_data(o)
+        assert self._results(local) == self._results(jx)
+        self._assert_capped_prefix(local, jx, 3)
+        deltas0 = jx.driver.metrics.counter("bindings_delta_updates").value
+        for round_ in range(4):
+            upd = make_mixed(rng, 8)
+            for o in upd:
+                # updates reuse existing names -> same cache path keys
+                o["metadata"]["name"] = f"pod{rng.randrange(120)}"
+                o["kind"] = "Pod"
+                o["apiVersion"] = "v1"
+                local.add_data(o)
+                jx.add_data(o)
+            if round_ == 1:
+                # add + delete: key-set churn (order caches rebuild) AND
+                # tombstones through the driver delta (mask/alive/scatter
+                # over dead rows)
+                new = make_mixed(rng, 3)
+                for o in new:
+                    local.add_data(o)
+                    jx.add_data(o)
+                for o in objs[:2]:
+                    local.remove_data(o)
+                    jx.remove_data(o)
+            if round_ == 2:
+                # Namespace churn: delta mask must be bypassed
+                ns = {"apiVersion": "v1", "kind": "Namespace",
+                      "metadata": {"name": "prod",
+                                   "labels": {"env": "changed"}}}
+                local.add_data(ns)
+                jx.add_data(ns)
+            assert self._results(local) == self._results(jx), f"round {round_}"
+            self._assert_capped_prefix(local, jx, 3)
+        deltas = jx.driver.metrics.counter("bindings_delta_updates").value
+        assert deltas > deltas0, "delta path never engaged"

@@ -153,3 +153,123 @@ class TestResourceTable:
         col = t.column(ColSpec(("spec", "replicas"), "num"))
         assert col.values[0] == 3.0 and col.present[0]
         assert not col.present[1]
+
+
+class TestDeltaColumns:
+    """Delta-maintained columns must be indistinguishable from fresh
+    builds under arbitrary churn (the oracle-twin rule: every fast path
+    has a contract twin)."""
+
+    MODES = ["str", "val", "num", "len", "present", "truthy",
+             "keys", "items", "strs", "nums"]
+
+    @staticmethod
+    def _rand_obj(rng):
+        labels = {f"k{rng.integers(4)}": f"v{rng.integers(3)}"
+                  for _ in range(rng.integers(0, 4))}
+        obj = {"metadata": {"labels": labels},
+               "spec": {"images": [f"img{rng.integers(5)}"
+                                   for _ in range(rng.integers(0, 3))]}}
+        if rng.random() < 0.5:
+            obj["spec"]["replicas"] = int(rng.integers(10))
+        if rng.random() < 0.3:
+            obj["spec"]["name"] = f"n{rng.integers(6)}"
+        if rng.random() < 0.2:
+            obj["spec"]["flag"] = bool(rng.random() < 0.5)
+        return obj
+
+    def _specs(self):
+        return [ColSpec(("spec", "name"), "str"),
+                ColSpec(("spec", "replicas"), "num"),
+                ColSpec(("spec", "flag"), "val"),
+                ColSpec(("spec", "images"), "len"),
+                ColSpec(("spec", "name"), "present"),
+                ColSpec(("spec", "flag"), "truthy"),
+                ColSpec(("metadata", "labels"), "keys"),
+                ColSpec(("metadata", "labels"), "items"),
+                ColSpec(("spec", "images", "*"), "strs")]
+
+    def _assert_equal(self, t):
+        """Every cached (possibly delta-updated) column equals a column
+        built fresh over the same objects with the same interner."""
+        from gatekeeper_tpu.store.columns import build_column
+        for spec in self._specs():
+            got = t.column(spec)
+            want = build_column(spec, t._objs, t.interner)
+            for attr in ("ids", "values", "present", "offsets", "values2"):
+                g, w = getattr(got, attr, None), getattr(want, attr, None)
+                assert (g is None) == (w is None), (spec, attr)
+                if g is not None:
+                    np.testing.assert_array_equal(g, w, err_msg=f"{spec} {attr}")
+        gi = t.identity()
+        lk, lv, lo = t.labels_csr()
+        t._identity_cache = None
+        t._col_cache.pop(ColSpec(("metadata", "labels"), "items"), None)
+        fresh = t.identity()
+        fk, fv, fo = t.labels_csr()
+        for attr in ("group_ids", "kind_ids", "name_ids", "ns_ids", "alive"):
+            np.testing.assert_array_equal(getattr(gi, attr),
+                                          getattr(fresh, attr),
+                                          err_msg=attr)
+        np.testing.assert_array_equal(lk, fk)
+        np.testing.assert_array_equal(lv, fv)
+        np.testing.assert_array_equal(lo, fo)
+
+    def test_churn_parity(self):
+        rng = np.random.default_rng(7)
+        t = ResourceTable()
+        meta = lambda i: ResourceMeta("v1", "Pod", f"p{i}", "ns1")
+        for i in range(200):
+            t.upsert(f"k{i}", self._rand_obj(rng), meta(i))
+        self._assert_equal(t)
+        for round_ in range(6):
+            # mixed churn: updates, adds, deletes
+            for i in rng.integers(0, 200, size=9):
+                t.upsert(f"k{i}", self._rand_obj(rng), meta(i))
+            t.upsert(f"new{round_}", self._rand_obj(rng), meta(1000 + round_))
+            t.remove(f"k{int(rng.integers(0, 200))}")
+            self._assert_equal(t)
+
+    def test_delta_actually_taken(self):
+        """Guard against the delta path silently never engaging."""
+        t = ResourceTable()
+        meta = lambda i: ResourceMeta("v1", "Pod", f"p{i}", "ns1")
+        rng = np.random.default_rng(3)
+        for i in range(1000):
+            t.upsert(f"k{i}", self._rand_obj(rng), meta(i))
+        spec = ColSpec(("spec", "name"), "str")
+        t.column(spec)
+        t.upsert("k5", self._rand_obj(rng), meta(5))
+        import gatekeeper_tpu.store.columns as C
+        calls = []
+        orig = C.build_column
+        try:
+            C.build_column = lambda s, objs, it: calls.append(len(objs)) or orig(s, objs, it)
+            t.column(spec)
+        finally:
+            C.build_column = orig
+        assert calls == [1]    # re-extracted exactly the one dirty row
+
+    def test_key_generation_stable_under_updates(self):
+        t = ResourceTable()
+        m = ResourceMeta("v1", "Pod", "a", "ns1")
+        t.upsert("k1", {"x": 1}, m)
+        kg = t.key_generation
+        t.upsert("k1", {"x": 2}, m)       # update: same key set
+        assert t.key_generation == kg
+        t.upsert("k2", {"x": 1}, m)       # insert: key set changed
+        assert t.key_generation != kg
+
+    def test_ns_generation(self):
+        t = ResourceTable()
+        t.upsert("p", {"a": 1}, ResourceMeta("v1", "Pod", "p", "ns1"))
+        g0 = t.generation
+        assert not t.namespaces_dirty_since(g0)
+        t.upsert("ns", {"metadata": {"labels": {"e": "p"}}},
+                 ResourceMeta("v1", "Namespace", "ns1", None))
+        assert t.namespaces_dirty_since(g0)
+        g1 = t.generation
+        t.upsert("p", {"a": 2}, ResourceMeta("v1", "Pod", "p", "ns1"))
+        assert not t.namespaces_dirty_since(g1)
+        t.remove("ns")
+        assert t.namespaces_dirty_since(g1)
