@@ -37,7 +37,11 @@ class Client:
         self.templates: dict[str, dict[str, CompiledTemplate]] = {}
         self.crds: dict[str, dict] = {}
         self.constraints: dict[str, dict[str, dict]] = {}
-        self._lock = threading.RLock()
+        # readers-writer, mirroring the reference client RWMutex
+        # (client.go:545,584 — Review/Audit take RLock, mutations Lock):
+        # concurrent admission reviews never serialize on each other
+        from gatekeeper_tpu.client.local_driver import RWLock
+        self._lock = RWLock()
         driver.init(self.targets)
 
     # ------------------------------------------------------------------
@@ -60,7 +64,7 @@ class Client:
         return build_crd(tmpl, handler.match_schema())
 
     def add_template(self, template_doc: dict) -> Responses:
-        with self._lock:
+        with self._lock.write():
             tmpl = ConstraintTemplate.from_dict(template_doc)
             if not tmpl.targets:
                 raise ClientError("template has no targets")
@@ -79,7 +83,7 @@ class Client:
             return Responses(handled={tt.target: True})
 
     def remove_template(self, template_doc: dict) -> Responses:
-        with self._lock:
+        with self._lock.write():
             tmpl = ConstraintTemplate.from_dict(template_doc)
             handled = {}
             targets = self.templates.pop(tmpl.kind, {})
@@ -104,7 +108,7 @@ class Client:
                 handler.validate_constraint(constraint)
 
     def add_constraint(self, constraint: dict) -> Responses:
-        with self._lock:
+        with self._lock.write():
             self.validate_constraint(constraint)
             kind = constraint["kind"]
             name = constraint["metadata"]["name"]
@@ -116,7 +120,7 @@ class Client:
             return Responses(handled=handled)
 
     def remove_constraint(self, constraint: dict) -> Responses:
-        with self._lock:
+        with self._lock.write():
             kind = constraint.get("kind", "")
             name = (constraint.get("metadata") or {}).get("name", "")
             self.constraints.get(kind, {}).pop(name, None)
@@ -130,7 +134,7 @@ class Client:
     # data (client.go:152-209)
 
     def add_data(self, obj: Any) -> Responses:
-        with self._lock:
+        with self._lock.write():
             handled = {}
             for name, handler in self.targets.items():
                 if isinstance(obj, WipeData) or obj is WipeData:
@@ -146,7 +150,7 @@ class Client:
             return Responses(handled=handled)
 
     def remove_data(self, obj: Any) -> Responses:
-        with self._lock:
+        with self._lock.write():
             handled = {}
             for name, handler in self.targets.items():
                 if isinstance(obj, WipeData) or obj is WipeData:
@@ -165,9 +169,10 @@ class Client:
     # queries (client.go:545-612)
 
     def review(self, obj: Any, tracing: bool = False) -> Responses:
-        # queries share the writer lock: the reference guards Review/Audit
-        # with the client RWMutex (client.go:545,584)
-        with self._lock:
+        # queries take the READ side (client.go:545 RLock): concurrent
+        # admission reviews proceed in parallel, excluded only by
+        # mutations
+        with self._lock.read():
             return self._review_locked(obj, tracing)
 
     def _review_locked(self, obj: Any, tracing: bool) -> Responses:
@@ -188,10 +193,37 @@ class Client:
         return responses
 
     def review_batch(self, objs: list, tracing: bool = False) -> list[Responses]:
-        """Review a micro-batch under one lock acquisition / constraint
-        snapshot (the webhook batcher's engine pass)."""
-        with self._lock:
-            return [self._review_locked(obj, tracing) for obj in objs]
+        """Review a micro-batch under one read-lock acquisition /
+        constraint snapshot (the webhook batcher's engine pass).
+
+        When the driver exposes ``query_review_batch`` (the jax driver's
+        [B, C] device pass, SURVEY §7 step 7) the whole batch is
+        evaluated as one matrix per target; otherwise per-review scalar
+        queries run under the shared snapshot."""
+        with self._lock.read():
+            batched = getattr(self.driver, "query_review_batch", None)
+            if batched is None or tracing:
+                return [self._review_locked(obj, tracing) for obj in objs]
+            responses = [Responses() for _ in objs]
+            for name, handler in self.targets.items():
+                idx: list[int] = []
+                reviews: list = []
+                for i, obj in enumerate(objs):
+                    try:
+                        reviews.append(handler.handle_review(obj))
+                        idx.append(i)
+                    except UnhandledData:
+                        continue
+                if not reviews:
+                    continue
+                outs = batched(name, reviews, QueryOpts(tracing=False))
+                for i, (results, trace) in zip(idx, outs):
+                    for r in results:
+                        handler.handle_violation(r)
+                    responses[i].by_target[name] = Response(
+                        target=name, results=results, trace=trace)
+                    responses[i].handled[name] = True
+            return responses
 
     def audit(self, tracing: bool = False,
               limit_per_constraint: int | None = None) -> Responses:
@@ -199,7 +231,7 @@ class Client:
         audit manager's violations cap (reference manager.go:35) down to
         the driver, where the jax engine turns it into a device top-k
         instead of formatting everything and truncating on the host."""
-        with self._lock:
+        with self._lock.read():
             return self._audit_locked(tracing, limit_per_constraint)
 
     def _audit_locked(self, tracing: bool,
@@ -217,7 +249,7 @@ class Client:
         return responses
 
     def reset(self) -> None:
-        with self._lock:
+        with self._lock.write():
             for kind, targets in list(self.templates.items()):
                 for target in targets:
                     self.driver.delete_template(target, kind)

@@ -11,6 +11,7 @@ everywhere.
 
 from __future__ import annotations
 
+import contextlib
 import functools
 import threading
 from typing import Any, Iterator
@@ -79,16 +80,29 @@ class RWLock:
         self._readers = 0
         self._writer: int | None = None
         self._depth = 0
+        self._waiting_writers = 0
+        # per-thread read depth: re-entrant reads must not block behind
+        # a waiting writer (they would deadlock against it)
+        self._local = threading.local()
 
     def acquire_read(self):
         me = threading.get_ident()
+        held = getattr(self._local, "depth", 0)
         with self._cond:
             if self._writer == me:       # read within own write: nest
                 self._depth += 1
                 return
-            while self._writer is not None:
+            if held:                     # re-entrant read: already admitted
+                self._readers += 1
+                self._local.depth = held + 1
+                return
+            # writer preference (Go sync.RWMutex semantics): fresh
+            # readers queue behind pending writers so sustained read
+            # load cannot starve mutations indefinitely
+            while self._writer is not None or self._waiting_writers:
                 self._cond.wait()
             self._readers += 1
+            self._local.depth = 1
 
     def release_read(self):
         me = threading.get_ident()
@@ -97,6 +111,7 @@ class RWLock:
                 self._depth -= 1
                 return
             self._readers -= 1
+            self._local.depth = getattr(self._local, "depth", 1) - 1
             if self._readers == 0:
                 self._cond.notify_all()
 
@@ -106,8 +121,12 @@ class RWLock:
             if self._writer == me:
                 self._depth += 1
                 return
-            while self._writer is not None or self._readers:
-                self._cond.wait()
+            self._waiting_writers += 1
+            try:
+                while self._writer is not None or self._readers:
+                    self._cond.wait()
+            finally:
+                self._waiting_writers -= 1
             self._writer = me
             self._depth = 1
 
@@ -117,6 +136,24 @@ class RWLock:
             if self._depth == 0:
                 self._writer = None
                 self._cond.notify_all()
+
+    @contextlib.contextmanager
+    def read(self):
+        """Shared-lock context manager (queries run concurrently)."""
+        self.acquire_read()
+        try:
+            yield
+        finally:
+            self.release_read()
+
+    @contextlib.contextmanager
+    def write(self):
+        """Exclusive-lock context manager (mutations)."""
+        self.acquire_write()
+        try:
+            yield
+        finally:
+            self.release_write()
 
 
 def locked(fn):

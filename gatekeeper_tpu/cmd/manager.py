@@ -92,6 +92,9 @@ class Manager:
                                   interval=args.audit_interval,
                                   violations_limit=args.constraint_violations_limit,
                                   metrics=self.metrics)
+        self.watch_poll_interval = getattr(args, "watch_poll_interval", 5.0)
+        self._poll_stop = None
+        self._poll_thread = None
 
     def start(self) -> None:
         self.plane.mgr.start()
@@ -99,8 +102,26 @@ class Manager:
         if self.webhook is not None:
             self.webhook.start()
         self.audit.start()
+        # roster poll loop (reference updateManagerLoop, 5 s —
+        # watch/manager.go:165-178): a GVK whose CRD becomes served
+        # AFTER registration is picked up without any roster mutation
+        self._poll_stop = threading.Event()
+
+        def poll_loop():
+            while not self._poll_stop.wait(self.watch_poll_interval):
+                try:
+                    self.plane.watch_manager.poll_once()
+                except Exception as e:   # log-and-continue like the loop
+                    print(f"watch poll error: {e}", file=sys.stderr)
+        self._poll_thread = threading.Thread(
+            target=poll_loop, daemon=True, name="watch-roster-poll")
+        self._poll_thread.start()
 
     def stop(self) -> None:
+        if getattr(self, "_poll_stop", None) is not None:
+            self._poll_stop.set()
+            self._poll_thread.join(timeout=10)
+            self._poll_stop = None
         self.audit.stop()
         if self.webhook is not None:
             self.webhook.stop()
@@ -125,6 +146,9 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--engine-worker-url", default=None,
                    help="run evaluation in a separate engine worker "
                         "process at this URL (see cmd/worker)")
+    p.add_argument("--watch-poll-interval", type=float, default=5.0,
+                   help="watch roster poll period in seconds "
+                        "(watch/manager.go:172)")
     p.add_argument("--demo", action="store_true",
                    help="seed demo/basic (1k namespaces + required-labels) "
                         "and run one audit sweep")

@@ -63,6 +63,7 @@ class JaxTargetState(TargetState):
         super().__init__()
         self.con_version: dict[str, int] = {}      # kind -> bump on change
         self.bindings_cache: dict[str, tuple] = {}  # kind -> (gen, ver, b)
+        self.bindings_retired: dict[str, tuple] = {}  # kind -> (ver, old b)
         self.mask_cache: dict[str, tuple] = {}
         self.rank_cache: tuple | None = None       # (generation, rank arr)
         self.order_cache: tuple | None = None      # (gen, ordered_rows, row_order)
@@ -162,31 +163,64 @@ class JaxDriver(LocalDriver):
             padded = hit[2]
             return padded[:n_con, :n], None, padded
         if hit is not None and hit[1] == (conver, remap) \
-                and hit[2].shape == (c_pad, r_pad) \
-                and not table.namespaces_dirty_since(hit[0][0]):
-            dirty = table.dirty_rows_since(hit[0][0])
-            if delta_worthwhile(len(dirty), n):
-                padded = hit[2].copy()
-                if len(dirty):
-                    padded[:n_con, dirty] = engine.mask_rows(constraints,
-                                                             dirty)
-                st.mask_cache[kind] = ((gen, conver), (conver, remap), padded)
-                return padded[:n_con, :n], dirty, padded
+                and hit[2].shape == (c_pad, r_pad):
+            prev_gen = hit[0][0]
+            old = hit[3]            # retired (gen, padded) or None
+            # ping-pong: overwrite the retired buffer (two updates old)
+            # at the rows dirty since ITS generation — O(|dirty|) writes
+            # instead of an O(c_pad*r_pad) copy.  Requires no Namespace
+            # churn since the buffer's generation (namespaceSelector
+            # results of untouched rows would be stale in it).
+            if old is not None and old[1].shape == (c_pad, r_pad) \
+                    and old[1] is not hit[2] \
+                    and not table.namespaces_dirty_since(old[0]):
+                target, since = old[1], min(old[0], prev_gen)
+            elif not table.namespaces_dirty_since(prev_gen):
+                target, since = None, prev_gen     # copy-on-write path
+            else:
+                target = since = -1                # full rebuild
+            if since != -1:
+                rows = table.dirty_rows_since(since)
+                if delta_worthwhile(len(rows), n):
+                    sub, rows = engine.mask_rows_since(constraints, since) \
+                        if len(rows) else (None, rows)
+                    if target is None:
+                        target = hit[2].copy()
+                    if len(rows):
+                        # flat scatter: one 1-D fancy write beats the
+                        # 2-D cross-product indexing at [C, 10k] scale
+                        flat = (np.arange(n_con, dtype=np.int64)[:, None]
+                                * target.shape[1] + rows[None, :]).ravel()
+                        target.ravel()[flat] = sub.ravel()
+                    base_rows = rows if since == prev_gen else \
+                        table.dirty_rows_since(prev_gen)
+                    st.mask_cache[kind] = ((gen, conver), (conver, remap),
+                                           target, (prev_gen, hit[2]))
+                    return target[:n_con, :n], base_rows, target
         padded = np.zeros((c_pad, r_pad), dtype=bool)
         padded[:n_con, :n] = engine.mask(constraints)
-        st.mask_cache[kind] = ((gen, conver), (conver, remap), padded)
+        st.mask_cache[kind] = ((gen, conver), (conver, remap), padded, None)
         return padded[:n_con, :n], None, padded
 
     def _kind_bindings(self, st: JaxTargetState, kind: str,
                        compiled: CompiledTemplate, constraints: list[dict]):
+        """Per-kind bindings with incremental churn updates.  Retired
+        bindings (two updates old) are recycled as write buffers
+        (ping-pong): the driver hands out only the newest bindings per
+        kind and device arrays are immutable snapshots, so overwriting
+        the retired generation's numpy buffers is safe — and it turns
+        per-sweep full-array copies into O(|dirty|) writes."""
         from gatekeeper_tpu.ir.prep import update_bindings
         key = (st.table.generation, self.con_version_of(st, kind))
         hit = st.bindings_cache.get(kind)
         if hit is not None and hit[0] == key:
             return hit[1]
         if hit is not None and hit[0][1] == key[1]:
+            retired = st.bindings_retired.get(kind)
+            recycle = retired[1] if retired is not None \
+                and retired[0] == key[1] else None
             b = update_bindings(compiled.vectorized.spec, st.table,
-                                constraints, hit[1])
+                                constraints, hit[1], recycle=recycle)
             if b is not None:
                 # carry the gate-source identities so unchanged gates
                 # keep their device copies through the delta chain
@@ -194,10 +228,12 @@ class JaxDriver(LocalDriver):
                     if attr in hit[1].__dict__:
                         b.__dict__[attr] = hit[1].__dict__[attr]
                 self.metrics.counter("bindings_delta_updates").inc()
+                st.bindings_retired[kind] = (key[1], hit[1])
                 st.bindings_cache[kind] = (key, b)
                 return b
         bindings = build_bindings(compiled.vectorized.spec, st.table, constraints)
         self.metrics.counter("bindings_full_builds").inc()
+        st.bindings_retired.pop(kind, None)
         st.bindings_cache[kind] = (key, bindings)
         return bindings
 
@@ -273,28 +309,6 @@ class JaxDriver(LocalDriver):
         # thread pool so first-time jit traces / XLA compiles of
         # different kinds overlap (a 30-template library would
         # otherwise pay its compiles serially on a cold start).
-        specs: list[tuple] = []
-        for kind in sorted(st.templates):
-            compiled = st.templates[kind]
-            constraints = self._kind_constraints(st, kind)
-            if not constraints:
-                continue
-            mask, mask_dirty, padded = self._kind_mask(st, target, kind,
-                                                       constraints)
-            small = len(ordered_rows) * len(constraints) < SMALL_WORKLOAD_EVALS
-            if compiled.vectorized is not None and mask is not None and not small:
-                bindings = self._kind_bindings(st, kind, compiled, constraints)
-                self._install_gates(bindings, mask, mask_dirty, rank, padded)
-                prog = compiled.vectorized.program
-                mode = "topk" if limit is not None else "mask"
-                specs.append((mode, kind, compiled, constraints, prog,
-                              bindings, mask))
-            else:
-                # unlowerable template — or a workload too small to
-                # amortize a device dispatch round-trip
-                specs.append(("scalar", kind, compiled, constraints, None,
-                              None, mask))
-
         def dispatch(spec):
             mode, _, _, _, prog, bindings, mask = spec
             # match/rank gates ride bindings.arrays (_install_gates)
@@ -304,14 +318,42 @@ class JaxDriver(LocalDriver):
                 return self.executor.run_async(prog, bindings)
             return None
 
-        n_dev = sum(1 for sp in specs if sp[0] != "scalar")
-        if n_dev > 1:
-            import concurrent.futures
-            with concurrent.futures.ThreadPoolExecutor(
-                    max_workers=min(8, n_dev)) as pool:
-                handles = list(pool.map(dispatch, specs))
-        else:
-            handles = [dispatch(sp) for sp in specs]
+        # prep + dispatch interleaved: each kind's device step is
+        # submitted the moment its bindings are ready, so kind N's
+        # device execution (and any cold compile, on the pool) overlaps
+        # kind N+1's host prep — on churned sweeps the host delta work
+        # hides most of the device time instead of serializing before it
+        import concurrent.futures
+        pool = concurrent.futures.ThreadPoolExecutor(max_workers=8)
+        specs: list[tuple] = []
+        futures: list = []
+        try:
+            for kind in sorted(st.templates):
+                compiled = st.templates[kind]
+                constraints = self._kind_constraints(st, kind)
+                if not constraints:
+                    continue
+                mask, mask_dirty, padded = self._kind_mask(st, target, kind,
+                                                           constraints)
+                small = len(ordered_rows) * len(constraints) < SMALL_WORKLOAD_EVALS
+                if compiled.vectorized is not None and mask is not None and not small:
+                    bindings = self._kind_bindings(st, kind, compiled, constraints)
+                    self._install_gates(bindings, mask, mask_dirty, rank, padded)
+                    prog = compiled.vectorized.program
+                    mode = "topk" if limit is not None else "mask"
+                    spec = (mode, kind, compiled, constraints, prog,
+                            bindings, mask)
+                    futures.append(pool.submit(dispatch, spec))
+                else:
+                    # unlowerable template — or a workload too small to
+                    # amortize a device dispatch round-trip
+                    spec = ("scalar", kind, compiled, constraints, None,
+                            None, mask)
+                    futures.append(None)
+                specs.append(spec)
+            handles = [f.result() if f is not None else None for f in futures]
+        finally:
+            pool.shutdown(wait=False)
         plans = [sp + (h,) for sp, h in zip(specs, handles)]
 
         # phase 2: host formatting per kind.  One (review, frozen)
@@ -339,6 +381,109 @@ class JaxDriver(LocalDriver):
         m.timer("audit_sweep_wall").observe(_time.perf_counter() - _t0)
         m.gauge("audit_resources").set(len(ordered_rows))
         return [r for _, r in tagged], ("\n".join(trace) if trace is not None else None)
+
+    @locked_read
+    def query_review_batch(self, target: str, reviews: list[dict],
+                           opts: QueryOpts | None = None) -> list[tuple]:
+        """Admission micro-batch as one [C, B] device pass per template
+        kind (SURVEY §7 step 7).
+
+        The B review objects become a throwaway mini resource table
+        (own interner — admission strings must not grow the inventory
+        interner); lowered programs and a ns-over-approximated match
+        mask produce candidate (constraint, review) pairs on device, and
+        only candidates are re-evaluated exactly on host (autoreject,
+        namespaceSelector against the REAL cached namespaces, scalar
+        oracle) — the same over-approximate-then-verify contract as the
+        audit path, so results match per-review query_review exactly.
+
+        Small batches (or tracing, which must observe evaluation) fall
+        back to per-review scalar queries — below SMALL_WORKLOAD_EVALS
+        pairs a device dispatch round-trip costs more than it saves."""
+        st = self._state(target)
+        handler = self.targets[target]
+        tracing = opts.tracing if opts is not None else self.default_tracing
+        constraints_all = list(st.all_constraints())
+        B = len(reviews)
+        if tracing or not isinstance(st, JaxTargetState) or not B or \
+                B * len(constraints_all) < SMALL_WORKLOAD_EVALS:
+            return [self.query_review(target, r, opts) for r in reviews]
+
+        from gatekeeper_tpu.engine.match import MatchEngine
+        from gatekeeper_tpu.store.table import ResourceMeta, ResourceTable
+        mt = ResourceTable()
+        for i, rv in enumerate(reviews):
+            k = rv.get("kind") or {}
+            g, v = k.get("group", ""), k.get("version", "")
+            api = f"{g}/{v}" if g else (v or "v1")
+            obj = rv.get("object")
+            mt.upsert(f"r{i:06d}", obj if isinstance(obj, dict) else {},
+                      ResourceMeta(api_version=api, kind=k.get("kind", ""),
+                                   name=str(rv.get("name", "")),
+                                   namespace=rv.get("namespace")))
+        mini = MatchEngine(mt)
+
+        plans: list[tuple] = []
+        for kind in sorted(st.templates):
+            compiled = st.templates[kind]
+            cons = self._kind_constraints(st, kind)
+            if not cons:
+                continue
+            cmask = mini.mask(cons, overapprox_ns=True)
+            lowered = compiled.vectorized
+            # the audit review's operation is always CREATE, so $meta
+            # operation columns would mis-encode UPDATE/DELETE reviews —
+            # under-approximation risk; those kinds stay on the mask gate
+            uses_op = lowered is not None and any(
+                rc.path[:1] == ("$meta",) and rc.path[1:] == ("operation",)
+                for rc in lowered.spec.r_cols)
+            ops_ok = all(r.get("operation", "CREATE") == "CREATE"
+                         for r in reviews) if uses_op else True
+            # inventory-join columns built over the mini table would see
+            # only the batch's reviews, not the real inventory — an
+            # under-approximating gate (dropped violations).  Those
+            # kinds keep the match-only gate + exact host evaluation.
+            if lowered is not None and lowered.spec.inv_joins:
+                lowered = None
+            if lowered is not None and ops_ok:
+                bindings = build_bindings(lowered.spec, mt, cons)
+                h = self.executor.run_async(lowered.program, bindings,
+                                            match=cmask)
+                plans.append((kind, compiled, cons, cmask, h))
+            else:
+                plans.append((kind, compiled, cons, cmask, None))
+        gates = [(kind, compiled, cons, (h.get() if h is not None else cmask))
+                 for kind, compiled, cons, cmask, h in plans]
+
+        ns_sel_cons = [c for c in constraints_all
+                       if ((c.get("spec") or {}).get("match") or {})
+                       .get("namespaceSelector") is not None]
+        out: list[tuple] = []
+        for i, rv in enumerate(reviews):
+            results: list[Result] = []
+            if ns_sel_cons:
+                for c, msg, details in handler.autoreject_review(
+                        rv, ns_sel_cons, st.table):
+                    results.append(Result(msg=msg,
+                                          metadata={"details": details},
+                                          constraint=c, review=rv))
+            frozen = freeze(rv)
+            for kind, compiled, cons, gate in gates:
+                for ci, c in enumerate(cons):
+                    if not gate[ci, i]:
+                        continue
+                    # exact matching (incl. namespaceSelector against
+                    # the real inventory) before the exact evaluation
+                    if not any(True for _ in handler.matching_constraints(
+                            rv, [c], st.table)):
+                        continue
+                    results.extend(self._eval_pair(st, target, compiled, rv,
+                                                   frozen, c, None))
+            out.append((results, None))
+        m = self.metrics
+        m.counter("review_batches_device").inc()
+        m.counter("reviews_device").inc(B)
+        return out
 
     @locked_read
     def explain_pair(self, target: str, kind: str, constraint_name: str,

@@ -80,23 +80,35 @@ class _View:
             self.group_ids = ident.group_ids
             self.kind_ids = ident.kind_ids
             self.ns_ids = ident.ns_ids
-            keys, vals, offs = table.labels_csr()
-            self.labels = _LabelIndex(keys, vals, offs, self.n)
         else:
             self.n = len(rows)
             self.alive = ident.alive[rows]
             self.group_ids = ident.group_ids[rows]
             self.kind_ids = ident.kind_ids[rows]
             self.ns_ids = ident.ns_ids[rows]
-            # labels for the subset come straight from the objects —
-            # O(|rows|), never forcing the full-CSR delta splice
-            from gatekeeper_tpu.store.columns import ColSpec, build_column
-            col = build_column(ColSpec(("metadata", "labels"), "items"),
-                               [table._objs[int(r)] for r in rows],
-                               table.interner)
-            vals2 = col.values2 if col.values2 is not None else col.values
-            self.labels = _LabelIndex(col.values, vals2, col.offsets, self.n)
+        self._labels: _LabelIndex | None = None
         self._ns_index: tuple | None = None
+
+    @property
+    def labels(self) -> _LabelIndex:
+        """Label lookups, built on first selector use — constraints
+        without label/expression selectors (common) never pay the
+        extraction."""
+        if self._labels is None:
+            if self.rows is None:
+                keys, vals, offs = self.table.labels_csr()
+                self._labels = _LabelIndex(keys, vals, offs, self.n)
+            else:
+                # labels for the subset come straight from the objects —
+                # O(|rows|), never forcing the full-CSR delta splice
+                from gatekeeper_tpu.store.columns import ColSpec, build_column
+                col = build_column(ColSpec(("metadata", "labels"), "items"),
+                                   [self.table._objs[int(r)] for r in self.rows],
+                                   self.table.interner)
+                vals2 = col.values2 if col.values2 is not None else col.values
+                self._labels = _LabelIndex(col.values, vals2, col.offsets,
+                                           self.n)
+        return self._labels
 
     # -- namespace labels ---------------------------------------------
 
@@ -172,8 +184,15 @@ class _View:
 
     # -- the mask over this view --------------------------------------
 
-    def mask(self, constraints: list[dict]) -> np.ndarray:
-        """bool [len(constraints), self.n]; tombstoned rows are False."""
+    def mask(self, constraints: list[dict],
+             overapprox_ns: bool = False) -> np.ndarray:
+        """bool [len(constraints), self.n]; tombstoned rows are False.
+
+        ``overapprox_ns`` treats namespaceSelector clauses as matching
+        everything — for masks over rows that are NOT the inventory this
+        table's namespaces describe (the admission batch path evaluates
+        candidate pairs exactly on the host afterwards; the mask must
+        only never under-approximate)."""
         it = self.table.interner
         n = self.n
         out = np.zeros((len(constraints), n), dtype=bool)
@@ -204,7 +223,8 @@ class _View:
                 m &= np.isin(self.ns_ids, np.asarray(nss, dtype=np.int32)) \
                     & (self.ns_ids != MISSING)
 
-            if "namespaceSelector" in match and match["namespaceSelector"] is not None:
+            if "namespaceSelector" in match and match["namespaceSelector"] is not None \
+                    and not overapprox_ns:
                 m &= self.selector_ok_ns(match["namespaceSelector"])
 
             selector = match.get("labelSelector") or {}
@@ -220,6 +240,7 @@ class MatchEngine:
         self.table = table
         self._gen = -1
         self._view: _View | None = None
+        self._sub_view: tuple | None = None   # ((since, gen), view, rows)
 
     def _full_view(self) -> _View:
         gen = self.table.generation
@@ -228,9 +249,11 @@ class MatchEngine:
             self._view = _View(self.table, None)
         return self._view
 
-    def mask(self, constraints: list[dict]) -> np.ndarray:
-        """bool [len(constraints), n_rows]; tombstoned rows are False."""
-        return self._full_view().mask(constraints)
+    def mask(self, constraints: list[dict],
+             overapprox_ns: bool = False) -> np.ndarray:
+        """bool [len(constraints), n_rows]; tombstoned rows are False.
+        See _View.mask for ``overapprox_ns``."""
+        return self._full_view().mask(constraints, overapprox_ns)
 
     def mask_rows(self, constraints: list[dict],
                   rows: np.ndarray) -> np.ndarray:
@@ -239,3 +262,18 @@ class MatchEngine:
         (namespaceSelector results of unchanged rows shift); callers
         gate on table.namespaces_dirty_since."""
         return _View(self.table, rows).mask(constraints)
+
+    def mask_rows_since(self, constraints: list[dict], since_gen: int):
+        """(mask [C, |rows|], rows) for the rows dirty after since_gen.
+        The subset view (identity slices + labels pulled from the dirty
+        objects) is cached per (since_gen, generation) — every template
+        kind of a sweep shares one view build.  Same namespace-churn
+        caveat as mask_rows."""
+        gen = self.table.generation
+        key = (since_gen, gen)
+        hit = self._sub_view
+        if hit is None or hit[0] != key:
+            rows = self.table.dirty_rows_since(since_gen)
+            hit = (key, _View(self.table, rows), rows)
+            self._sub_view = hit
+        return hit[1].mask(constraints), hit[2]

@@ -44,8 +44,8 @@ import itertools
 from typing import Any, Callable
 
 from gatekeeper_tpu.ir.prep import (
-    CSetReq, CValReq, EColReq, ElemKeysReq, KeyedValReq, MembReq, PrepSpec,
-    PTableReq, RColReq, TableReq)
+    CSetReq, CValReq, EColReq, ElemKeysReq, InvJoinReq, KeyedValReq, MembReq,
+    PrepSpec, PTableReq, RColReq, TableReq)
 from gatekeeper_tpu.ir.program import CMP_OPS, Node, Program, RuleSpec
 from gatekeeper_tpu.rego import builtins as bi
 from gatekeeper_tpu.rego.ast_nodes import (
@@ -330,7 +330,9 @@ class Lowerer:
         self.membs: list[MembReq] = []
         self.elem_keys: list[ElemKeysReq] = []
         self.keyed_vals: list[KeyedValReq] = []
+        self.spec_inv_joins: list[InvJoinReq] = []
         self.cvalid_fns: list[Callable] = []
+        self.uses_inventory_lowered = False
         self._leaf_nodes: dict[tuple, int] = {}
         self._no_negate_nodes: set[int] = set()
         self._fn_purity: dict[str, bool] = {}
@@ -372,6 +374,7 @@ class Lowerer:
             csets=tuple(self.csets), cvals=tuple(self.cvals),
             membs=tuple(self.membs), elem_keys=tuple(self.elem_keys),
             keyed_vals=tuple(self.keyed_vals),
+            inv_joins=tuple(self.spec_inv_joins),
             cvalid_fns=tuple(self.cvalid_fns))
         return LoweredProgram(
             program=Program(nodes=tuple(self.nodes), rules=tuple(self.rules_out)),
@@ -956,7 +959,7 @@ class Lowerer:
     # -- rule lowering -------------------------------------------------
 
     def _lower_rule(self, rule: Rule) -> None:
-        body = rule.body
+        body = self._try_inventory_join(rule.body)
         # vars used by later literals (head msg/details are host-formatted,
         # so assigns feeding only the head are skipped)
         used_later: list[set] = [set() for _ in body]
@@ -966,6 +969,161 @@ class Lowerer:
             _collect_lit_vars(body[i], acc)
         for i, lit in enumerate(body):
             self._lower_literal(lit, used_later[i])
+
+    # -- inventory joins (data.inventory duplicate detection) ----------
+
+    @staticmethod
+    def _parse_inv_iter(rhs) -> tuple | None:
+        """Match ``data.inventory.namespace[ns][gv]["Kind"][name]`` (or
+        the cluster form ``data.inventory.cluster[gv]["Kind"][name]``):
+        -> (kind, name_var, namespaced_only, bound_vars)."""
+        if not (isinstance(rhs, Ref) and isinstance(rhs.base, Var)
+                and rhs.base.name == "data"):
+            return None
+        p = rhs.path
+        if len(p) < 2 or not (isinstance(p[0], Scalar)
+                              and p[0].value == "inventory"):
+            return None
+        if not isinstance(p[1], Scalar):
+            return None
+        scope = p[1].value
+        rest = p[2:]
+        if scope == "namespace" and len(rest) == 4:
+            ns_v, gv_v, kind_t, name_t = rest
+            free = (ns_v, gv_v)
+            namespaced = True
+        elif scope == "cluster" and len(rest) == 3:
+            gv_v, kind_t, name_t = rest
+            free = (gv_v,)
+            namespaced = False
+        else:
+            return None
+        if not all(isinstance(v, Var) for v in free):
+            return None
+        if not (isinstance(kind_t, Scalar) and isinstance(kind_t.value, str)):
+            return None
+        if not isinstance(name_t, Var):
+            return None
+        bound = {v.name for v in free if not v.is_wildcard}
+        return kind_t.value, name_t, namespaced, bound
+
+    def _try_inventory_join(self, body) -> list:
+        """Recognize the duplicate-detection join shape and replace its
+        literals with one host-built InvJoinReq column (SURVEY §7 /
+        VERDICT: per-sweep inventory index so K8sUniqueIngressHost runs
+        on device).  Supported shape:
+
+          other := data.inventory.namespace[ns][_]["Kind"][name]
+          other.<path> == <review leaf>          (either operand order)
+          not <review name leaf> == name         (optional, either order)
+
+        with the inventory vars referenced nowhere else in the body
+        (the head is host-formatted by the oracle on candidate pairs, so
+        head references are fine).  Anything else leaves the body
+        unchanged — the standard path will raise CannotLower and route
+        the template to the scalar oracle."""
+        inv_idx = None
+        parsed = other_var = None
+        for i, lit in enumerate(body):
+            e = lit.expr
+            if isinstance(e, Assign) and isinstance(e.lhs, Var) \
+                    and not lit.negated:
+                p = self._parse_inv_iter(e.rhs)
+                if p is not None:
+                    if inv_idx is not None:
+                        return body          # two joins: scalar fallback
+                    inv_idx, parsed, other_var = i, p, e.lhs.name
+        if inv_idx is None:
+            return body
+        kind, name_t, namespaced, bound_free = parsed
+        name_var = None if name_t.is_wildcard else name_t.name
+        join = None          # (inv_path, src_leaf)
+        guard = False
+        consumed = {inv_idx}
+        inv_vars = {other_var} | bound_free | ({name_var} if name_var else set())
+
+        # syntactic env: the pre-pass runs before any literal lowers, so
+        # resolve `v := input.review.object...` chains from the body text
+        syn_env: dict[str, Sym] = {}
+        for lit in body:
+            e = lit.expr
+            if not lit.negated and isinstance(e, Assign) \
+                    and isinstance(e.lhs, Var) and isinstance(e.rhs, Ref):
+                leaf = _resolve_ref_leaf(e.rhs, self.axes, syn_env)
+                if leaf is not None:
+                    syn_env[e.lhs.name] = SLeaf(leaf)
+
+        def refs_inv(term) -> bool:
+            found: list = []
+
+            def chk(t):
+                if isinstance(t, Var) and t.name in inv_vars:
+                    found.append(t)
+            from gatekeeper_tpu.rego.ast_nodes import walk_terms
+            walk_terms(term, chk)
+            return bool(found)
+
+        def review_leaf_of(term):
+            if isinstance(term, Ref):
+                return _resolve_ref_leaf(term, self.axes, syn_env)
+            if isinstance(term, Var):
+                sym = syn_env.get(term.name)
+                if isinstance(sym, SLeaf):
+                    return sym.leaf
+            return None
+
+        for i, lit in enumerate(body):
+            # walk the LITERAL (walk_terms does not descend into bare
+            # Compare/Assign exprs)
+            if i == inv_idx or not refs_inv(lit):
+                continue
+            e = lit.expr
+            if isinstance(e, (Compare, Assign)) and \
+                    getattr(e, "op", None) in ("==", "="):
+                lhs, rhs = e.lhs, e.rhs
+                # join: other.<path> == <review leaf>
+                for a, b in ((lhs, rhs), (rhs, lhs)):
+                    if join is None and not lit.negated \
+                            and isinstance(a, Ref) \
+                            and isinstance(a.base, Var) \
+                            and a.base.name == other_var \
+                            and all(isinstance(s, Scalar) for s in a.path) \
+                            and not refs_inv(b):
+                        leaf = review_leaf_of(b)
+                        if leaf is not None and leaf.root == "obj":
+                            join = (tuple(s.value for s in a.path), leaf)
+                            consumed.add(i)
+                            break
+                if i in consumed:
+                    continue
+                # guard: not <review name> == name
+                if lit.negated and name_var is not None and not guard:
+                    for a, b in ((lhs, rhs), (rhs, lhs)):
+                        if isinstance(a, Var) and a.name == name_var \
+                                and not refs_inv(b):
+                            leaf = review_leaf_of(b)
+                            if leaf is not None and (
+                                    leaf == LeafId("obj", ("metadata", "name"))
+                                    or leaf == LeafId("meta", ("name",))):
+                                guard = True
+                                consumed.add(i)
+                                break
+                    if i in consumed:
+                        continue
+            return body       # unsupported use of an inventory var
+        if join is None:
+            return body
+        inv_path, src_leaf = join
+        name = f"ij{next(self.serial)}"
+        self.spec_inv_joins.append(InvJoinReq(
+            name=name, kind=kind, inv_path=inv_path,
+            src_path=src_leaf.path, exclude_same_name=guard,
+            namespaced_only=namespaced))
+        # definedness of the review-side leaf rides the column build
+        # (MISSING src never counts); emit the join verdict conjunct
+        self.conjuncts.append(self._emit("input", (), (name, "r_bool")))
+        self.uses_inventory_lowered = True
+        return [lit for i, lit in enumerate(body) if i not in consumed]
 
     def _lower_literal(self, lit: Literal, used_later: set) -> None:
         if lit.withs:

@@ -94,6 +94,8 @@ def binding_axes(name: str) -> tuple:
         if name.endswith(".vmap"):
             return (None,)                       # global id -> dense u [T]
         return ("c", None)                       # .any / .all [C, U]
+    if base.startswith("ij") and base[2:].isdigit():
+        return ("r",)                            # inventory join bool [R]
     if base.startswith("t") and base[1:].isdigit():
         return (None,)                           # unary table [T]
     raise ValueError(f"binding_axes: unrecognized binding {name!r}; "
@@ -224,6 +226,30 @@ class ElemKeysReq:
 
 
 @dataclasses.dataclass(frozen=True)
+class InvJoinReq:
+    """Duplicate-detection join against the inventory (the
+    K8sUniqueIngressHost pattern, regolib src.go:55-60 inventory access):
+
+      ∃ another cached object of `kind` (namespace-scoped when
+      `namespaced_only`) whose value at `inv_path` equals the review
+      object's value at `src_path`, with a different metadata.name when
+      `exclude_same_name`.
+
+    Lowered to a per-row bool column `name` ([r_pad]) built from interned
+    value counts (np.unique/bincount over the kind's rows) — the device
+    sees a plain r_bool input; no per-pair join ever materializes.
+    Cross-row by nature: delta updates recompute the column and diff
+    against the previous one to find the true dirty set."""
+
+    name: str
+    kind: str
+    inv_path: tuple[str, ...]
+    src_path: tuple[str, ...]
+    exclude_same_name: bool = True
+    namespaced_only: bool = True
+
+
+@dataclasses.dataclass(frozen=True)
 class MembReq:
     """Membership matrix vs a ragged per-resource key set.
 
@@ -248,6 +274,7 @@ class PrepSpec:
     membs: tuple[MembReq, ...] = ()
     elem_keys: tuple[ElemKeysReq, ...] = ()
     keyed_vals: tuple[KeyedValReq, ...] = ()
+    inv_joins: tuple[InvJoinReq, ...] = ()
     # constraint-only conjuncts, folded into one validity vector
     cvalid_fns: tuple[Callable[[dict], bool], ...] = ()
 
@@ -365,6 +392,10 @@ class Bindings:
     delta_state: dict = dataclasses.field(default_factory=dict)
     base: "Bindings | None" = None
     base_dirty: dict[str, np.ndarray] = dataclasses.field(default_factory=dict)
+    # arrays changed vs base WITHOUT a row-dirty footprint but in an
+    # append-only way (value tables gaining entries for ids that only
+    # dirty rows reference) — row-sliced delta evaluation stays sound
+    base_append_only: set = dataclasses.field(default_factory=set)
 
     def shapes_key(self) -> tuple:
         return tuple(sorted((k, v.shape, str(v.dtype)) for k, v in self.arrays.items()))
@@ -382,6 +413,51 @@ def _eval_host(fn, *args):
     if v is UNDEFINED:
         return None
     return v
+
+
+def build_inv_join(req: InvJoinReq, table: ResourceTable,
+                   r_pad: int) -> np.ndarray:
+    """[r_pad] bool: the review row has a same-valued other object.
+    All-vectorized: unique-value counts over the kind's rows, pair
+    counts for the same-name exclusion, gathers for the per-row verdict.
+    The review row itself is among the kind's rows during an audit, and
+    the same-name exclusion removes it exactly like the oracle's
+    ``not review.name == name`` guard."""
+    interner = table.interner
+    ident = table.identity()
+    n = table.n_rows
+    kid = interner.lookup(req.kind)
+    out = np.zeros((r_pad,), dtype=bool)
+    src = table.column(ColSpec(req.src_path, "val")).ids
+    if kid == MISSING or n == 0:
+        return out
+    sel = ident.alive & (ident.kind_ids == kid)
+    if req.namespaced_only:
+        sel &= ident.ns_ids != MISSING
+    inv_vals = table.column(ColSpec(req.inv_path, "val")).ids
+    h = inv_vals[sel]
+    h = h[h != MISSING]
+    if not len(h):
+        return out
+    uh, cnt = np.unique(h, return_counts=True)
+    pos = np.searchsorted(uh, src)
+    pos_c = np.clip(pos, 0, len(uh) - 1)
+    valid = (src != MISSING) & (uh[pos_c] == src)
+    total = np.where(valid, cnt[pos_c], 0)
+    own = np.zeros((n,), dtype=np.int64)
+    if req.exclude_same_name:
+        big = np.int64(len(interner) + 1)
+        names_inv = ident.name_ids[sel][inv_vals[sel] != MISSING]
+        pair_inv = h.astype(np.int64) * big + names_inv
+        up, ucnt = np.unique(pair_inv, return_counts=True)
+        # review-side name: the object's metadata.name equals the cached
+        # meta name (ProcessData derives the key from it)
+        pair_rev = src.astype(np.int64) * big + ident.name_ids
+        ppos = np.clip(np.searchsorted(up, pair_rev), 0, len(up) - 1)
+        pvalid = valid & (ident.name_ids != MISSING) & (up[ppos] == pair_rev)
+        own = np.where(pvalid, ucnt[ppos], 0)
+    out[:n] = (total - own) > 0
+    return out
 
 
 def build_bindings(spec: PrepSpec, table: ResourceTable,
@@ -743,6 +819,10 @@ def build_bindings(spec: PrepSpec, table: ResourceTable,
                 b[ci] = bool(x) if x is not None else False
             out[cv.name] = b
 
+    # ---- inventory joins (cross-row duplicate detection)
+    for ij in spec.inv_joins:
+        out[ij.name] = build_inv_join(ij, table, r_pad)
+
     # ---- constraint validity (constraint-only conjuncts)
     cvalid = np.zeros((c_pad,), dtype=bool)
     for ci, c in enumerate(constraints):
@@ -762,7 +842,8 @@ def build_bindings(spec: PrepSpec, table: ResourceTable,
 
 def update_bindings(spec: PrepSpec, table: ResourceTable,
                     constraints: list[dict],
-                    prev: Bindings) -> Bindings | None:
+                    prev: Bindings,
+                    recycle: Bindings | None = None) -> Bindings | None:
     """Incrementally derive a new Bindings from `prev` by re-extracting
     only the rows dirty since prev was built (prev.delta_state["gen"]).
 
@@ -772,10 +853,21 @@ def update_bindings(spec: PrepSpec, table: ResourceTable,
     large for the delta to pay off.  The caller must treat None as
     "call build_bindings".
 
-    Copy-on-write: prev and its arrays are never mutated — changed
-    arrays get fresh identities and their dirty rows are recorded in
+    prev and its arrays are never mutated — changed arrays get fresh
+    identities and their rows-dirty-since-prev are recorded in
     ``base_dirty`` so the device cache can scatter-update instead of
-    re-uploading (engine/veval.ProgramExecutor._arrays).  Constraint-set
+    re-uploading (engine/veval.ProgramExecutor._arrays).
+
+    ``recycle`` (optional) is a RETIRED Bindings at least one update
+    older than prev whose numpy buffers may be overwritten in place —
+    the ping-pong that turns per-sweep O(r_pad) array copies into
+    O(|dirty|) writes.  Writes then cover the rows dirty since
+    *recycle* (a superset of base_dirty's rows); vs prev the result
+    still differs only at base_dirty rows, which is the device-sync
+    contract.  The caller owns the safety argument: nothing else may
+    read the recycled buffers as current data (the driver hands out
+    only the newest bindings per kind, and device arrays are immutable
+    snapshots — see engine/jax_driver._kind_bindings).  Constraint-set
     changes are NOT handled here (caller keys on the constraint version
     and rebuilds) — all per-constraint arrays are shared as-is."""
     from gatekeeper_tpu.store.table import delta_worthwhile
@@ -787,13 +879,22 @@ def update_bindings(spec: PrepSpec, table: ResourceTable,
     if audit_pads(n, 0)[0] != prev.r_pad:
         return None
     prev_gen = st0["gen"]
-    dirty = table.dirty_rows_since(prev_gen)
+    base_rows = table.dirty_rows_since(prev_gen)
+    rec_state = recycle.delta_state if recycle is not None else None
+    if rec_state and rec_state.get("remap") == table.remap_generation \
+            and recycle.r_pad == prev.r_pad and recycle is not prev:
+        dirty = table.dirty_rows_since(min(rec_state["gen"], prev_gen))
+        rec_arrays = recycle.arrays
+    else:
+        dirty = base_rows
+        rec_arrays = {}
     if not delta_worthwhile(len(dirty), n):
         return None
     interner = table.interner
     r_pad, c_pad = prev.r_pad, prev.c_pad
     out = dict(prev.arrays)
     base_dirty: dict[str, np.ndarray] = {}
+    append_only: set = set()
     state: dict = {"gen": table.generation, "remap": table.remap_generation,
                    "tables": {}, "ptables": {}, "csets": st0["csets"],
                    "elem_counts": {}, "interner_size": 0}
@@ -805,8 +906,15 @@ def update_bindings(spec: PrepSpec, table: ResourceTable,
     dirty_objs = [objs[int(i)] for i in dirty]
 
     def cow(name: str) -> np.ndarray:
-        arr = out[name] = out[name].copy()
-        base_dirty[name] = dirty
+        cur = out[name]
+        rec = rec_arrays.get(name)
+        if rec is not None and rec is not cur and rec.shape == cur.shape \
+                and rec.dtype == cur.dtype:
+            arr = rec            # overwrite the retired buffer in place
+        else:
+            arr = cur.copy()
+        out[name] = arr
+        base_dirty[name] = base_rows
         return arr
 
     alive = cow("__alive__")
@@ -922,6 +1030,7 @@ def update_bindings(spec: PrepSpec, table: ResourceTable,
         if new_ids:
             ok = out[tr.name + ".ok"] = out[tr.name + ".ok"].copy()
             vals = out[tr.name + ".v"] = out[tr.name + ".v"].copy()
+            append_only.update((tr.name + ".ok", tr.name + ".v"))
             for uid in new_ids:
                 key = interner.string(uid)
                 arg = decode_value(key) if tr.src_val else key
@@ -966,6 +1075,8 @@ def update_bindings(spec: PrepSpec, table: ResourceTable,
             tbl = pst["tbl"].copy()           # already [n_distinct, u_pad]
             t_any = out[pt.name + ".any"] = out[pt.name + ".any"].copy()
             t_all = out[pt.name + ".all"] = out[pt.name + ".all"].copy()
+            append_only.update((pt.name + ".vmap", pt.name + ".any",
+                                pt.name + ".all"))
             distinct = pst["distinct"]
             for gid in new_ids:
                 u = len(u_of)
@@ -1035,6 +1146,17 @@ def update_bindings(spec: PrepSpec, table: ResourceTable,
                             if 0 <= k < len(elem) and elem[k] is not False:
                                 ekm[li, di, ei] = True
 
+    # ---- inventory joins: cross-row, so recompute and DIFF — the true
+    # dirty set (rows whose join verdict changed) can exceed the table's
+    # dirty rows (an upsert elsewhere flips this row's duplicate status)
+    for ij in spec.inv_joins:
+        new_col = build_inv_join(ij, table, r_pad)
+        prev_col = prev.arrays[ij.name]
+        changed = np.nonzero(new_col != prev_col)[0]
+        if len(changed):
+            out[ij.name] = new_col
+            base_dirty[ij.name] = changed
+
     # validity: every table-indexed array must still cover the interner
     # (late interning past the bucket would alias clamped device gathers)
     if (spec.tables or spec.ptables or
@@ -1051,7 +1173,8 @@ def update_bindings(spec: PrepSpec, table: ResourceTable,
     return Bindings(arrays=out, n_constraints=prev.n_constraints,
                     n_resources=n, c_pad=c_pad, r_pad=r_pad,
                     e_pads=prev.e_pads, delta_state=state,
-                    base=prev, base_dirty=base_dirty)
+                    base=prev, base_dirty=base_dirty,
+                    base_append_only=append_only)
 
 
 _META_FIELDS = {

@@ -97,3 +97,29 @@ class Metrics:
                     "p50": t.percentile(50), "p99": t.percentile(99),
                 }
             return out
+
+    def render_prometheus(self, prefix: str = "gatekeeper") -> str:
+        """Prometheus text exposition (the /metrics export surface —
+        SURVEY §5 set the bar at real exported counters; the reference
+        plumbs OPA's registry but never serves it)."""
+        lines: list[str] = []
+        with self._lock:
+            for name, c in sorted(self._counters.items()):
+                lines.append(f"# TYPE {prefix}_{name} counter")
+                lines.append(f"{prefix}_{name} {c.value}")
+            for name, g in sorted(self._gauges.items()):
+                lines.append(f"# TYPE {prefix}_{name} gauge")
+                lines.append(f"{prefix}_{name} {g.value}")
+            for name, t in sorted(self._timers.items()):
+                # timers carry their unit in their registered name
+                # (admission_seconds, admission_batch_size) — don't
+                # force a _seconds suffix onto unitless observations
+                base = f"{prefix}_{name}"
+                lines.append(f"# TYPE {base} summary")
+                for q in (50, 90, 99):
+                    v = t.percentile(q)
+                    if v is not None:
+                        lines.append(f'{base}{{quantile="0.{q}"}} {v:.6f}')
+                lines.append(f"{base}_sum {t.total:.6f}")
+                lines.append(f"{base}_count {t.count}")
+        return "\n".join(lines) + "\n"

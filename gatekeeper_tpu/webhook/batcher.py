@@ -77,6 +77,7 @@ class MicroBatcher:
         return p.response
 
     def _run(self) -> None:
+        import time
         while True:
             with self._wake:
                 while not self._queue and not self._stop:
@@ -87,10 +88,18 @@ class MicroBatcher:
                         p.event.set()
                     self._queue.clear()
                     return
-            # batch window: let more requests coalesce
-            if self.max_wait > 0:
-                threading.Event().wait(self.max_wait)
-            with self._wake:
+                # batch window: let more requests coalesce, but never
+                # sleep once the batch is already full — and leave early
+                # the moment it fills (woken by submit) instead of
+                # unconditionally burning max_wait
+                if self.max_wait > 0 and len(self._queue) < self.max_batch:
+                    deadline = time.monotonic() + self.max_wait
+                    while len(self._queue) < self.max_batch \
+                            and not self._stop:
+                        remaining = deadline - time.monotonic()
+                        if remaining <= 0:
+                            break
+                        self._wake.wait(remaining)
                 batch, self._queue = (self._queue[:self.max_batch],
                                       self._queue[self.max_batch:])
             if not batch:

@@ -51,13 +51,16 @@ def is_gk_service_account(user_info: dict) -> bool:
 class ValidationHandler:
     def __init__(self, client: Client, cluster=None, injected_config=None,
                  batcher=None, metrics: Metrics | None = None,
-                 log=lambda *_: None):
+                 log=lambda *_: None, batch_mode: str = "auto"):
         self.client = client
         self.cluster = cluster
         self.injected_config = injected_config  # test hook (policy.go:121)
         self.batcher = batcher
         self.metrics = metrics if metrics is not None else Metrics()
         self.log = log
+        # "auto": batch only when a full batch clears the device
+        # engine's small-workload threshold; "always"/"never" force it
+        self.batch_mode = batch_mode
 
     # ------------------------------------------------------------------
 
@@ -146,10 +149,24 @@ class ValidationHandler:
                 dump = True
         return enabled, dump
 
+    def _batching_pays(self) -> bool:
+        """Micro-batching helps only when a coalesced batch can clear
+        the device engine's small-workload threshold — below it, the
+        batcher would serialize scalar evaluations that the client's
+        read lock already runs concurrently (reference RWMutex,
+        local.go:43-48), costing ~10x on p50."""
+        if self.batch_mode != "auto":
+            return self.batch_mode == "always"
+        if not hasattr(self.client.driver, "query_review_batch"):
+            return False
+        from gatekeeper_tpu.engine.jax_driver import SMALL_WORKLOAD_EVALS
+        n_cons = sum(len(v) for v in self.client.constraints.values())
+        return n_cons * self.batcher.max_batch >= SMALL_WORKLOAD_EVALS
+
     def _review(self, request: dict):
         """reviewRequest (policy.go:244-277)."""
         tracing, dump = self._trace_switch(request)
-        if self.batcher is not None and not tracing:
+        if self.batcher is not None and not tracing and self._batching_pays():
             resp = self.batcher.submit(request)
         else:
             resp = self.client.review(request, tracing=tracing)

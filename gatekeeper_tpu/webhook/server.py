@@ -22,13 +22,28 @@ DEFAULT_PORT = 8443          # the reference defaults to 443 (policy.go:48)
 
 class WebhookServer:
     def __init__(self, handler: ValidationHandler, port: int = DEFAULT_PORT,
-                 host: str = "127.0.0.1"):
+                 host: str = "127.0.0.1", metrics=None):
         self.handler = handler
+        self.metrics = metrics if metrics is not None else handler.metrics
         outer = self
 
         class _HTTPHandler(BaseHTTPRequestHandler):
             def log_message(self, *args):  # quiet
                 pass
+
+            def do_GET(self):
+                """GET /metrics — Prometheus text exposition of the
+                shared registry (audit/admission/device counters)."""
+                if self.path != "/metrics":
+                    self.send_error(404)
+                    return
+                payload = outer.metrics.render_prometheus().encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
 
             def do_POST(self):
                 if self.path != WEBHOOK_PATH:

@@ -60,3 +60,37 @@ def test_demo_flow_through_engine_worker():
         assert out["audit_timestamp"]
     finally:
         worker.stop()
+
+
+def test_live_watch_roster_poll():
+    """A GVK whose CRD becomes served AFTER registration must get its
+    watch started by the periodic roster poll (reference
+    updateManagerLoop, watch/manager.go:165-178) — with no roster
+    mutation and no deterministic pump."""
+    import time
+    args = parse_args(["--port", "-1", "--audit-interval", "3600",
+                       "--watch-poll-interval", "0.1"])
+    mgr = Manager(args)
+    gvk = GVK(group="example.com", version="v1", kind="Widget")
+    # register intent while the CRD is NOT yet served
+    mgr.plane.sync_registrar.add_watch(gvk)
+    assert gvk in mgr.plane.watch_manager.pending_gvks()
+    mgr.start()
+    try:
+        # now the CRD appears (apiserver starts serving the kind)
+        mgr.cluster.create({
+            "apiVersion": "apiextensions.k8s.io/v1beta1",
+            "kind": "CustomResourceDefinition",
+            "metadata": {"name": "widgets.example.com"},
+            "spec": {"group": "example.com", "version": "v1",
+                     "names": {"kind": "Widget", "plural": "widgets"}},
+        })
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if gvk in mgr.plane.watch_manager.watched_gvks():
+                break
+            time.sleep(0.05)
+        assert gvk in mgr.plane.watch_manager.watched_gvks(), \
+            "poll loop never picked up the newly-served CRD"
+    finally:
+        mgr.stop()

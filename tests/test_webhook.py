@@ -124,6 +124,7 @@ class TestBatcher:
             lambda reqs: handler.client.review_batch(reqs),
             max_batch=16, max_wait=0.01)
         handler.batcher = batcher
+        handler.batch_mode = "always"   # force coalescing for the test
         batcher.start()
         try:
             results = [None] * 8
@@ -166,5 +167,38 @@ class TestHTTP:
             assert out["response"]["uid"] == "u1"
             assert out["response"]["allowed"] is False
             assert out["response"]["status"]["code"] == 403
+        finally:
+            server.stop()
+
+
+class TestMetricsEndpoint:
+    def test_metrics_export(self, handler):
+        """GET /metrics serves the Prometheus text exposition of the
+        shared registry (SURVEY §5: exported counters)."""
+        server = WebhookServer(handler, port=0)
+        server.start()
+        try:
+            # generate some admission traffic first
+            body = {"apiVersion": "admission.k8s.io/v1beta1",
+                    "kind": "AdmissionReview",
+                    "request": review_request(ns_obj("bad"))}
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{server.port}/v1/admit",
+                data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"})
+            urllib.request.urlopen(req).read()
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{server.port}/metrics") as resp:
+                assert resp.headers["Content-Type"].startswith("text/plain")
+                text = resp.read().decode()
+            assert "# TYPE gatekeeper_admission_seconds_seconds summary" \
+                in text or "gatekeeper_admission_seconds" in text
+            assert "gatekeeper_admission_denied" in text or \
+                "_count" in text
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{server.port}/v1/admit") as resp:
+                pass
+        except urllib.error.HTTPError as e:
+            assert e.code == 404   # GET on the admit path is not served
         finally:
             server.stop()
