@@ -1,0 +1,31 @@
+# gatekeeper-tpu image: serves both the control-plane manager and the
+# engine worker (deploy/gatekeeper-tpu.yaml runs the same image with
+# different commands — reference analogue: /root/reference/Dockerfile,
+# one binary image).
+#
+# The TPU runtime (libtpu) is provided by the node/runtime class on TPU
+# VMs; on CPU-only nodes the engine falls back to jax CPU automatically.
+
+FROM python:3.12-slim
+
+# native toolchain for the columnar-ingest C extension (compiled on
+# first import, gatekeeper_tpu/native/__init__.py) and openssl for the
+# webhook's self-signed serving certs (webhook/bootstrap.py)
+RUN apt-get update && apt-get install -y --no-install-recommends \
+        gcc libc6-dev openssl && rm -rf /var/lib/apt/lists/*
+
+RUN pip install --no-cache-dir "jax[tpu]" -f \
+        https://storage.googleapis.com/jax-releases/libtpu_releases.html \
+    || pip install --no-cache-dir jax jaxlib
+RUN pip install --no-cache-dir numpy pyyaml
+
+WORKDIR /app
+COPY gatekeeper_tpu /app/gatekeeper_tpu
+COPY bench.py /app/bench.py
+
+# warm the native extension build at image build time
+RUN python -c "from gatekeeper_tpu import native; print('native:', native.available)"
+
+ENV PYTHONUNBUFFERED=1
+EXPOSE 8443
+ENTRYPOINT ["python", "-m", "gatekeeper_tpu.cmd.manager"]

@@ -1,0 +1,51 @@
+# gatekeeper-tpu build/test/bench targets.
+# Reference analogue: /root/reference/Makefile:34-48 (native-test / test /
+# manager / deploy); the engine here is jax so "manager" is a python entry
+# point and "bench" replaces the reference's (absent) perf harness.
+
+IMG ?= gatekeeper-tpu:latest
+PY ?= python
+
+.PHONY: all native-test test bench bench-quick demo manager worker \
+        docker-build deploy undeploy lint ci
+
+all: test
+
+# unit + integration tests on a virtual 8-device CPU mesh (conftest.py
+# forces jax_platforms=cpu; the reference's native-test is `go test ./...`)
+native-test:
+	$(PY) -m pytest tests/ -q
+
+test: native-test
+
+# the ONE-json-line benchmark contract (driver runs this on real TPU)
+bench:
+	$(PY) bench.py
+
+bench-quick:
+	GATEKEEPER_BENCH_QUICK=1 $(PY) bench.py
+
+# demo/basic flow end-to-end (1k namespaces + required-labels template)
+demo:
+	$(PY) -m gatekeeper_tpu.cmd.manager --demo --port -1
+
+manager:
+	$(PY) -m gatekeeper_tpu.cmd.manager
+
+worker:
+	$(PY) -m gatekeeper_tpu.cmd.worker
+
+docker-build:
+	docker build -t $(IMG) .
+
+# reference Makefile:48 `deploy` applies the manifest
+deploy:
+	kubectl apply -f deploy/gatekeeper-tpu.yaml
+
+undeploy:
+	kubectl delete -f deploy/gatekeeper-tpu.yaml
+
+lint:
+	$(PY) -m compileall -q gatekeeper_tpu
+
+ci: lint native-test

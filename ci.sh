@@ -1,0 +1,22 @@
+#!/usr/bin/env bash
+# CI entry point (reference analogue: Travis building Dockerfile_ci and
+# running `make test`).  Runs lint + the full suite on the virtual
+# 8-device CPU mesh, then the quick bench smoke.
+set -euo pipefail
+cd "$(dirname "$0")"
+
+echo "== lint =="
+python -m compileall -q gatekeeper_tpu
+
+echo "== tests (virtual 8-device CPU mesh) =="
+python -m pytest tests/ -q
+
+echo "== bench smoke (quick shapes) =="
+GATEKEEPER_BENCH_QUICK=1 GATEKEEPER_BENCH_N=20000 python bench.py > /tmp/bench.json
+python - <<'EOF'
+import json
+d = json.loads(open("/tmp/bench.json").read())
+assert d["metric"] and d["value"] > 0, d
+print("bench ok:", d["metric"], round(d["value"], 1), d["unit"])
+EOF
+echo "CI PASS"

@@ -95,7 +95,13 @@ class AuditManager:
                   "violations": 0, "constraints_updated": 0}
 
         # don't audit anything until the template CRD is deployed
-        if self.cluster.try_get(CRD_GVK, CRD_NAME) is None:
+        crd = self.cluster.try_get(CRD_GVK, CRD_NAME)
+        if crd is None:
+            # v1-first bootstrap stores the CRD under apiextensions v1
+            crd = self.cluster.try_get(
+                GVK("apiextensions.k8s.io", "v1",
+                    "CustomResourceDefinition"), CRD_NAME)
+        if crd is None:
             report["skipped"] = True
             return report
 

@@ -47,40 +47,48 @@ class Client:
     # ------------------------------------------------------------------
     # templates (client.go:211-300)
 
+    def _compile_template(self, tmpl: ConstraintTemplate):
+        """(compiled_by_target, crd) for a template.  Multi-target
+        templates compile per target (``spec.targets[]`` is plural in
+        the CRD, constrainttemplate_types.go:27-98; the framework keys
+        templates[target][Kind], client.go:211-213); the CRD's match
+        schema comes from the first target, mirroring the reference's
+        single-schema CRD build."""
+        if not tmpl.targets:
+            raise ClientError("template has no targets")
+        compiled_by_target: dict[str, CompiledTemplate] = {}
+        first_handler = None
+        for tt in tmpl.targets:
+            if tt.target in compiled_by_target:
+                raise ClientError(f"duplicate target {tt.target!r}")
+            handler = self.targets.get(tt.target)
+            if handler is None:
+                raise ClientError(f"unknown target {tt.target!r}")
+            if first_handler is None:
+                first_handler = handler
+            compiled_by_target[tt.target] = compile_target_rego(
+                tmpl.kind, tt.target, tt.rego)
+        return compiled_by_target, build_crd(tmpl, first_handler.match_schema())
+
     def create_crd(self, template_doc: dict) -> dict:
         """Validate the template and build its constraint CRD without
         registering anything (used by the webhook's synchronous template
         validation, policy.go:211-227)."""
-        tmpl = ConstraintTemplate.from_dict(template_doc)
-        if not tmpl.targets:
-            raise ClientError("template has no targets")
-        if len(tmpl.targets) > 1:
-            raise ClientError("multi-target templates are not supported")
-        tt = tmpl.targets[0]
-        handler = self.targets.get(tt.target)
-        if handler is None:
-            raise ClientError(f"unknown target {tt.target!r}")
-        compile_target_rego(tmpl.kind, tt.target, tt.rego)
-        return build_crd(tmpl, handler.match_schema())
+        _, crd = self._compile_template(ConstraintTemplate.from_dict(template_doc))
+        return crd
 
     def add_template(self, template_doc: dict) -> Responses:
         with self._lock.write():
             tmpl = ConstraintTemplate.from_dict(template_doc)
-            if not tmpl.targets:
-                raise ClientError("template has no targets")
-            if len(tmpl.targets) > 1:
-                raise ClientError("multi-target templates are not supported")
-            tt = tmpl.targets[0]
-            handler = self.targets.get(tt.target)
-            if handler is None:
-                raise ClientError(f"unknown target {tt.target!r}")
-            compiled = compile_target_rego(tmpl.kind, tt.target, tt.rego)
-            crd = build_crd(tmpl, handler.match_schema())
-            self.templates[tmpl.kind] = {tt.target: compiled}
+            compiled_by_target, crd = self._compile_template(tmpl)
+            self.templates[tmpl.kind] = compiled_by_target
             self.crds[tmpl.kind] = crd
             self.constraints.setdefault(tmpl.kind, {})
-            self.driver.put_template(tt.target, tmpl.kind, compiled)
-            return Responses(handled={tt.target: True})
+            handled = {}
+            for target, compiled in compiled_by_target.items():
+                self.driver.put_template(target, tmpl.kind, compiled)
+                handled[target] = True
+            return Responses(handled=handled)
 
     def remove_template(self, template_doc: dict) -> Responses:
         with self._lock.write():

@@ -21,6 +21,24 @@ CONSTRAINT_VERSION = "v1alpha1"
 _DNS1123 = re.compile(r"^[a-z0-9]([-a-z0-9]*[a-z0-9])?$")
 
 
+def crd_to_v1(doc: dict) -> dict:
+    """Convert a v1beta1 CustomResourceDefinition document to the
+    apiextensions v1 shape (spec.versions[] + per-version schema) —
+    v1beta1 was removed in Kubernetes 1.22, so real-cluster writes go
+    v1-first with this conversion."""
+    spec = doc.get("spec") or {}
+    schema = ((spec.get("validation") or {}).get("openAPIV3Schema")
+              or {"type": "object"})
+    schema = {**schema, "x-kubernetes-preserve-unknown-fields": True}
+    out_spec = {k: v for k, v in spec.items()
+                if k not in ("version", "validation")}
+    out_spec["versions"] = [{"name": spec.get("version", "v1"),
+                             "served": True, "storage": True,
+                             "schema": {"openAPIV3Schema": schema}}]
+    return {**doc, "apiVersion": "apiextensions.k8s.io/v1",
+            "spec": out_spec}
+
+
 def build_crd(template: ConstraintTemplate, match_schema: dict) -> dict:
     if not template.kind:
         raise ClientError("template has no CRD kind")

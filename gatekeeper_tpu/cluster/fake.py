@@ -193,6 +193,17 @@ class FakeCluster:
         for egvk, event in events:
             self._notify(egvk, event)
 
+    @staticmethod
+    def _crd_version(spec: dict) -> str:
+        """v1beta1 CRDs carry spec.version; v1 CRDs carry
+        spec.versions[] (the served one, or the first)."""
+        if spec.get("version"):
+            return spec["version"]
+        for v in spec.get("versions") or []:
+            if v.get("served", True):
+                return v.get("name", "")
+        return ""
+
     def _crd_served_gvk(self, obj: dict) -> GVK | None:
         if obj.get("kind") != "CustomResourceDefinition":
             return None
@@ -201,7 +212,7 @@ class FakeCluster:
         if not names.get("kind"):
             return None
         return GVK(group=spec.get("group", ""),
-                   version=spec.get("version", ""), kind=names["kind"])
+                   version=self._crd_version(spec), kind=names["kind"])
 
     def _cascade_crd_delete(self, crd: dict) -> list[tuple[GVK, Event]]:
         """Issue deletes for every CR of a CRD being deleted (with lock
@@ -229,21 +240,22 @@ class FakeCluster:
         CRD itself (with lock held)."""
         if self._objects.get(removed_gvk):
             return []
-        crd_gvk = GVK("apiextensions.k8s.io", "v1beta1",
-                      "CustomResourceDefinition")
         events: list[tuple[GVK, Event]] = []
-        store = self._objects.get(crd_gvk, {})
-        for key in list(store):
-            crd = store[key]
-            if not crd["metadata"].get("deletionTimestamp"):
-                continue
-            if crd["metadata"].get("finalizers"):
-                continue
-            if self._crd_served_gvk(crd) != removed_gvk:
-                continue
-            del store[key]
-            self._maybe_register_crd(crd, deleted=True)
-            events.append((crd_gvk, Event(DELETED, copy.deepcopy(crd))))
+        for crd_version in ("v1beta1", "v1"):
+            crd_gvk = GVK("apiextensions.k8s.io", crd_version,
+                          "CustomResourceDefinition")
+            store = self._objects.get(crd_gvk, {})
+            for key in list(store):
+                crd = store[key]
+                if not crd["metadata"].get("deletionTimestamp"):
+                    continue
+                if crd["metadata"].get("finalizers"):
+                    continue
+                if self._crd_served_gvk(crd) != removed_gvk:
+                    continue
+                del store[key]
+                self._maybe_register_crd(crd, deleted=True)
+                events.append((crd_gvk, Event(DELETED, copy.deepcopy(crd))))
         return events
 
     def get(self, gvk: GVK, name: str, namespace: str | None = None) -> dict:
@@ -311,7 +323,8 @@ class FakeCluster:
             return
         spec = obj.get("spec") or {}
         names = spec.get("names") or {}
-        gvk = GVK(group=spec.get("group", ""), version=spec.get("version", ""),
+        gvk = GVK(group=spec.get("group", ""),
+                  version=self._crd_version(spec),
                   kind=names.get("kind", ""))
         if not gvk.kind:
             return

@@ -42,31 +42,41 @@ from gatekeeper_tpu.webhook.server import DEFAULT_PORT, WebhookServer
 NS_GVK = GVK("", "v1", "Namespace")
 
 
-def bootstrap_cluster(cluster: FakeCluster) -> None:
+def bootstrap_cluster(cluster) -> None:
     """Install what deploy/gatekeeper.yaml installs: the base CRDs /
-    served kinds the controllers and audit manager expect."""
-    cluster.register_kind(TEMPLATE_GVK, "constrainttemplates")
-    cluster.register_kind(CONFIG_GVK, "configs")
-    cluster.register_kind(NS_GVK, "namespaces")
-    if cluster.try_get(GVK("apiextensions.k8s.io", "v1beta1",
-                           "CustomResourceDefinition"), CRD_NAME) is None:
-        cluster.create({
-            "apiVersion": "apiextensions.k8s.io/v1beta1",
-            "kind": "CustomResourceDefinition",
-            "metadata": {"name": CRD_NAME},
-            "spec": {"group": "templates.gatekeeper.sh",
-                     "version": "v1alpha1",
-                     "names": {"kind": "ConstraintTemplate",
-                               "plural": "constrainttemplates"}}})
+    served kinds the controllers and audit manager expect.  A real
+    apiserver (cluster.kube.KubeCluster) serves core kinds already and
+    gets only the ConstraintTemplate CRD applied; the FakeCluster also
+    needs its discovery seeded."""
+    if hasattr(cluster, "register_kind"):
+        cluster.register_kind(TEMPLATE_GVK, "constrainttemplates")
+        cluster.register_kind(CONFIG_GVK, "configs")
+        cluster.register_kind(NS_GVK, "namespaces")
+    from gatekeeper_tpu.webhook.bootstrap import apply_crd
+    apply_crd(cluster, CRD_NAME, "templates.gatekeeper.sh", "v1alpha1",
+              "ConstraintTemplate", "constrainttemplates")
+    apply_crd(cluster, "configs.config.gatekeeper.sh", "config.gatekeeper.sh",
+              "v1alpha1", "Config", "configs")
 
 
 class Manager:
     """Everything main() builds, held together for tests and the demo."""
 
     def __init__(self, args: argparse.Namespace,
-                 cluster: FakeCluster | None = None):
+                 cluster=None):
         self.metrics = Metrics()
-        self.cluster = cluster if cluster is not None else FakeCluster()
+        if cluster is not None:
+            self.cluster = cluster
+        elif getattr(args, "kubeconfig", None):
+            # real apiserver: the whole control plane binds to it through
+            # the cluster protocol (reference main.go:43-51)
+            from gatekeeper_tpu.cluster.kube import KubeCluster
+            self.cluster = KubeCluster.from_kubeconfig(args.kubeconfig)
+        else:
+            self.cluster = FakeCluster()
+        # async clusters deliver watch events on stream threads; the
+        # deterministic pump must settle instead of assuming inline events
+        self.async_cluster = not isinstance(self.cluster, FakeCluster)
         bootstrap_cluster(self.cluster)
         if getattr(args, "engine_worker_url", None):
             # engine-process split: the evaluation engine (and the TPU)
@@ -86,8 +96,16 @@ class Manager:
                                          batcher=self.batcher,
                                          metrics=self.metrics,
                                          log=lambda m: print(m, file=sys.stderr))
-        self.webhook = WebhookServer(self.handler, port=args.port) \
+        # TLS engages when the cert dir exists (reference /certs,
+        # policy.go:76-79); otherwise plain HTTP (tests/demo)
+        import os as _os
+        cert_dir = getattr(args, "cert_dir", None)
+        cert_dir = cert_dir if cert_dir and _os.path.isdir(cert_dir) else None
+        self.webhook = WebhookServer(self.handler, port=args.port,
+                                     cert_dir=cert_dir) \
             if args.port >= 0 else None
+        self._manual_deploy = getattr(args, "enable_manual_deploy", False)
+        self._cert_dir = cert_dir
         self.audit = AuditManager(self.cluster, self.client,
                                   interval=args.audit_interval,
                                   violations_limit=args.constraint_violations_limit,
@@ -101,6 +119,15 @@ class Manager:
         self.batcher.start()
         if self.webhook is not None:
             self.webhook.start()
+            if self.webhook.tls and not self._manual_deploy:
+                # self-register the ValidatingWebhookConfiguration +
+                # cert secret + service (policy.go:81-100)
+                from gatekeeper_tpu.webhook.bootstrap import bootstrap_webhook
+                try:
+                    bootstrap_webhook(self.cluster, self._cert_dir,
+                                      self.webhook.port)
+                except Exception as e:
+                    print(f"webhook bootstrap failed: {e}", file=sys.stderr)
         self.audit.start()
         # roster poll loop (reference updateManagerLoop, 5 s —
         # watch/manager.go:165-178): a GVK whose CRD becomes served
@@ -149,6 +176,16 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--watch-poll-interval", type=float, default=5.0,
                    help="watch roster poll period in seconds "
                         "(watch/manager.go:172)")
+    p.add_argument("--kubeconfig", default=None,
+                   help="kubeconfig path for a real apiserver; absent -> "
+                        "in-memory cluster (tests/demo) unless running "
+                        "in-cluster")
+    p.add_argument("--cert-dir", default="/certs",
+                   help="TLS cert dir for the webhook server "
+                        "(tls.crt/tls.key, policy.go:76-79)")
+    p.add_argument("--enable-manual-deploy", action="store_true",
+                   help="skip self-registering the "
+                        "ValidatingWebhookConfiguration (policy.go:81-100)")
     p.add_argument("--demo", action="store_true",
                    help="seed demo/basic (1k namespaces + required-labels) "
                         "and run one audit sweep")
@@ -192,7 +229,7 @@ def run_demo(mgr: Manager, n_namespaces: int = 1000) -> dict:
                         '  msg := sprintf("you must provide labels: %v", '
                         '[missing])\n}\n'}]},
     })
-    mgr.plane.run_until_idle()
+    mgr.plane.run_until_idle(settle=2.0 if mgr.async_cluster else 0.0)
     cluster.create({
         "apiVersion": "constraints.gatekeeper.sh/v1alpha1",
         "kind": "K8sRequiredLabels",
@@ -201,7 +238,7 @@ def run_demo(mgr: Manager, n_namespaces: int = 1000) -> dict:
                                       "kinds": ["Namespace"]}]},
                  "parameters": {"labels": ["gatekeeper"]}},
     })
-    mgr.plane.run_until_idle()
+    mgr.plane.run_until_idle(settle=2.0 if mgr.async_cluster else 0.0)
     report = mgr.audit.audit_once()
     con = cluster.get(GVK("constraints.gatekeeper.sh", "v1alpha1",
                           "K8sRequiredLabels"), "ns-must-have-gk")

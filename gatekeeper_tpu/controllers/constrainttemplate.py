@@ -30,6 +30,35 @@ from gatekeeper_tpu.watch.manager import Registrar
 
 TEMPLATE_GVK = GVK("templates.gatekeeper.sh", "v1alpha1", "ConstraintTemplate")
 CRD_GVK = GVK("apiextensions.k8s.io", "v1beta1", "CustomResourceDefinition")
+CRD_V1_GVK = GVK("apiextensions.k8s.io", "v1", "CustomResourceDefinition")
+
+
+def crd_try_get(cluster, name: str):
+    """Look the constraint CRD up under either apiextensions version
+    (v1-first real clusters store it under v1)."""
+    found = cluster.try_get(CRD_GVK, name)
+    if found is None:
+        found = cluster.try_get(CRD_V1_GVK, name)
+    return found
+
+
+def crd_create(cluster, crd: dict) -> None:
+    """Create the constraint CRD, converting to apiextensions v1 when
+    the apiserver no longer serves v1beta1 (k8s >= 1.22)."""
+    from gatekeeper_tpu.client.crd_helpers import crd_to_v1
+    try:
+        cluster.create(crd)
+    except NotFoundError:
+        cluster.create(crd_to_v1(crd))
+
+
+def crd_delete(cluster, name: str) -> None:
+    try:
+        cluster.delete(CRD_GVK, name)
+    except NotFoundError:
+        cluster.delete(CRD_V1_GVK, name)
+
+
 CONSTRAINT_GROUP = "constraints.gatekeeper.sh"
 FINALIZER = "constrainttemplate.finalizers.gatekeeper.sh"
 
@@ -89,7 +118,7 @@ class ReconcileConstraintTemplate(Reconciler):
         if terminating:
             return self._handle_delete(instance, crd)
         crd_name = (crd.get("metadata") or {}).get("name", "")
-        found = self.cluster.try_get(CRD_GVK, crd_name)
+        found = crd_try_get(self.cluster, crd_name)
         if found is None:
             return self._handle_create(instance, crd)
         return self._handle_update(instance, crd, found)
@@ -106,7 +135,7 @@ class ReconcileConstraintTemplate(Reconciler):
             return DONE
         self.watcher.add_watch(make_constraint_gvk(_template_kind(instance)))
         try:
-            self.cluster.create(crd)
+            crd_create(self.cluster, crd)
         except AlreadyExistsError:
             pass  # another replica won the create race (HA note at :210)
         instance.setdefault("status", {})["created"] = True
@@ -120,6 +149,10 @@ class ReconcileConstraintTemplate(Reconciler):
         if not self._add_template(instance):
             return DONE
         self.watcher.add_watch(make_constraint_gvk(_template_kind(instance)))
+        if found.get("apiVersion") == "apiextensions.k8s.io/v1":
+            # compare/update in the stored object's shape, not ours
+            from gatekeeper_tpu.client.crd_helpers import crd_to_v1
+            crd = crd_to_v1(crd)
         if crd.get("spec") != found.get("spec"):
             found["spec"] = crd["spec"]
             try:
@@ -138,10 +171,10 @@ class ReconcileConstraintTemplate(Reconciler):
             return DONE
         crd_name = (crd.get("metadata") or {}).get("name", "")
         try:
-            self.cluster.delete(CRD_GVK, crd_name)
+            crd_delete(self.cluster, crd_name)
         except NotFoundError:
             pass
-        if self.cluster.try_get(CRD_GVK, crd_name) is not None:
+        if crd_try_get(self.cluster, crd_name) is not None:
             # child CRD not gone yet (constraints still finalizing):
             # keep their watch alive and requeue
             self.watcher.add_watch(make_constraint_gvk(_template_kind(instance)))

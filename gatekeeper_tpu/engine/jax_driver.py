@@ -50,6 +50,20 @@ from gatekeeper_tpu.rego.values import freeze
 from gatekeeper_tpu.utils.metrics import Metrics
 
 
+class _TrivialMatch:
+    """Sentinel mask: every alive row matches every constraint (no
+    spec.match anywhere).  Indexable like the real mask so host-side
+    candidate checks stay uniform."""
+
+    def __getitem__(self, _idx):
+        return True
+
+    def __bool__(self):
+        return True
+
+
+TRIVIAL_MATCH = _TrivialMatch()
+
 SMALL_WORKLOAD_EVALS = 20_000
 """Below this many (resource, constraint) pairs per kind, the scalar
 engine beats the device path: a single device dispatch+fetch costs a
@@ -65,6 +79,11 @@ class JaxTargetState(TargetState):
         self.bindings_cache: dict[str, tuple] = {}  # kind -> (gen, ver, b)
         self.bindings_retired: dict[str, tuple] = {}  # kind -> (ver, old b)
         self.mask_cache: dict[str, tuple] = {}
+        # kind -> the padded mask currently installed as a bindings
+        # __match__ array: that buffer may still be referenced by host
+        # formatting and by the device cache, so the mask ping-pong must
+        # never overwrite it in place
+        self.installed_match: dict[str, object] = {}
         self.rank_cache: tuple | None = None       # (generation, rank arr)
         self.order_cache: tuple | None = None      # (gen, ordered_rows, row_order)
         self.fmt_cache: dict[str, tuple] = {}      # kind -> (con_ver, {(cname,row): (ver, results)})
@@ -93,6 +112,13 @@ class JaxDriver(LocalDriver):
             mesh = make_mesh()          # a real failure here should raise
         self.executor = ProgramExecutor(mesh=mesh)
         self.metrics = Metrics()
+        # serializes reader-side cache fills (bindings/mask delta prep):
+        # racing audit readers would otherwise interleave interner
+        # appends and column/cache mutations across different kinds —
+        # NOT the identical computation the RWLock benign-race argument
+        # assumes.  Execution and host formatting stay concurrent.
+        import threading as _threading
+        self._prep_lock = _threading.Lock()
 
     # ------------------------------------------------------------------
 
@@ -152,6 +178,13 @@ class JaxDriver(LocalDriver):
         engine = self._match_engine(st, target)
         if engine is None:
             return None, None, None
+        if all(not (c.get("spec") or {}).get("match") for c in constraints):
+            # no constraint carries match criteria: every alive resource
+            # matches (kinds default to wildcard, target.go:147-173).
+            # TRIVIAL sentinel: the device gates on __alive__ alone and
+            # no [C, R] mask is built or shipped — at 1M rows the mask
+            # upload dominates cold start through a thin transport.
+            return TRIVIAL_MATCH, None, None
         table = st.table
         gen, remap = table.generation, table.remap_generation
         conver = self.con_version_of(st, kind)
@@ -168,11 +201,15 @@ class JaxDriver(LocalDriver):
             old = hit[3]            # retired (gen, padded) or None
             # ping-pong: overwrite the retired buffer (two updates old)
             # at the rows dirty since ITS generation — O(|dirty|) writes
-            # instead of an O(c_pad*r_pad) copy.  Requires no Namespace
-            # churn since the buffer's generation (namespaceSelector
-            # results of untouched rows would be stale in it).
+            # instead of an O(c_pad*r_pad) copy.  Requires (a) no
+            # Namespace churn since the buffer's generation
+            # (namespaceSelector results of untouched rows would be
+            # stale in it) and (b) the buffer not being the one
+            # currently installed in the bindings arrays (host/device
+            # references must see immutable content).
             if old is not None and old[1].shape == (c_pad, r_pad) \
                     and old[1] is not hit[2] \
+                    and old[1] is not st.installed_match.get(kind) \
                     and not table.namespaces_dirty_since(old[0]):
                 target, since = old[1], min(old[0], prev_gen)
             elif not table.namespaces_dirty_since(prev_gen):
@@ -196,7 +233,11 @@ class JaxDriver(LocalDriver):
                         table.dirty_rows_since(prev_gen)
                     st.mask_cache[kind] = ((gen, conver), (conver, remap),
                                            target, (prev_gen, hit[2]))
-                    return target[:n_con, :n], base_rows, target
+                    # the delta is only meaningful relative to hit[2]:
+                    # the device-sync consumer must verify ITS base is
+                    # that exact buffer (scalar-sweep interludes advance
+                    # the mask without advancing the device)
+                    return target[:n_con, :n], (hit[2], base_rows), target
         padded = np.zeros((c_pad, r_pad), dtype=bool)
         padded[:n_con, :n] = engine.mask(constraints)
         st.mask_cache[kind] = ((gen, conver), (conver, remap), padded, None)
@@ -237,8 +278,9 @@ class JaxDriver(LocalDriver):
         st.bindings_cache[kind] = (key, bindings)
         return bindings
 
-    def _install_gates(self, bindings, mask: np.ndarray | None,
-                       mask_dirty: np.ndarray | None,
+    def _install_gates(self, st, kind: str, bindings,
+                       mask: np.ndarray | None,
+                       mask_delta: tuple | None,
                        rank: np.ndarray | None,
                        padded: np.ndarray | None = None) -> None:
         """Attach the padded match mask and rank as regular bindings
@@ -246,14 +288,27 @@ class JaxDriver(LocalDriver):
         device cache + scatter-update path as the columns (the executor
         then needs no separate match/rank plumbing, and the sharded
         path shards them by their declared axes).  `padded` is the
-        mask's canonical padded form from _kind_mask — installed without
-        any copy when its shape matches the bindings buckets."""
+        mask's canonical padded form from _kind_mask, installed without
+        any copy; `mask_delta` = (base_buffer, rows) states which buffer
+        the dirty rows are relative to — a device scatter is recorded
+        ONLY when the bindings' inherited __match__ IS that buffer
+        (scalar-sweep interludes advance the mask cache without touching
+        the device; syncing the wrong frame would under-approximate)."""
         # NOTE: bindings.arrays / base_dirty are REBOUND (never mutated
         # in place): concurrent readers (RWLock shares queries) may be
         # iterating the old dicts — racing installs produce identical
         # dicts and last-write-wins is benign, mid-iteration mutation
         # would not be.
         d = bindings.__dict__
+        if mask is TRIVIAL_MATCH:
+            if "__match__" in bindings.arrays:
+                # constraints lost their match criteria: drop the stale
+                # gate (alive-only gating is exact now)
+                bindings.arrays = {k: v for k, v in bindings.arrays.items()
+                                   if k != "__match__"}
+                d.pop("_match_src", None)
+            st.installed_match.pop(kind, None)
+            mask = None
         if mask is not None and bindings.arrays.get("__match__") is not padded \
                 and d.get("_match_src") is not mask:
             if padded is None or \
@@ -264,10 +319,12 @@ class JaxDriver(LocalDriver):
             old = bindings.arrays.get("__match__")
             bindings.arrays = {**bindings.arrays, "__match__": padded}
             d["_match_src"] = mask
-            if bindings.base is not None and mask_dirty is not None \
-                    and old is not None and old.shape == padded.shape:
+            st.installed_match[kind] = padded
+            if bindings.base is not None and mask_delta is not None \
+                    and old is not None and old is mask_delta[0] \
+                    and old.shape == padded.shape:
                 bindings.base_dirty = {**bindings.base_dirty,
-                                       "__match__": mask_dirty}
+                                       "__match__": mask_delta[1]}
         if rank is not None and d.get("_rank_src") is not rank:
             from gatekeeper_tpu.engine.veval import pad_rank
             bindings.arrays = {**bindings.arrays,
@@ -328,29 +385,34 @@ class JaxDriver(LocalDriver):
         specs: list[tuple] = []
         futures: list = []
         try:
-            for kind in sorted(st.templates):
-                compiled = st.templates[kind]
-                constraints = self._kind_constraints(st, kind)
-                if not constraints:
-                    continue
-                mask, mask_dirty, padded = self._kind_mask(st, target, kind,
-                                                           constraints)
-                small = len(ordered_rows) * len(constraints) < SMALL_WORKLOAD_EVALS
-                if compiled.vectorized is not None and mask is not None and not small:
-                    bindings = self._kind_bindings(st, kind, compiled, constraints)
-                    self._install_gates(bindings, mask, mask_dirty, rank, padded)
-                    prog = compiled.vectorized.program
-                    mode = "topk" if limit is not None else "mask"
-                    spec = (mode, kind, compiled, constraints, prog,
-                            bindings, mask)
-                    futures.append(pool.submit(dispatch, spec))
-                else:
-                    # unlowerable template — or a workload too small to
-                    # amortize a device dispatch round-trip
-                    spec = ("scalar", kind, compiled, constraints, None,
-                            None, mask)
-                    futures.append(None)
-                specs.append(spec)
+            with self._prep_lock:
+                for kind in sorted(st.templates):
+                    compiled = st.templates[kind]
+                    constraints = self._kind_constraints(st, kind)
+                    if not constraints:
+                        continue
+                    mask, mask_dirty, padded = self._kind_mask(
+                        st, target, kind, constraints)
+                    small = len(ordered_rows) * len(constraints) \
+                        < SMALL_WORKLOAD_EVALS
+                    if compiled.vectorized is not None and mask is not None \
+                            and not small:
+                        bindings = self._kind_bindings(st, kind, compiled,
+                                                       constraints)
+                        self._install_gates(st, kind, bindings, mask,
+                                            mask_dirty, rank, padded)
+                        prog = compiled.vectorized.program
+                        mode = "topk" if limit is not None else "mask"
+                        spec = (mode, kind, compiled, constraints, prog,
+                                bindings, mask)
+                        futures.append(pool.submit(dispatch, spec))
+                    else:
+                        # unlowerable template — or a workload too small
+                        # to amortize a device dispatch round-trip
+                        spec = ("scalar", kind, compiled, constraints, None,
+                                None, mask)
+                        futures.append(None)
+                    specs.append(spec)
             handles = [f.result() if f is not None else None for f in futures]
         finally:
             pool.shutdown(wait=False)
@@ -507,10 +569,11 @@ class JaxDriver(LocalDriver):
         ci = names.index(constraint_name)
         if compiled.vectorized is None:
             return f"template {kind!r} runs on the scalar engine (not lowered)"
-        bindings = self._kind_bindings(st, kind, compiled, constraints)
-        mask, _, _ = self._kind_mask(st, target, kind, constraints)
+        with self._prep_lock:
+            bindings = self._kind_bindings(st, kind, compiled, constraints)
+            mask, _, _ = self._kind_mask(st, target, kind, constraints)
         out = explain(compiled.vectorized.program, bindings, ci, row,
-                      match=mask)
+                      match=mask if mask is not TRIVIAL_MATCH else None)
         handler = self.targets[target]
         meta = st.table.meta_at(row)
         review = handler.make_review(meta, st.table.object_at(row))

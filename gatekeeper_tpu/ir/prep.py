@@ -428,9 +428,9 @@ def build_inv_join(req: InvJoinReq, table: ResourceTable,
     n = table.n_rows
     kid = interner.lookup(req.kind)
     out = np.zeros((r_pad,), dtype=bool)
-    src = table.column(ColSpec(req.src_path, "val")).ids
     if kid == MISSING or n == 0:
-        return out
+        return out      # joined kind uncached: O(1), no column build
+    src = table.column(ColSpec(req.src_path, "val")).ids
     sel = ident.alive & (ident.kind_ids == kid)
     if req.namespaced_only:
         sel &= ident.ns_ids != MISSING

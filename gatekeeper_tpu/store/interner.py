@@ -13,6 +13,8 @@ columns.
 
 from __future__ import annotations
 
+import threading
+
 import numpy as np
 
 MISSING = -1
@@ -27,6 +29,15 @@ class Interner:
         self._bytes_cache: np.ndarray | None = None
         self._len_cache: np.ndarray | None = None
         self._cache_size = 0
+        # guards the append path only: concurrent readers doing delta
+        # cache fills may intern DIFFERENT new strings (the
+        # identical-computation argument holds per kind, not across
+        # kinds), and an unguarded read-len-then-append interleaving
+        # would assign one id to two strings.  The hit path stays
+        # lock-free (dict reads are atomic); the native extractor holds
+        # the GIL across its whole per-string intern, and bulk callers
+        # additionally serialize under the driver prep lock.
+        self._lock = threading.Lock()
 
     def __len__(self) -> int:
         return len(self._strings)
@@ -34,9 +45,12 @@ class Interner:
     def intern(self, s: str) -> int:
         i = self._ids.get(s)
         if i is None:
-            i = len(self._strings)
-            self._ids[s] = i
-            self._strings.append(s)
+            with self._lock:
+                i = self._ids.get(s)
+                if i is None:
+                    i = len(self._strings)
+                    self._strings.append(s)
+                    self._ids[s] = i
         return i
 
     def lookup(self, s: str) -> int:

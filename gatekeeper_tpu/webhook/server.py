@@ -21,10 +21,17 @@ DEFAULT_PORT = 8443          # the reference defaults to 443 (policy.go:48)
 
 
 class WebhookServer:
+    """Serves /v1/admit (+ /metrics).  With ``cert_dir`` holding
+    tls.crt/tls.key the server speaks HTTPS — the reference serves the
+    webhook over TLS from cert dir /certs (policy.go:76-79); an
+    apiserver will not call back over plain HTTP."""
+
     def __init__(self, handler: ValidationHandler, port: int = DEFAULT_PORT,
-                 host: str = "127.0.0.1", metrics=None):
+                 host: str = "127.0.0.1", metrics=None,
+                 cert_dir: str | None = None):
         self.handler = handler
         self.metrics = metrics if metrics is not None else handler.metrics
+        self.cert_dir = cert_dir
         outer = self
 
         class _HTTPHandler(BaseHTTPRequestHandler):
@@ -71,6 +78,18 @@ class WebhookServer:
                     self.send_error(400, str(e))
 
         self._server = ThreadingHTTPServer((host, port), _HTTPHandler)
+        self.tls = False
+        if cert_dir:
+            import os
+            import ssl
+            crt = os.path.join(cert_dir, "tls.crt")
+            key = os.path.join(cert_dir, "tls.key")
+            if os.path.exists(crt) and os.path.exists(key):
+                ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+                ctx.load_cert_chain(crt, key)
+                self._server.socket = ctx.wrap_socket(
+                    self._server.socket, server_side=True)
+                self.tls = True
         self.port = self._server.server_address[1]
         self._thread: threading.Thread | None = None
 

@@ -1,0 +1,264 @@
+"""HTTP apiserver stub: the k8s REST subset KubeCluster speaks, backed
+by a FakeCluster (whose apiserver semantics — optimistic concurrency,
+finalizers, CRD discovery, cascading deletes — are already the test
+oracle).  This is the envtest-equivalent fixture for the real-cluster
+adapter: KubeCluster -> HTTP -> FakeCluster must behave exactly like
+the FakeCluster used directly.
+
+Serves: discovery (/api/v1, /apis/<g>/<v>), namespaced + cluster-scoped
+collections (GET list / POST create), items (GET/PUT/DELETE), and
+chunked watch streams (?watch=1) fed from FakeCluster.watch.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from gatekeeper_tpu.api.config import GVK
+from gatekeeper_tpu.cluster.fake import FakeCluster
+from gatekeeper_tpu.errors import (AlreadyExistsError, ApiConflictError,
+                                   ApiError, NotFoundError)
+
+
+class FakeApiServer:
+    def __init__(self, cluster: FakeCluster | None = None):
+        self.cluster = cluster if cluster is not None else FakeCluster()
+        # per-GVK event log (the apiserver watch cache): list responses
+        # carry the log position as resourceVersion, watch requests
+        # replay from it — no list->stream gap, like a real apiserver
+        self._log: dict = {}
+        self._log_lock = threading.Lock()
+        self._log_subs: set = set()
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *args):
+                pass
+
+            # -- helpers --------------------------------------------
+
+            def _send(self, code: int, doc: dict):
+                payload = json.dumps(doc).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def _err(self, e: Exception):
+                code = 500
+                reason = "InternalError"
+                if isinstance(e, NotFoundError):
+                    code, reason = 404, "NotFound"
+                elif isinstance(e, AlreadyExistsError):
+                    code, reason = 409, "AlreadyExists"
+                elif isinstance(e, ApiConflictError):
+                    code, reason = 409, "Conflict"
+                elif isinstance(e, ApiError):
+                    code, reason = 422, "Invalid"
+                self._send(code, {"kind": "Status", "status": "Failure",
+                                  "reason": reason, "message": str(e),
+                                  "code": code})
+
+            def _route(self):
+                """path -> (group_version, gvk, namespace, name|None) or
+                ('discovery', group_version) or None."""
+                parts = [p for p in self.path.split("?")[0].split("/") if p]
+                if parts[:1] == ["api"]:
+                    parts = parts[1:]
+                    if not parts:
+                        return None
+                    gv_parts = [parts[0]]
+                    rest = parts[1:]
+                    group = ""
+                    version = parts[0]
+                elif parts[:1] == ["apis"]:
+                    parts = parts[1:]
+                    if len(parts) < 2:
+                        return None
+                    group, version = parts[0], parts[1]
+                    rest = parts[2:]
+                else:
+                    return None
+                gv = f"{group}/{version}" if group else version
+                if not rest:
+                    return ("discovery", gv)
+                ns = None
+                if rest[0] == "namespaces" and len(rest) >= 3:
+                    ns = rest[1]
+                    rest = rest[2:]
+                plural = rest[0]
+                name = rest[1] if len(rest) > 1 else None
+                # resolve plural -> kind via cluster discovery
+                try:
+                    kinds = outer.cluster.server_resources_for_group_version(gv)
+                except NotFoundError:
+                    kinds = []
+                kind = next((k["kind"] for k in kinds if k["name"] == plural),
+                            None)
+                if kind is None:
+                    return ("missing", gv)
+                return (gv, GVK(group=group, version=version, kind=kind),
+                        ns, name)
+
+            def _body(self) -> dict:
+                length = int(self.headers.get("Content-Length", "0"))
+                return json.loads(self.rfile.read(length) or b"{}")
+
+            # -- verbs ----------------------------------------------
+
+            def do_GET(self):
+                r = self._route()
+                if r is None:
+                    return self._err(NotFoundError(self.path))
+                if r[0] == "discovery":
+                    try:
+                        res = outer.cluster \
+                            .server_resources_for_group_version(r[1])
+                    except NotFoundError as e:
+                        return self._err(e)
+                    return self._send(200, {
+                        "kind": "APIResourceList", "groupVersion": r[1],
+                        "resources": [{"kind": k["kind"], "name": k["name"],
+                                       "namespaced": True}
+                                      for k in res]})
+                if r[0] == "missing":
+                    return self._err(NotFoundError(self.path))
+                _, gvk, ns, name = r
+                if name is not None:
+                    try:
+                        return self._send(200,
+                                          outer.cluster.get(gvk, name, ns)
+                                          if ns is not None else
+                                          outer.cluster.get(gvk, name))
+                    except ApiError as e:
+                        # namespaced get via cluster path, or vice versa
+                        obj = None
+                        for o in outer.cluster.list(gvk):
+                            m = o.get("metadata") or {}
+                            if m.get("name") == name and \
+                                    (ns is None or m.get("namespace") == ns):
+                                obj = o
+                        if obj is None:
+                            return self._err(e)
+                        return self._send(200, obj)
+                if "watch=1" in self.path:
+                    return self._watch(gvk)
+                outer._ensure_logged(gvk)
+                with outer._log_lock:
+                    items = outer.cluster.list(gvk)
+                    pos = len(outer._log.get(gvk, []))
+                return self._send(200, {
+                    "kind": f"{gvk.kind}List", "items": items,
+                    "metadata": {"resourceVersion": str(pos)}})
+
+            def _watch(self, gvk: GVK):
+                outer._ensure_logged(gvk)
+                # replay position from ?resourceVersion=N (log index)
+                pos = 0
+                for part in self.path.split("?", 1)[-1].split("&"):
+                    if part.startswith("resourceVersion="):
+                        try:
+                            pos = int(part.split("=", 1)[1] or "0")
+                        except ValueError:
+                            pos = 0
+                try:
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Transfer-Encoding", "chunked")
+                    self.end_headers()
+
+                    def write_chunk(data: bytes):
+                        self.wfile.write(f"{len(data):x}\r\n".encode()
+                                         + data + b"\r\n")
+                        self.wfile.flush()
+
+                    while not outer._stopping:
+                        with outer._log_lock:
+                            log = outer._log.get(gvk, [])
+                            start = pos
+                            pending = log[pos:]
+                            pos = len(log)
+                        for i, ev in enumerate(pending):
+                            obj = dict(ev.obj)
+                            meta = dict(obj.get("metadata") or {})
+                            # surface the LOG position as the object rv
+                            # so the client resumes from exactly here
+                            meta["resourceVersion"] = str(start + i + 1)
+                            obj["metadata"] = meta
+                            line = json.dumps(
+                                {"type": ev.type, "object": obj}).encode() \
+                                + b"\n"
+                            write_chunk(line)
+                        if not pending:
+                            threading.Event().wait(0.05)
+                except (BrokenPipeError, ConnectionResetError, OSError):
+                    pass
+
+            def do_POST(self):
+                r = self._route()
+                if r is None or r[0] in ("discovery", "missing"):
+                    # CRD creation bootstraps discovery: allow POSTing
+                    # apiextensions CRDs even before the kind is routed
+                    return self._err(NotFoundError(self.path))
+                try:
+                    return self._send(201, outer.cluster.create(self._body()))
+                except ApiError as e:
+                    return self._err(e)
+
+            def do_PUT(self):
+                r = self._route()
+                if r is None or r[0] in ("discovery", "missing"):
+                    return self._err(NotFoundError(self.path))
+                try:
+                    return self._send(200, outer.cluster.update(self._body()))
+                except ApiError as e:
+                    return self._err(e)
+
+            def do_DELETE(self):
+                r = self._route()
+                if r is None or r[0] in ("discovery", "missing"):
+                    return self._err(NotFoundError(self.path))
+                _, gvk, ns, name = r
+                try:
+                    outer.cluster.delete(gvk, name, ns)
+                    return self._send(200, {"kind": "Status",
+                                            "status": "Success"})
+                except ApiError as e:
+                    return self._err(e)
+
+        self._stopping = False
+        self._server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self._server.server_address[1]
+        self.url = f"http://127.0.0.1:{self.port}"
+        self._thread: threading.Thread | None = None
+
+    def _ensure_logged(self, gvk) -> None:
+        """Subscribe the event log to a GVK (idempotent)."""
+        with self._log_lock:
+            if gvk in self._log_subs:
+                return
+            self._log_subs.add(gvk)
+            self._log.setdefault(gvk, [])
+
+        def append(ev):
+            with self._log_lock:
+                self._log[gvk].append(ev)
+        self.cluster.watch(gvk, append)
+
+    def start(self) -> "FakeApiServer":
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True, name="fake-apiserver")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stopping = True
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)

@@ -324,3 +324,61 @@ class TestClientValidation:
         backend.new_client([TestTarget()])
         with pytest.raises(ClientError, match="one client"):
             backend.new_client([TestTarget()])
+
+
+class SecondTarget(TestTarget):
+    """A second registered target for multi-target templates."""
+
+    name = "second.target"
+
+    def process_data(self, obj):
+        if isinstance(obj, dict) and "Alias" in obj:
+            meta = ResourceMeta(api_version="v1", kind="AliasData",
+                                name=obj["Alias"], namespace=None)
+            return obj["Alias"], meta, obj
+        raise UnhandledData(f"unhandled: {obj!r}")
+
+    def handle_review(self, obj):
+        if isinstance(obj, dict) and "Alias" in obj:
+            return obj
+        raise UnhandledData(f"unhandled review: {obj!r}")
+
+
+@pytest.mark.parametrize("driver_name", DRIVERS)
+def test_multi_target_template(driver_name):
+    """spec.targets[] is plural (constrainttemplate_types.go:27-98) and
+    the framework keys templates[target][Kind] (client.go:211-213):
+    one template with two targets must review/audit through BOTH."""
+    backend = Backend(make_driver(driver_name))
+    client = backend.new_client([TestTarget(), SecondTarget()])
+    doc = template_doc("K8sMulti", DENY_ALL)
+    doc["spec"]["targets"] = [
+        {"target": "test.target", "rego": DENY_ALL},
+        {"target": "second.target",
+         "rego": 'package foo\nviolation[{"msg": "ALIAS-DENIED", '
+                 '"details": {}}] { 1 == 1 }'},
+    ]
+    resp = client.add_template(doc)
+    assert resp.handled == {"test.target": True, "second.target": True}
+    client.add_constraint(constraint_doc("K8sMulti", "deny"))
+    client.add_data({"Name": "n1", "ForConstraint": "K8sMulti"})
+    client.add_data({"Alias": "a1", "ForConstraint": "K8sMulti"})
+
+    # review routes per target via handle_review
+    r1 = client.review({"Name": "x", "ForConstraint": "K8sMulti"})
+    assert [r.msg for r in r1.results()] == ["DENIED"]
+    assert list(r1.by_target) == ["test.target"]
+    r2 = client.review({"Alias": "y", "ForConstraint": "K8sMulti"})
+    assert [r.msg for r in r2.results()] == ["ALIAS-DENIED"]
+    assert list(r2.by_target) == ["second.target"]
+
+    # audit spans both targets' caches with per-target rego
+    audit = client.audit()
+    by_target = {t: [r.msg for r in resp.results]
+                 for t, resp in audit.by_target.items()}
+    assert by_target == {"test.target": ["DENIED"],
+                         "second.target": ["ALIAS-DENIED"]}
+
+    # removal unregisters from every target
+    client.remove_template(doc)
+    assert client.audit().results() == []

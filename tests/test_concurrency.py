@@ -8,6 +8,7 @@ assert no exceptions, no torn state, and a consistent final audit."""
 
 import random
 import threading
+import traceback
 
 from gatekeeper_tpu.client.client import Backend
 from gatekeeper_tpu.client.interface import QueryOpts
@@ -50,16 +51,16 @@ def test_concurrent_reviews_audits_and_churn():
                    "operation": "CREATE", "object": pod}
             try:
                 batcher.submit(req)
-            except Exception as e:   # noqa: BLE001 - collecting for assert
-                errors.append(("review", e))
+            except Exception:   # noqa: BLE001 - collecting for assert
+                errors.append(("review", traceback.format_exc()))
 
     def auditor():
         while not stop.is_set():
             try:
                 c.driver.query_audit("admission.k8s.gatekeeper.sh",
                                      QueryOpts(limit_per_constraint=5))
-            except Exception as e:
-                errors.append(("audit", e))
+            except Exception:
+                errors.append(("audit", traceback.format_exc()))
 
     def churner(seed):
         rng = random.Random(1000 + seed)
@@ -74,8 +75,8 @@ def test_concurrent_reviews_audits_and_churn():
                         "K8sAllowedRepos", "gcr-only", {"repos": ["gcr.io/"]}))
                 else:
                     c.add_data(_rand_pod(rng, rng.randrange(80)))
-            except Exception as e:
-                errors.append(("churn", e))
+            except Exception:
+                errors.append(("churn", traceback.format_exc()))
 
     threads = [threading.Thread(target=reviewer, args=(i,)) for i in range(4)]
     threads += [threading.Thread(target=auditor) for _ in range(2)]

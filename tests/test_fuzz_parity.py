@@ -25,8 +25,23 @@ PROBES = ["livenessProbe", "readinessProbe", "startupProbe"]
 
 def gen_conjunct(rng):
     """(body_line, needs_container, needs_env) from the pattern menu."""
-    kind = rng.randrange(10)
+    kind = rng.randrange(13)
     neg = "not " if rng.random() < 0.35 else ""
+    if kind == 10:
+        # compound-value equality (a round-1 soundness trap: must not
+        # under- or over-approximate on device)
+        v = rng.choice(['{"httpGet": {}}', '{"exec": {}}', "false"])
+        return (f'{neg}container["{rng.choice(PROBES)}"] == {v}', 1, 0)
+    if kind == 11:
+        # arithmetic over leaf + constant
+        return (f"input.review.object.spec.replicas * "
+                f"{rng.randint(1, 3)} + 1 "
+                f"{rng.choice(['>', '<='])} {rng.randrange(8)}", 0, 0)
+    if kind == 12:
+        # arithmetic with a constraint parameter
+        return (f"count(input.review.object.spec.containers) + "
+                f"input.constraint.spec.parameters.slack "
+                f"{rng.choice(['>=', '<'])} {rng.randrange(5)}", 0, 0)
     if kind == 0:
         return (f'{neg}input.review.object.metadata.labels["'
                 f'{rng.choice(LABELS)}"] == "{rng.choice(VALUES)}"', 0, 0)
@@ -57,7 +72,7 @@ def gen_conjunct(rng):
             f"{rng.choice(['>', '<='])} {rng.randrange(5)}", 0, 0)
 
 
-def gen_template(rng, i):
+def gen_rule(rng, i, ri):
     n = rng.randint(1, 3)
     parts = [gen_conjunct(rng) for _ in range(n)]
     needs_container = any(p[1] for p in parts)
@@ -73,12 +88,27 @@ def gen_template(rng, i):
         body.append("allowedset := {v | v := "
                     "input.constraint.spec.parameters.allowed[_]}")
     body += [p[0] for p in parts]
-    body.append('msg := sprintf("t%d fired on %v", '
-                '[input.review.object.metadata.name])'
-                .replace("%d", str(i)))
-    src = "package fuzz%d\nviolation[{\"msg\": msg}] {\n  %s\n}\n" % (
-        i, "\n  ".join(body))
-    return src
+    body.append(f'msg := sprintf("t{i}r{ri} fired on %v", '
+                '[input.review.object.metadata.name])')
+    return "violation[{\"msg\": msg}] {\n  %s\n}" % "\n  ".join(body)
+
+
+INV_JOIN_RULE = """violation[{"msg": msg}] {
+  host := input.review.object.spec.host
+  other := data.inventory.namespace[ns][_]["Pod"][name]
+  other.spec.host == host
+  not input.review.object.metadata.name == name
+  msg := sprintf("dup host %v", [host])
+}"""
+
+
+def gen_template(rng, i):
+    # multi-rule templates: results union across rules; a rule touching
+    # a different region must not knock siblings off the device path
+    rules = [gen_rule(rng, i, ri) for ri in range(rng.randint(1, 2))]
+    if rng.random() < 0.25:
+        rules.append(INV_JOIN_RULE)        # inventory duplicate join
+    return "package fuzz%d\n%s\n" % (i, "\n".join(rules))
 
 
 def gen_pod(rng, i):
@@ -98,6 +128,8 @@ def gen_pod(rng, i):
     spec = {"containers": containers}
     if rng.random() < 0.5:
         spec["replicas"] = rng.randrange(6)
+    if rng.random() < 0.3:
+        spec["host"] = f"h{rng.randrange(4)}.com"   # inventory-join fodder
     return {"apiVersion": "v1", "kind": "Pod",
             "metadata": {"name": f"p{i:03d}",
                          "namespace": rng.choice(["d", "p"]),
@@ -127,6 +159,18 @@ def gen_match(rng):
                 expr["values"] = rng.sample(VALUES, k=2)
             sel["matchExpressions"] = [expr]
         m["labelSelector"] = sel
+    if rng.random() < 0.35:
+        # namespaceSelector resolves against the cached v1/Namespace
+        # objects (round-1 soundness bugs lived exactly here)
+        if rng.random() < 0.5:
+            m["namespaceSelector"] = {"matchLabels": {
+                "team": rng.choice(["d", "p", "zzz"])}}
+        else:
+            op = rng.choice(["In", "NotIn", "Exists", "DoesNotExist"])
+            expr = {"key": "team", "operator": op}
+            if op in ("In", "NotIn"):
+                expr["values"] = rng.sample(["d", "p", "zzz"], k=2)
+            m["namespaceSelector"] = {"matchExpressions": [expr]}
     return m or None
 
 
@@ -158,7 +202,8 @@ def test_fuzz_driver_parity(seed):
         params = {"labels": rng.sample(LABELS, k=2),
                   "repos": rng.sample(REPOS, k=rng.randint(1, 2)),
                   "probes": rng.sample(PROBES, k=rng.randint(1, 2)),
-                  "allowed": [rng.choice(REPOS) + f"app{k}" for k in range(2)]}
+                  "allowed": [rng.choice(REPOS) + f"app{k}" for k in range(2)],
+                  "slack": rng.randrange(4)}
         match = gen_match(rng)
         for c in (local, jx):
             c.add_template(tdoc(kind, src))
@@ -179,3 +224,23 @@ def test_fuzz_driver_parity(seed):
     st = jx.driver.state["admission.k8s.gatekeeper.sh"]
     lowered = sum(1 for t in st.templates.values() if t.vectorized is not None)
     assert lowered >= 1, "fuzz produced no lowerable templates"
+    # churn rounds: random upserts/deletes drive the delta-maintained
+    # columns/bindings/masks and the persistent device violation mask
+    for round_ in range(2):
+        for idx in rng.sample(range(60), 8):
+            p = gen_pod(rng, idx)
+            local.add_data(p)
+            jx.add_data(p)
+        if round_ == 1:
+            victim = pods[rng.randrange(60)]
+            local.remove_data(victim)
+            jx.remove_data(victim)
+            # a Namespace label change shifts namespaceSelector results
+            nsu = {"apiVersion": "v1", "kind": "Namespace",
+                   "metadata": {"name": "d",
+                                "labels": {"team": rng.choice(["d", "zzz"])}}}
+            local.add_data(nsu)
+            jx.add_data(nsu)
+        lres = sorted(map(key, local.audit().results()))
+        jres = sorted(map(key, jx.audit().results()))
+        assert lres == jres, f"churn round {round_}"

