@@ -93,8 +93,7 @@ def setup_north_star(driver, resources, rng):
             "K8sContainerLimits", f"cpu-{j:03d}",
             {"cpu": rng.choice(["500m", "1", "2"]),
              "memory": rng.choice(["512Mi", "2Gi"])}))
-    for obj in resources:
-        client.add_data(obj)
+    client.add_data_batch(resources)
     return client
 
 
@@ -260,8 +259,7 @@ def bench_library(detail):
         c.add_template(tdoc)
         c.add_constraint(cdoc)
     t0 = time.perf_counter()
-    for r in resources:
-        c.add_data(r)
+    c.add_data_batch(resources)
     ingest_s = time.perf_counter() - t0
     t0 = time.perf_counter()
     jd.query_audit(TARGET_NAME, QueryOpts(limit_per_constraint=CAP))

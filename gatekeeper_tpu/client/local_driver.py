@@ -233,6 +233,16 @@ class LocalDriver(Driver):
         self._state(target).table.upsert(key, obj, meta)
 
     @locked
+    def put_data_batch(self, target: str,
+                       entries: list[tuple[str, ResourceMeta, dict]]) -> None:
+        """Bulk ingest under ONE writer acquisition (initial list-sync
+        floods; per-object locking dominates at 1M objects).  One
+        generation bump for the whole batch keeps downstream delta
+        caches seeing a single churn event."""
+        self._state(target).table.bulk_upsert(
+            [(key, obj, meta) for key, meta, obj in entries])
+
+    @locked
     def delete_data(self, target: str, key: str) -> bool:
         return self._state(target).table.remove(key)
 

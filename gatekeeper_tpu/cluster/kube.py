@@ -63,6 +63,7 @@ def load_kubeconfig(path: str) -> dict:
                 cluster["certificate-authority-data"]).decode())
         cert = user.get("client-certificate")
         keyf = user.get("client-key")
+        tmp_paths: list[str] = []
         if user.get("client-certificate-data") and user.get("client-key-data"):
             cf = tempfile.NamedTemporaryFile("wb", delete=False,
                                              suffix=".pem")
@@ -73,8 +74,18 @@ def load_kubeconfig(path: str) -> dict:
             kf.write(base64.b64decode(user["client-key-data"]))
             kf.close()
             cert, keyf = cf.name, kf.name
+            tmp_paths = [cf.name, kf.name]
         if cert and keyf:
-            sslctx.load_cert_chain(cert, keyf)
+            try:
+                sslctx.load_cert_chain(cert, keyf)
+            finally:
+                # load_cert_chain reads eagerly; inline key material
+                # must not persist on disk past this call
+                for p in tmp_paths:
+                    try:
+                        os.unlink(p)
+                    except OSError:
+                        pass
     if user.get("token"):
         headers["Authorization"] = f"Bearer {user['token']}"
     elif user.get("tokenFile"):
@@ -332,6 +343,11 @@ class KubeCluster:
                                 known.add(k)
                             callback(Event(etype, obj))
             except NotFoundError:
+                # the resource (CRD) vanished from the apiserver: drop
+                # the cached discovery entry so kind_served() turns
+                # false and the watch manager can retire this GVK
+                # instead of re-listing 404s forever
+                self._invalidate(gvk.group_version)
                 rv = ""
                 stop.wait(self._watch_backoff)
             except (ApiError, OSError, ValueError):

@@ -44,12 +44,23 @@ def crd_try_get(cluster, name: str):
 
 def crd_create(cluster, crd: dict) -> None:
     """Create the constraint CRD, converting to apiextensions v1 when
-    the apiserver no longer serves v1beta1 (k8s >= 1.22)."""
+    the apiserver no longer serves v1beta1 (k8s >= 1.22).  Stamps the
+    spec-hash annotation so the first reconcile after create sees the
+    object as up to date (see _crd_up_to_date)."""
     from gatekeeper_tpu.client.crd_helpers import crd_to_v1
+    def stamped(doc: dict) -> dict:
+        doc = dict(doc)
+        md = dict(doc.get("metadata") or {})
+        anns = dict(md.get("annotations") or {})
+        anns[SPEC_HASH_ANNOTATION] = _spec_hash(doc.get("spec"))
+        md["annotations"] = anns
+        doc["metadata"] = md
+        return doc
     try:
-        cluster.create(crd)
+        cluster.create(stamped(crd))
     except NotFoundError:
-        cluster.create(crd_to_v1(crd))
+        v1 = crd_to_v1(crd)
+        cluster.create(stamped(v1))
 
 
 def crd_delete(cluster, name: str) -> None:
@@ -67,6 +78,29 @@ def make_constraint_gvk(kind: str) -> GVK:
     """makeGvk (:306-312): constraints are always
     constraints.gatekeeper.sh/v1alpha1/<Kind>."""
     return GVK(CONSTRAINT_GROUP, "v1alpha1", kind)
+
+
+SPEC_HASH_ANNOTATION = "gatekeeper.sh/spec-hash"
+
+
+def _spec_hash(spec) -> str:
+    import hashlib
+    import json
+    return hashlib.sha256(
+        json.dumps(spec, sort_keys=True, default=str).encode()).hexdigest()
+
+
+def _crd_up_to_date(crd: dict, found: dict) -> bool:
+    """Whether the stored constraint CRD already reflects our generated
+    spec.  A real apiserver defaults fields crd_to_v1 never emits
+    (names.listKind, conversion strategy, schema normalization), so
+    plain spec equality would fail every reconcile and issue a no-op
+    update per pass — perpetual churn.  Instead the update path stamps
+    a hash of the spec *we wrote* as an annotation; defaults never
+    touch annotations, and any template edit (including pure field
+    removals) changes the hash."""
+    anns = (found.get("metadata") or {}).get("annotations") or {}
+    return anns.get(SPEC_HASH_ANNOTATION) == _spec_hash(crd.get("spec"))
 
 
 def _template_kind(instance: dict) -> str:
@@ -153,8 +187,11 @@ class ReconcileConstraintTemplate(Reconciler):
             # compare/update in the stored object's shape, not ours
             from gatekeeper_tpu.client.crd_helpers import crd_to_v1
             crd = crd_to_v1(crd)
-        if crd.get("spec") != found.get("spec"):
+        if not _crd_up_to_date(crd, found):
             found["spec"] = crd["spec"]
+            found.setdefault("metadata", {}).setdefault(
+                "annotations", {})[SPEC_HASH_ANNOTATION] = \
+                _spec_hash(crd.get("spec"))
             try:
                 self.cluster.update(found)
             except ApiConflictError:

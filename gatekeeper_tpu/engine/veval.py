@@ -36,6 +36,84 @@ from gatekeeper_tpu.ir.program import Node, Program, RuleSpec
 _3D = (1, 1, 1)
 
 
+class _LazyTwoTier:
+    """Deferred two-tier jit: traces/compiles on first call (shapes come
+    from the live arguments), serving the fast-compiled executable while
+    the executor's background worker swaps in the full-effort twin.
+    Retraces per distinct input signature like jax.jit would (narrow-
+    transferred columns may arrive int8/int16/int32)."""
+
+    def __init__(self, executor, raw, fast: bool = True):
+        import threading as _threading
+        self._ex = executor
+        self._raw = raw
+        self._fast = fast
+        self._fns: dict[tuple, Any] = {}
+        self._lock = _threading.Lock()
+        self._inflight: dict[tuple, Any] = {}   # sig -> Event
+
+    def _get_or_build(self, sig, lower):
+        """Single-flight per signature: a prewarm and the first real
+        call must not compile the same executable twice (the compile
+        service serializes — a duplicate doubles cold latency)."""
+        import threading as _threading
+        while True:
+            with self._lock:
+                fn = self._fns.get(sig)
+                if fn is not None:
+                    return fn
+                ev = self._inflight.get(sig)
+                if ev is None:
+                    ev = _threading.Event()
+                    self._inflight[sig] = ev
+                    break
+            ev.wait()
+        try:
+            lowered = lower()
+
+            def install(full, _sig=sig):
+                self._fns[_sig] = full
+
+            if self._fast:
+                fn = self._ex._compile_two_tier(lowered, install)
+            else:
+                fn = lowered.compile()
+            with self._lock:
+                self._fns[sig] = fn
+            return fn
+        finally:
+            with self._lock:
+                self._inflight.pop(sig, None)
+            ev.set()
+
+    def __call__(self, *args):
+        sig = tuple((tuple(a.shape), str(a.dtype)) for a in args)
+        fn = self._fns.get(sig)
+        if fn is None:
+            fn = self._get_or_build(
+                sig, lambda: jax.jit(self._raw).lower(*args))
+        return fn(*args)
+
+    def prewarm(self, *examples) -> None:
+        """Compile for the given jax.ShapeDtypeStruct signature ahead of
+        the first call (cold audits overlap this with host prep)."""
+        sig = tuple((tuple(a.shape), str(a.dtype)) for a in examples)
+        if sig not in self._fns:
+            self._get_or_build(
+                sig, lambda ex=tuple(examples):
+                jax.jit(self._raw).lower(*ex))
+
+
+def _widen_args(args: tuple) -> tuple:
+    """Upcast narrow-transferred id columns (_put ships int8/int16 to
+    cut host->device bytes) back to int32 *inside* the jitted program —
+    the cast fuses into the first consumer kernel, costing no extra
+    dispatch or transfer."""
+    return tuple(a.astype(jnp.int32)
+                 if a.dtype in (jnp.int8, jnp.int16) else a
+                 for a in args)
+
+
 def _fires(dv: tuple[jax.Array, jax.Array]) -> jax.Array:
     """defined & truthy; only False and undefined fail in Rego."""
     d, v = dv
@@ -499,12 +577,97 @@ class ProgramExecutor:
         self._cache: dict[tuple, Any] = {}
         self._lock = __import__("threading").Lock()   # dispatch runs threaded
         self._trace_lock = __import__("threading").Lock()
+        self._compile_inflight: dict[tuple, Any] = {}  # key -> Event
         self.compiles = 0      # executable-cache misses (trace+compile)
         self.cache_hits = 0    # executable-cache hits
+        self.trace_seconds = 0.0    # cumulative jit-trace (GIL-bound)
+        self.compile_seconds = 0.0  # cumulative XLA compile (parallel)
+        self.upgrades = 0      # background full-opt recompiles landed
+        self._upgrade_q: list = []
+        self._upgrade_thread = None
         # multi-chip: a (c, r) jax.sharding.Mesh — bindings device_put
         # with NamedShardings per ir/prep.binding_axes, executables built
         # via shard_map (parallel/sharding.py).  None = single device.
         self.mesh = mesh
+
+    # ------------------------------------------------------------------
+    # two-tier compilation
+    #
+    # XLA-for-TPU compile time is dominated by execution-time
+    # optimization passes; `exec_time_optimization_effort=-1` compiles
+    # ~4x faster with near-identical generated code for these
+    # gather/compare/reduce programs.  Cold starts serve the
+    # fast-compiled executable immediately and a single background
+    # worker re-compiles at default effort and swaps it in — steady
+    # state always converges to the fully optimized binary, and the
+    # upgrade queue is deferred so it never competes with the cold
+    # flurry for the (serialized) compile service.
+
+    FAST_OPTS = {"exec_time_optimization_effort": -1.0}
+    UPGRADE_DELAY_S = 15.0
+    _shutdown = __import__("threading").Event()
+
+    def _compile_two_tier(self, lowered, install):
+        """Compile `lowered` fast; schedule the full-effort twin and
+        hand it to `install(full_fn)` when ready.  Falls back to a
+        single default-effort compile when the option is unsupported
+        (non-TPU backends) or fast compilation fails."""
+        import os
+        import time as _time
+        if os.environ.get("GATEKEEPER_NO_FAST_COMPILE") == "1":
+            return lowered.compile()
+        try:
+            fast = lowered.compile(compiler_options=dict(self.FAST_OPTS))
+        except Exception:
+            return lowered.compile()
+        with self._lock:
+            self._upgrade_q.append((_time.perf_counter(), lowered, install))
+            if self._upgrade_thread is None or \
+                    not self._upgrade_thread.is_alive():
+                import threading as _threading
+                t = _threading.Thread(
+                    target=self._upgrade_loop, name="xla-upgrade",
+                    daemon=True)
+                self._upgrade_thread = t
+                # a compile RPC in flight during interpreter teardown
+                # aborts the process (uncatchable C++ throw): stop the
+                # worker and join any in-progress compile at exit
+                import atexit
+
+                def _drain(thread=t):
+                    ProgramExecutor._shutdown.set()
+                    thread.join(timeout=120)
+                atexit.register(_drain)
+                t.start()
+        return fast
+
+    def _upgrade_loop(self):
+        import time as _time
+        while not self._shutdown.is_set():
+            with self._lock:
+                if not self._upgrade_q:
+                    self._upgrade_thread = None
+                    return
+                # quiesce-based deferral: wait until the whole cold
+                # flurry stopped enqueueing, so upgrades never compete
+                # with first-serve compiles for the serialized service
+                newest = max(t for t, _, _ in self._upgrade_q)
+                t_enq, lowered, install = self._upgrade_q[0]
+            wait = newest + self.UPGRADE_DELAY_S - _time.perf_counter()
+            if wait > 0:
+                if self._shutdown.wait(min(wait, 1.0)):
+                    return
+                continue
+            with self._lock:
+                self._upgrade_q.pop(0)
+            try:
+                full = lowered.compile()
+                install(full)
+                self.upgrades += 1
+            except Exception:
+                pass   # the fast executable stays in service
+        with self._lock:
+            self._upgrade_thread = None
 
     def _sharding_of(self, name: str):
         from jax.sharding import NamedSharding, PartitionSpec as P
@@ -539,6 +702,25 @@ class ProgramExecutor:
     def _put(self, name: str, host: np.ndarray, sharded: bool) -> jax.Array:
         if sharded:
             return jax.device_put(host, self._sharding_of(name))
+        import os
+        if host.dtype == np.int32 and host.size >= (1 << 16) and \
+                os.environ.get("GATEKEEPER_NO_NARROW") != "1":
+            # narrow-transfer: id columns usually fit int8/int16 (the
+            # interner holds few distinct strings relative to rows);
+            # ship the narrow form and widen on device — host->device
+            # bandwidth is the cold-start bottleneck through a
+            # tunneled accelerator, compute on device is free
+            lo = int(host.min()) if host.size else 0
+            hi = int(host.max()) if host.size else 0
+            for dt in (np.int8, np.int16):
+                info = np.iinfo(dt)
+                if info.min <= lo and hi <= info.max:
+                    # stays narrow on device; executables upcast at
+                    # entry (_widen_args) so the cast fuses away.  The
+                    # executable cache keys on dtype, so a column later
+                    # outgrowing the narrow range simply compiles the
+                    # int32 twin once.
+                    return jax.device_put(host.astype(dt))
         return jax.device_put(host)
 
     def _scatter_rows(self, name: str, dev: jax.Array, host: np.ndarray,
@@ -560,6 +742,17 @@ class ProgramExecutor:
         idx = [slice(None)] * host.ndim
         idx[ax] = rows
         vals = np.ascontiguousarray(host[tuple(idx)])
+        if dev.dtype != vals.dtype:
+            # narrow-transferred column (_put): scatter narrow when the
+            # new values still fit, else re-upload whole (the rare event
+            # of the interner outgrowing the narrow range)
+            info = np.iinfo(dev.dtype) if np.issubdtype(dev.dtype, np.integer) \
+                else None
+            if info is not None and len(vals) and \
+                    info.min <= vals.min() and vals.max() <= info.max:
+                vals = vals.astype(dev.dtype)
+            else:
+                return self._put(name, host, sharded)
         out = dev.at[tuple(idx)].set(jax.device_put(vals))
         if sharded:
             # scatter output placement follows XLA's choice; pin it back
@@ -659,50 +852,83 @@ class ProgramExecutor:
             if fn is not None:
                 self.cache_hits += 1
         if fn is None:
-            if sharded:
-                from jax.sharding import PartitionSpec as P
-                from gatekeeper_tpu.ir.prep import binding_axes
-                from gatekeeper_tpu.parallel.sharding import (
-                    make_sharded_mask_fn, make_sharded_topk_packed)
-                specs = {nm: P(*binding_axes(nm)) for nm in names}
-                r_pad = arrays["__alive__"].shape[0]
-                if topk is None:
-                    raw = make_sharded_mask_fn(program, names, specs,
-                                               self.mesh)
-                else:
-                    raw = make_sharded_topk_packed(program, names, specs,
-                                                   self.mesh, topk, r_pad)
-            elif topk is None:
-                def raw(args: tuple):
-                    return _eval_mask(program, dict(zip(names, args)))
-            else:
-                def raw(args: tuple):
-                    counts, rows, scores = _eval_topk(
-                        program, dict(zip(names, args)), topk)
-                    valid = (scores > 0).astype(jnp.int32)
-                    return jnp.concatenate(
-                        [counts[:, None], rows, valid], axis=1)  # [C, 1+2k]
-            example = tuple(
-                jax.ShapeDtypeStruct(arrays[nm].shape, arrays[nm].dtype,
-                                     sharding=arrays[nm].sharding
-                                     if sharded else None)
-                for nm in names)
-            with self._trace_lock:
-                # double-check: a concurrent miss on the same key may
-                # have finished while we waited for the trace lock
+            # single-flight per key: concurrent misses (dispatch pool)
+            # must not compile the same executable twice — the compile
+            # service serializes, so a duplicate doubles cold latency
+            import threading as _threading
+            while fn is None:
                 with self._lock:
-                    hit = self._cache.get(key)
-                if hit is not None:
-                    return hit, names
-                lowered = jax.jit(raw).lower(example)
-            fn = lowered.compile()
-            with self._lock:
-                hit = self._cache.setdefault(key, fn)
-                if hit is fn:
-                    self.compiles += 1
-                else:
-                    fn = hit
+                    fn = self._cache.get(key)
+                    if fn is not None:
+                        self.cache_hits += 1
+                        return fn, names
+                    ev = self._compile_inflight.get(key)
+                    if ev is None:
+                        ev = _threading.Event()
+                        self._compile_inflight[key] = ev
+                        break
+                ev.wait()
+            try:
+                fn = self._compile_locked(program, arrays, topk, sharded,
+                                          names, key)
+            finally:
+                with self._lock:
+                    self._compile_inflight.pop(key, None)
+                ev.set()
         return fn, names
+
+    def _compile_locked(self, program: Program, arrays: dict,
+                        topk: int | None, sharded: bool,
+                        names: tuple, key: tuple):
+        if sharded:
+            from jax.sharding import PartitionSpec as P
+            from gatekeeper_tpu.ir.prep import binding_axes
+            from gatekeeper_tpu.parallel.sharding import (
+                make_sharded_mask_fn, make_sharded_topk_packed)
+            specs = {nm: P(*binding_axes(nm)) for nm in names}
+            r_pad = arrays["__alive__"].shape[0]
+            if topk is None:
+                raw = make_sharded_mask_fn(program, names, specs,
+                                           self.mesh)
+            else:
+                raw = make_sharded_topk_packed(program, names, specs,
+                                               self.mesh, topk, r_pad)
+        elif topk is None:
+            def raw(args: tuple):
+                args = _widen_args(args)
+                return _eval_mask(program, dict(zip(names, args)))
+        else:
+            def raw(args: tuple):
+                args = _widen_args(args)
+                counts, rows, scores = _eval_topk(
+                    program, dict(zip(names, args)), topk)
+                valid = (scores > 0).astype(jnp.int32)
+                return jnp.concatenate(
+                    [counts[:, None], rows, valid], axis=1)  # [C, 1+2k]
+        example = tuple(
+            jax.ShapeDtypeStruct(arrays[nm].shape, arrays[nm].dtype,
+                                 sharding=arrays[nm].sharding
+                                 if sharded else None)
+            for nm in names)
+        import time as _time
+        with self._trace_lock:
+            # tracing is GIL-bound; keep it serial (the pool would
+            # thrash), while compiles below run concurrently
+            _t0 = _time.perf_counter()
+            lowered = jax.jit(raw).lower(example)
+            self.trace_seconds += _time.perf_counter() - _t0
+        _t0 = _time.perf_counter()
+
+        def install(full, _key=key):
+            with self._lock:
+                self._cache[_key] = full
+
+        fn = self._compile_two_tier(lowered, install)
+        self.compile_seconds += _time.perf_counter() - _t0
+        with self._lock:
+            self._cache[key] = fn
+            self.compiles += 1
+        return fn
 
     # ------------------------------------------------------------------
     # persistent device violation masks
@@ -718,6 +944,20 @@ class ProgramExecutor:
     # update_bindings relies on.  Multi-chip meshes keep the full
     # re-evaluation path (scatter of global dirty indices into sharded
     # arrays does not decompose per-shard with static shapes).
+
+    def prewarm_reduce(self, k: int, c_pad: int, r_pad: int,
+                       with_rank: bool = True) -> None:
+        """Compile the shared top-k reduce executable for the audit
+        shape bucket before any kind's mask is ready — on a cold start
+        its (serialized) XLA compile then overlaps host binding prep
+        instead of serializing after the last mask evaluation."""
+        fn = self._reduce_fn(k, (c_pad, r_pad), (r_pad,) if with_rank
+                             else None)
+        if isinstance(fn, _LazyTwoTier):
+            ex = [jax.ShapeDtypeStruct((c_pad, r_pad), jnp.bool_)]
+            if with_rank:
+                ex.append(jax.ShapeDtypeStruct((r_pad,), jnp.int32))
+            fn.prewarm(*ex)
 
     def _viol_key(self, program: Program) -> tuple:
         return (id(self), program.cache_key())
@@ -819,7 +1059,10 @@ class ProgramExecutor:
             else:
                 def raw(viol):
                     return reduce_chunked(viol, None)
-            fn = jax.jit(raw)
+            # exec-critical and shared across kinds: always compile at
+            # full effort (prewarm_reduce overlaps it with host prep);
+            # a fast-compiled scan/top_k runs several times slower
+            fn = _LazyTwoTier(self, raw, fast=False)
             self._cache[key] = fn
         return fn
 
@@ -831,6 +1074,7 @@ class ProgramExecutor:
         fn = self._cache.get(key)
         if fn is None:
             def raw(viol_old, dirty, *args):
+                args = _widen_args(args)
                 full = dict(zip(names, args))
                 sliced = {}
                 for nm, a in full.items():
@@ -841,7 +1085,7 @@ class ProgramExecutor:
                         sliced[nm] = jnp.take(a, dirty, axis=ax)
                 sub = _eval_program(program, sliced)      # [C, d_bucket]
                 return viol_old.at[:, dirty].set(sub)
-            fn = jax.jit(raw)
+            fn = _LazyTwoTier(self, raw)
             self._cache[key] = fn
         return fn
 

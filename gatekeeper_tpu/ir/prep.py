@@ -313,12 +313,10 @@ def build_elem_arrays(objs: list, base: tuple[str, ...], rels: list[tuple[tuple[
     is the semantics contract the extension is tested against."""
     from gatekeeper_tpu import native
     if native.available:
-        counts_l, cols = native.elem_arrays(
+        counts, cols = native.elem_arrays(
             objs, base, [r for r, _m in rels],
             [native.MODE_CODES[m] for _r, m in rels],
             interner._ids, interner._strings, encode_value)
-        counts = np.asarray(counts_l, dtype=np.int32) if counts_l \
-            else np.zeros((len(objs),), dtype=np.int32)
         return counts, {rm: col for rm, col in zip(rels, cols)}
     n = len(objs)
     counts = np.zeros((n,), dtype=np.int32)
@@ -531,21 +529,21 @@ def build_bindings(spec: PrepSpec, table: ResourceTable,
             flat = cols[(ec.rel, ec.mode)]
             if ec.mode in ("str", "val"):
                 arr = np.full((r_pad, e_pad), MISSING, dtype=np.int32)
-                if flat:
+                if len(flat):
                     arr[idx_r, idx_e] = np.asarray(flat, dtype=np.int32)
                 out[ec.name] = arr
             elif ec.mode in ("num", "len"):
-                fv = np.asarray(flat, dtype=np.float64) if flat else np.zeros((0,))
+                fv = np.asarray(flat, dtype=np.float64) if len(flat) else np.zeros((0,))
                 v = np.zeros((r_pad, e_pad), dtype=np.float32)
                 p = np.zeros((r_pad, e_pad), dtype=bool)
-                if flat:
+                if len(flat):
                     v[idx_r, idx_e] = np.nan_to_num(fv).astype(np.float32)
                     p[idx_r, idx_e] = ~np.isnan(fv)
                 out[ec.name + ".v"] = v
                 out[ec.name + ".p"] = p
             else:  # present / truthy
                 b = np.zeros((r_pad, e_pad), dtype=bool)
-                if flat:
+                if len(flat):
                     b[idx_r, idx_e] = np.asarray(flat, dtype=bool)
                 out[ec.name] = b
 
@@ -967,21 +965,21 @@ def update_bindings(spec: PrepSpec, table: ResourceTable,
             if ec.mode in ("str", "val"):
                 arr = cow(ec.name)
                 arr[dirty] = MISSING
-                if flat:
+                if len(flat):
                     arr[idx_r, idx_e] = np.asarray(flat, dtype=np.int32)
             elif ec.mode in ("num", "len"):
-                fv = np.asarray(flat, dtype=np.float64) if flat else np.zeros((0,))
+                fv = np.asarray(flat, dtype=np.float64) if len(flat) else np.zeros((0,))
                 v = cow(ec.name + ".v")
                 p = cow(ec.name + ".p")
                 v[dirty] = 0.0
                 p[dirty] = False
-                if flat:
+                if len(flat):
                     v[idx_r, idx_e] = np.nan_to_num(fv).astype(np.float32)
                     p[idx_r, idx_e] = ~np.isnan(fv)
             else:
                 b = cow(ec.name)
                 b[dirty] = False
-                if flat:
+                if len(flat):
                     b[idx_r, idx_e] = np.asarray(flat, dtype=bool)
 
     # ---- dynamic-key container lookups: refill dirty columns

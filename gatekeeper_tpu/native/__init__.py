@@ -47,11 +47,38 @@ def _build() -> object | None:
     return mod
 
 
+def _np_dtype(mode_code: int):
+    import numpy as np
+    if mode_code in (0, 1):          # str / val -> interner ids
+        return np.int32
+    if mode_code in (2, 3):          # num / len
+        return np.float64
+    return np.bool_                  # present / truthy
+
+
+def _wrap(mod):
+    """numpy views over the extension's raw cell buffers (the C side
+    writes machine scalars, not PyObjects — see colext.c Buf)."""
+    import numpy as np
+
+    def scalar_col(objs, path, mode, ids, strings, encode_cb):
+        buf = mod.scalar_col(objs, path, mode, ids, strings, encode_cb)
+        return np.frombuffer(buf, dtype=_np_dtype(mode))
+
+    def elem_arrays(objs, base, rels, modes, ids, strings, encode_cb):
+        counts, cols = mod.elem_arrays(objs, base, rels, modes, ids,
+                                       strings, encode_cb)
+        return (np.frombuffer(counts, dtype=np.int32),
+                [np.frombuffer(c, dtype=_np_dtype(m))
+                 for c, m in zip(cols, modes)])
+
+    return scalar_col, elem_arrays
+
+
 if os.environ.get("GATEKEEPER_NO_NATIVE") != "1":
     try:
         _mod = _build()
-        elem_arrays = _mod.elem_arrays
-        scalar_col = _mod.scalar_col
+        scalar_col, elem_arrays = _wrap(_mod)
         memb_fill = _mod.memb_fill
         available = True
     except Exception:  # no toolchain / unexpected platform: Python paths

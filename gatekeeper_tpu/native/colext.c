@@ -115,8 +115,61 @@ static PyObject *encode_scalar(PyObject *v, int *compound)
 enum { M_STR = 0, M_VAL = 1, M_NUM = 2, M_LEN = 3, M_PRESENT = 4,
        M_TRUTHY = 5 };
 
+/* Raw growable output buffer: cells are written as machine scalars
+ * (int32 ids / float64 numbers / uint8 bools) instead of per-cell
+ * PyObjects — the Python wrapper reinterprets the returned bytes with
+ * np.frombuffer, so a 4M-element column costs one memcpy, not 4M
+ * PyLong allocations plus a list->array conversion. */
+typedef struct {
+    char *p;
+    Py_ssize_t len;   /* bytes used */
+    Py_ssize_t cap;   /* bytes allocated */
+    int item;         /* bytes per cell */
+} Buf;
+
+static int buf_init(Buf *b, Py_ssize_t cells, int item)
+{
+    if (cells < 16)
+        cells = 16;
+    b->p = PyMem_Malloc(cells * item);
+    if (b->p == NULL) {
+        PyErr_NoMemory();
+        return -1;
+    }
+    b->len = 0;
+    b->cap = cells * item;
+    b->item = item;
+    return 0;
+}
+
+static void *buf_more(Buf *b)
+{
+    if (b->len + b->item > b->cap) {
+        Py_ssize_t cap = b->cap * 2;
+        char *p = PyMem_Realloc(b->p, cap);
+        if (p == NULL) {
+            PyErr_NoMemory();
+            return NULL;
+        }
+        b->p = p;
+        b->cap = cap;
+    }
+    void *out = b->p + b->len;
+    b->len += b->item;
+    return out;
+}
+
+static int item_for_mode(int mode)
+{
+    switch (mode) {
+    case M_STR: case M_VAL: return 4;           /* int32 ids */
+    case M_NUM: case M_LEN: return 8;           /* float64 */
+    default: return 1;                          /* uint8 bools */
+    }
+}
+
 /* append one element-column cell for (elem, rel, mode).  Returns 0 ok. */
-static int append_cell(PyObject *col, PyObject *elem, PyObject *rel,
+static int append_cell(Buf *col, PyObject *elem, PyObject *rel,
                        int mode, PyObject *ids, PyObject *strings,
                        PyObject *encode_cb)
 {
@@ -128,7 +181,9 @@ static int append_cell(PyObject *col, PyObject *elem, PyObject *rel,
         v = PyDict_GetItem(v, PyTuple_GET_ITEM(rel, i));
         if (v == NULL) { has = 0; break; }
     }
-    PyObject *cell = NULL;
+    void *cell = buf_more(col);
+    if (cell == NULL)
+        return -1;
     switch (mode) {
     case M_STR: {
         long id = MISSING;
@@ -136,7 +191,7 @@ static int append_cell(PyObject *col, PyObject *elem, PyObject *rel,
             id = intern_str(ids, strings, v);
             if (id == -2) return -1;
         }
-        cell = PyLong_FromLong(id);
+        *(int32_t *)cell = (int32_t)id;
         break;
     }
     case M_VAL: {
@@ -161,7 +216,7 @@ static int append_cell(PyObject *col, PyObject *elem, PyObject *rel,
                 if (id == -2) return -1;
             }
         }
-        cell = PyLong_FromLong(id);
+        *(int32_t *)cell = (int32_t)id;
         break;
     }
     case M_NUM: {
@@ -171,7 +226,7 @@ static int append_cell(PyObject *col, PyObject *elem, PyObject *rel,
             if (d == -1.0 && PyErr_Occurred())
                 PyErr_Clear(), d = NAN;
         }
-        cell = PyFloat_FromDouble(d);
+        *(double *)cell = d;
         break;
     }
     case M_LEN: {
@@ -181,24 +236,29 @@ static int append_cell(PyObject *col, PyObject *elem, PyObject *rel,
             if (n < 0) return -1;
             d = (double)n;
         }
-        cell = PyFloat_FromDouble(d);
+        *(double *)cell = d;
         break;
     }
     case M_PRESENT:
-        cell = PyBool_FromLong(has);
+        *(uint8_t *)cell = (uint8_t)has;
         break;
     case M_TRUTHY:
-        cell = PyBool_FromLong(has && v != Py_False);
+        *(uint8_t *)cell = (uint8_t)(has && v != Py_False);
         break;
     default:
         PyErr_SetString(PyExc_ValueError, "bad mode");
         return -1;
     }
-    if (cell == NULL)
-        return -1;
-    int rc = PyList_Append(col, cell);
-    Py_DECREF(cell);
-    return rc;
+    return 0;
+}
+
+static PyObject *buf_take(Buf *b)
+{
+    /* hand the bytes to Python; frees the C buffer */
+    PyObject *out = PyBytes_FromStringAndSize(b->p, b->len);
+    PyMem_Free(b->p);
+    b->p = NULL;
+    return out;
 }
 
 /* base walk with "*" flattening; appends terminal list elements to out. */
@@ -206,6 +266,25 @@ static int collect_elems(PyObject *obj, PyObject *base, PyObject *star,
                          PyObject *out)
 {
     Py_ssize_t blen = PyTuple_GET_SIZE(base);
+    /* star-free fast path (the overwhelmingly common base shape,
+     * e.g. spec.containers): one dict walk, no intermediate lists */
+    int has_star = 0;
+    for (Py_ssize_t i = 0; i < blen; i++) {
+        int eq = PyObject_RichCompareBool(PyTuple_GET_ITEM(base, i), star,
+                                          Py_EQ);
+        if (eq < 0)
+            return -1;
+        if (eq) { has_star = 1; break; }
+    }
+    if (!has_star) {
+        PyObject *v = walk_path(obj, base, 0);
+        if (v == NULL || !PyList_Check(v))
+            return 0;
+        for (Py_ssize_t e = 0; e < PyList_GET_SIZE(v); e++)
+            if (PyList_Append(out, PyList_GET_ITEM(v, e)) < 0)
+                return -1;
+        return 0;
+    }
     PyObject *cur = PyList_New(0);
     if (cur == NULL || PyList_Append(cur, obj) < 0) {
         Py_XDECREF(cur);
@@ -252,7 +331,7 @@ static int collect_elems(PyObject *obj, PyObject *base, PyObject *star,
 }
 
 /* elem_arrays(objs, base, rels, modes, ids, strings, encode_cb)
- *   -> (counts list, [col list per rel]) */
+ *   -> (counts bytes [int32], [col bytes per rel]) */
 static PyObject *py_elem_arrays(PyObject *self, PyObject *args)
 {
     PyObject *objs, *base, *rels, *modes, *ids, *strings, *encode_cb;
@@ -261,70 +340,92 @@ static PyObject *py_elem_arrays(PyObject *self, PyObject *args)
         return NULL;
     Py_ssize_t n = PyList_GET_SIZE(objs);
     Py_ssize_t nr = PyList_GET_SIZE(rels);
-    PyObject *star = PyUnicode_FromString("*");
-    PyObject *counts = PyList_New(0);
-    PyObject *cols = PyList_New(0);
-    if (star == NULL || counts == NULL || cols == NULL)
-        goto fail;
-    for (Py_ssize_t r = 0; r < nr; r++) {
-        PyObject *col = PyList_New(0);
-        if (col == NULL || PyList_Append(cols, col) < 0) {
-            Py_XDECREF(col);
-            goto fail;
-        }
-        Py_DECREF(col);
-    }
+    Buf counts;
+    Buf colbuf[64];
     long mode_codes[64];
+    Py_ssize_t nbuf = 0;
+    PyObject *star = NULL, *elems = NULL, *out = NULL;
     if (nr > 64) {
         PyErr_SetString(PyExc_ValueError, "too many element columns");
-        goto fail;
+        return NULL;
     }
-    for (Py_ssize_t r = 0; r < nr; r++)
+    if (buf_init(&counts, n, 4) < 0)
+        return NULL;
+    for (Py_ssize_t r = 0; r < nr; r++) {
         mode_codes[r] = PyLong_AsLong(PyList_GET_ITEM(modes, r));
-
-    PyObject *elems = PyList_New(0);
-    if (elems == NULL)
+        if (buf_init(&colbuf[r], n, item_for_mode((int)mode_codes[r])) < 0)
+            goto fail;
+        nbuf = r + 1;
+    }
+    star = PyUnicode_FromString("*");
+    elems = PyList_New(0);
+    if (star == NULL || elems == NULL)
         goto fail;
     for (Py_ssize_t i = 0; i < n; i++) {
         PyObject *o = PyList_GET_ITEM(objs, i);
         if (PyList_SetSlice(elems, 0, PyList_GET_SIZE(elems), NULL) < 0)
-            goto fail_elems;
+            goto fail;
         if (o != Py_None && collect_elems(o, base, star, elems) < 0)
-            goto fail_elems;
+            goto fail;
         Py_ssize_t ne = PyList_GET_SIZE(elems);
-        PyObject *cnt = PyLong_FromSsize_t(ne);
-        if (cnt == NULL || PyList_Append(counts, cnt) < 0) {
-            Py_XDECREF(cnt);
-            goto fail_elems;
-        }
-        Py_DECREF(cnt);
+        void *cnt = buf_more(&counts);
+        if (cnt == NULL)
+            goto fail;
+        *(int32_t *)cnt = (int32_t)ne;
         for (Py_ssize_t e = 0; e < ne; e++) {
             PyObject *elem = PyList_GET_ITEM(elems, e);
             for (Py_ssize_t r = 0; r < nr; r++) {
-                if (append_cell(PyList_GET_ITEM(cols, r), elem,
+                if (append_cell(&colbuf[r], elem,
                                 PyList_GET_ITEM(rels, r),
                                 (int)mode_codes[r], ids, strings,
                                 encode_cb) < 0)
-                    goto fail_elems;
+                    goto fail;
             }
         }
     }
     Py_DECREF(elems);
     Py_DECREF(star);
-    PyObject *out = PyTuple_Pack(2, counts, cols);
-    Py_DECREF(counts);
-    Py_DECREF(cols);
-    return out;
-fail_elems:
-    Py_DECREF(elems);
+    elems = star = NULL;
+    {
+        PyObject *cols = PyList_New(0);
+        PyObject *cb = buf_take(&counts);
+        if (cols == NULL || cb == NULL) {
+            Py_XDECREF(cols);
+            Py_XDECREF(cb);
+            counts.p = NULL;
+            goto fail;
+        }
+        counts.p = NULL;
+        int ok = 1;
+        for (Py_ssize_t r = 0; r < nbuf; r++) {
+            PyObject *b = buf_take(&colbuf[r]);
+            colbuf[r].p = NULL;
+            if (b == NULL || PyList_Append(cols, b) < 0) {
+                Py_XDECREF(b);
+                ok = 0;
+                break;
+            }
+            Py_DECREF(b);
+        }
+        nbuf = 0;
+        if (ok)
+            out = PyTuple_Pack(2, cb, cols);
+        Py_DECREF(cb);
+        Py_DECREF(cols);
+        return out;
+    }
 fail:
+    Py_XDECREF(elems);
     Py_XDECREF(star);
-    Py_XDECREF(counts);
-    Py_XDECREF(cols);
+    if (counts.p != NULL)
+        PyMem_Free(counts.p);
+    for (Py_ssize_t r = 0; r < nbuf; r++)
+        if (colbuf[r].p != NULL)
+            PyMem_Free(colbuf[r].p);
     return NULL;
 }
 
-/* scalar_col(objs, path, mode, ids, strings, encode_cb) -> list
+/* scalar_col(objs, path, mode, ids, strings, encode_cb) -> bytes
  * one cell per obj (tombstone None rows handled per mode defaults). */
 static PyObject *py_scalar_col(PyObject *self, PyObject *args)
 {
@@ -334,32 +435,30 @@ static PyObject *py_scalar_col(PyObject *self, PyObject *args)
                           &strings, &encode_cb))
         return NULL;
     Py_ssize_t n = PyList_GET_SIZE(objs);
-    PyObject *out = PyList_New(0);
-    if (out == NULL)
+    Buf out;
+    if (buf_init(&out, n, item_for_mode(mode)) < 0)
         return NULL;
     for (Py_ssize_t i = 0; i < n; i++) {
         PyObject *o = PyList_GET_ITEM(objs, i);
         if (o == Py_None) {
-            PyObject *cell;
+            void *cell = buf_more(&out);
+            if (cell == NULL)
+                goto fail;
             if (mode == M_STR || mode == M_VAL)
-                cell = PyLong_FromLong(MISSING);
+                *(int32_t *)cell = (int32_t)MISSING;
             else if (mode == M_NUM || mode == M_LEN)
-                cell = PyFloat_FromDouble(NAN);
+                *(double *)cell = NAN;
             else
-                cell = PyBool_FromLong(0);
-            if (cell == NULL || PyList_Append(out, cell) < 0) {
-                Py_XDECREF(cell); Py_DECREF(out);
-                return NULL;
-            }
-            Py_DECREF(cell);
+                *(uint8_t *)cell = 0;
             continue;
         }
-        if (append_cell(out, o, path, mode, ids, strings, encode_cb) < 0) {
-            Py_DECREF(out);
-            return NULL;
-        }
+        if (append_cell(&out, o, path, mode, ids, strings, encode_cb) < 0)
+            goto fail;
     }
-    return out;
+    return buf_take(&out);
+fail:
+    PyMem_Free(out.p);
+    return NULL;
 }
 
 /* memb_fill(objs, keys_path, local, ids, buf, n_rows, l_pad)

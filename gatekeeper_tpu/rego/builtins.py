@@ -980,6 +980,34 @@ def _io_jwt_decode_verify(token, constraints):
         _io_jwt_verify_hs256(token, secret)
     if valid and "iss" in constraints:
         valid = ("iss" in payload and payload["iss"] == constraints["iss"])
+    if valid and "aud" in constraints:
+        aud = payload["aud"] if "aud" in payload else None
+        want = constraints["aud"]
+        if isinstance(aud, str):
+            valid = aud == want
+        elif isinstance(aud, (list, tuple)):
+            valid = want in list(aud)
+        else:
+            valid = False
+    elif valid and "aud" in payload:
+        valid = False   # token bound to an audience the caller didn't claim
+    if valid:
+        # exp/nbf are enforced by default against current time
+        # (opa topdown/tokens.go builtinJWTDecodeVerify): "time" in
+        # constraints overrides the clock, in nanoseconds
+        now_ns = constraints["time"] if "time" in constraints else \
+            _time_now_ns()
+        if not isinstance(now_ns, (int, float)) or isinstance(now_ns, bool):
+            raise BuiltinError("io.jwt.decode_verify: time must be a number")
+        now_s = now_ns / 1e9
+        exp = payload["exp"] if "exp" in payload else None
+        nbf = payload["nbf"] if "nbf" in payload else None
+        if isinstance(exp, (int, float)) and not isinstance(exp, bool) \
+                and now_s >= exp:
+            valid = False
+        if isinstance(nbf, (int, float)) and not isinstance(nbf, bool) \
+                and now_s < nbf:
+            valid = False
     if not valid:
         return (False, Obj({}), Obj({}))
     return (True, header, payload)

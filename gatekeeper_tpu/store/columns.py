@@ -114,16 +114,15 @@ def build_column(spec: ColSpec, objs: list, interner: Interner):
                                   native.MODE_CODES[spec.mode],
                                   interner._ids, interner._strings,
                                   encode_value)
+        # `cells` is a read-only numpy view over the extension's raw
+        # cell buffer (native/__init__.py) — always used as a gather/
+        # copy source, never written in place
         if spec.mode in ("str", "val"):
-            return ScalarColumn(ids=np.asarray(cells, dtype=np.int32)
-                                if cells else np.full((0,), MISSING, np.int32))
+            return ScalarColumn(ids=cells)
         if spec.mode in ("num", "len"):
-            fv = np.asarray(cells, dtype=np.float64) if cells \
-                else np.zeros((0,), dtype=np.float64)
-            pres = ~np.isnan(fv)
-            return NumColumn(values=np.nan_to_num(fv), present=pres)
-        return PresenceColumn(present=np.asarray(cells, dtype=bool)
-                              if cells else np.zeros((0,), dtype=bool))
+            pres = ~np.isnan(cells)
+            return NumColumn(values=np.nan_to_num(cells), present=pres)
+        return PresenceColumn(present=cells)
     if spec.mode == "str":
         ids = np.full((n,), MISSING, dtype=np.int32)
         for i, o in enumerate(objs):

@@ -36,6 +36,8 @@ from gatekeeper_tpu.store.table import ResourceMeta, ResourceTable
 
 TARGET_NAME = "admission.k8s.gatekeeper.sh"
 
+_QUOTE_CACHE: dict[str, str] = {}
+
 
 def _labels_of(review: dict) -> dict:
     obj = review.get("object") or {}
@@ -93,7 +95,13 @@ class K8sValidationTarget(TargetHandler):
             raise ClientError(f"resource {name!r} has no version")
         if not kind:
             raise ClientError(f"resource {name!r} has no kind")
-        escaped = urllib.parse.quote(api_version, safe="")
+        escaped = _QUOTE_CACHE.get(api_version)
+        if escaped is None:
+            # clusters hold a handful of distinct groupVersions; quoting
+            # each once (not per object) matters at 1M-object ingest
+            escaped = urllib.parse.quote(api_version, safe="")
+            if len(_QUOTE_CACHE) < 4096:
+                _QUOTE_CACHE[api_version] = escaped
         if namespace is None:
             key = f"cluster/{escaped}/{kind}/{name}"
         else:

@@ -39,8 +39,16 @@ def enable_persistent_cache(path: str | None = None) -> str:
             return existing
         if _enabled:
             return getattr(jax.config, "jax_compilation_cache_dir", "") or ""
+        try:
+            backend = jax.default_backend()
+        except Exception:
+            backend = "unknown"
+        # per-backend subdirectory: a shared dir accumulates AOT
+        # artifacts from both the CPU tests and the TPU product
+        # process, and loading a mismatched-machine CPU artifact can
+        # SIGILL (cpu_aot_loader refuses with feature-mismatch errors)
         path = path or os.environ.get("GATEKEEPER_XLA_CACHE_DIR") \
-            or os.path.join(os.getcwd(), ".gatekeeper_xla_cache")
+            or os.path.join(os.getcwd(), ".gatekeeper_xla_cache", backend)
         jax.config.update("jax_compilation_cache_dir", path)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)

@@ -27,8 +27,16 @@ class WebhookServer:
     apiserver will not call back over plain HTTP."""
 
     def __init__(self, handler: ValidationHandler, port: int = DEFAULT_PORT,
-                 host: str = "127.0.0.1", metrics=None,
+                 host: str | None = None, metrics=None,
                  cert_dir: str | None = None):
+        # Default bind: all interfaces when serving TLS (in-cluster the
+        # apiserver calls back through a Service to the pod IP — a
+        # loopback bind would refuse every callback and, with
+        # failurePolicy: Ignore, silently disable admission), loopback
+        # when plain HTTP (dev mode must not expose an unauthenticated
+        # admit endpoint on the network).
+        if host is None:
+            host = "" if cert_dir else "127.0.0.1"
         self.handler = handler
         self.metrics = metrics if metrics is not None else handler.metrics
         self.cert_dir = cert_dir

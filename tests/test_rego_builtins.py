@@ -433,6 +433,44 @@ class TestParityStragglers:
                       f'{{"secret": "key"}}); ok; x := pl.iss')
         assert out == ["me"]
 
+    def test_jwt_decode_verify_time_and_aud(self):
+        import base64
+        import hashlib
+        import hmac
+        import json
+
+        def tok(payload):
+            enc = lambda d: base64.urlsafe_b64encode(
+                json.dumps(d).encode()).rstrip(b"=").decode()
+            h, p = enc({"alg": "HS256"}), enc(payload)
+            sig = base64.urlsafe_b64encode(hmac.new(
+                b"key", f"{h}.{p}".encode(),
+                hashlib.sha256).digest()).rstrip(b"=").decode()
+            return f"{h}.{p}.{sig}"
+
+        def ok(t, cons='{"secret": "key"}'):
+            out = self._q(f'[ok, _, _] := io.jwt.decode_verify("{t}", '
+                          f'{cons}); x := ok')
+            return out == [True]
+
+        # exp in the past -> invalid; in the future -> valid
+        # (OPA enforces exp/nbf against current time by default,
+        # topdown/tokens.go builtinJWTDecodeVerify)
+        assert not ok(tok({"iss": "me", "exp": 1}))
+        assert ok(tok({"iss": "me", "exp": 4102444800}))   # year 2100
+        # nbf in the future -> invalid
+        assert not ok(tok({"nbf": 4102444800}))
+        # constraint "time" overrides the clock (nanoseconds)
+        assert ok(tok({"exp": 100}), '{"secret": "key", "time": 50000000000}')
+        assert not ok(tok({"exp": 100}),
+                      '{"secret": "key", "time": 200000000000}')
+        # aud claim requires a matching aud constraint
+        assert not ok(tok({"aud": "svc"}))
+        assert ok(tok({"aud": "svc"}), '{"secret": "key", "aud": "svc"}')
+        assert ok(tok({"aud": ["svc", "other"]}),
+                  '{"secret": "key", "aud": "svc"}')
+        assert not ok(tok({"aud": "svc"}), '{"secret": "key", "aud": "no"}')
+
     def test_template_match_and_infix_forms(self):
         assert self._q('regex.template_match("u:{\\\\d+}", "u:123", "{", "}");'
                        ' x := 1') == [1]
