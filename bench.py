@@ -356,6 +356,57 @@ def bench_admission_replay(detail):
         "n_reviews": n_reviews, "p50_ms": round(p50 * 1e3, 3),
         "p99_ms": round(p99 * 1e3, 3), "reviews_per_sec": round(rps, 1)}
 
+    # replicated serving: N engine-worker processes behind a ReplicaPool
+    # (the reference's webhook-pod-replica model on one host) — scalar
+    # admission evaluation escapes the GIL.  Pointless without cores to
+    # run them on: time-slicing one core only adds RPC overhead.
+    default_workers = min(3, (os.cpu_count() or 1) - 1)
+    n_workers = int(os.environ.get("GATEKEEPER_BENCH_REPLICAS",
+                                   str(default_workers)))
+    if n_workers > 0:
+        from gatekeeper_tpu.client.replica_pool import ReplicaPool
+        try:
+            pool = ReplicaPool.spawn_workers(n_workers, timeout=180)
+        except Exception as e:
+            log(f"[admission] replica spawn failed ({e}); skipping")
+            return
+        try:
+            cp = Backend(pool).new_client([K8sValidationTarget()])
+            cp.add_template(template_doc("K8sRequiredLabels", REQUIRED_LABELS))
+            cp.add_template(template_doc("K8sAllowedRepos", ALLOWED_REPOS))
+            cp.add_constraint(constraint_doc("K8sRequiredLabels", "need-l1",
+                                             {"labels": ["l1"]}))
+            cp.add_constraint(constraint_doc("K8sAllowedRepos", "gcr",
+                                             {"repos": ["gcr.io/"]}))
+            rhandler = ValidationHandler(cp)
+            rhandler.handle(reqs[0])  # warm every replica
+            for r in reqs[1:n_workers]:
+                rhandler.handle(r)
+            rlat: list[float] = []
+            t0 = time.perf_counter()
+            with concurrent.futures.ThreadPoolExecutor(max_workers=32) as ex:
+                def one_r(r):
+                    s = time.perf_counter()
+                    resp = rhandler.handle(r)
+                    with lock:
+                        rlat.append(time.perf_counter() - s)
+                    return resp
+                list(ex.map(one_r, reqs))
+            rwall = time.perf_counter() - t0
+        finally:
+            pool.close()
+        rlat.sort()
+        rp50 = statistics.median(rlat)
+        rp99 = rlat[int(0.99 * len(rlat))]
+        rrps = n_reviews / rwall
+        log(f"[admission] {n_reviews} reviews over {n_workers} worker "
+            f"processes: p50 {rp50*1e3:.2f}ms p99 {rp99*1e3:.2f}ms, "
+            f"{rrps:.0f} reviews/s")
+        detail["admission_replay"]["replicated"] = {
+            "workers": n_workers, "p50_ms": round(rp50 * 1e3, 3),
+            "p99_ms": round(rp99 * 1e3, 3),
+            "reviews_per_sec": round(rrps, 1)}
+
 
 def main():
     detail: dict = {}

@@ -64,6 +64,14 @@ class Driver(abc.ABC):
     @abc.abstractmethod
     def put_data(self, target: str, key: str, meta: ResourceMeta, obj: dict) -> None: ...
 
+    def put_data_batch(self, target: str,
+                       entries: list[tuple[str, ResourceMeta, dict]]) -> None:
+        """Bulk ingest; drivers override to take their write lock once
+        (LocalDriver) or ship one wire call (RemoteDriver) — this
+        default only guarantees the semantics."""
+        for key, meta, obj in entries:
+            self.put_data(target, key, meta, obj)
+
     @abc.abstractmethod
     def delete_data(self, target: str, key: str) -> bool: ...
 

@@ -20,7 +20,7 @@ from __future__ import annotations
 
 import json
 import threading
-import urllib.request
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable
 
@@ -96,6 +96,13 @@ class EngineWorker:
             def log_message(self, *args):  # quiet
                 pass
 
+            # keep-alive: the client reuses one connection per thread
+            # instead of a TCP handshake per Driver call (admission is
+            # call-per-review); Nagle off, or the header/body write
+            # pair interacts with delayed ACK for ~40ms per call
+            protocol_version = "HTTP/1.1"
+            disable_nagle_algorithm = True
+
             def do_POST(self):
                 try:
                     length = int(self.headers.get("Content-Length", "0"))
@@ -152,6 +159,16 @@ class EngineWorker:
                                 m.get("namespace"))
             d.put_data(b["target"], b["key"], meta, b["obj"])
             return {"ok": True}
+        if method == "put_data_batch":
+            entries = []
+            for e in b["entries"]:
+                m = e["meta"]
+                entries.append((e["key"],
+                                ResourceMeta(m["api_version"], m["kind"],
+                                             m["name"], m.get("namespace")),
+                                e["obj"]))
+            d.put_data_batch(b["target"], entries)
+            return {"ok": True}
         if method == "delete_data":
             return {"removed": d.delete_data(b["target"], b["key"])}
         if method == "wipe_data":
@@ -162,6 +179,16 @@ class EngineWorker:
                                             _opts_from_wire(b.get("opts")))
             return {"results": [_result_to_wire(r) for r in results],
                     "trace": trace}
+        if method == "query_review_batch":
+            opts = _opts_from_wire(b.get("opts"))
+            batched = getattr(d, "query_review_batch", None)
+            if batched is not None:
+                pairs = batched(b["target"], b["reviews"], opts)
+            else:
+                pairs = [d.query_review(b["target"], rv, opts)
+                         for rv in b["reviews"]]
+            return {"batch": [{"results": [_result_to_wire(r) for r in rs],
+                               "trace": tr} for rs, tr in pairs]}
         if method == "query_audit":
             results, trace = d.query_audit(b["target"],
                                            _opts_from_wire(b.get("opts")))
@@ -196,19 +223,53 @@ class RemoteDriver(Driver):
     def __init__(self, url: str, timeout: float = 60.0):
         self.url = url.rstrip("/")
         self.timeout = timeout
+        p = urllib.parse.urlparse(self.url)
+        self._host = p.hostname or "127.0.0.1"
+        self._port = p.port or 80
+        self._local = threading.local()   # per-thread keep-alive conn
+
+    def _conn(self):
+        import http.client
+        conn = getattr(self._local, "conn", None)
+        if conn is None:
+            conn = http.client.HTTPConnection(self._host, self._port,
+                                              timeout=self.timeout)
+            self._local.conn = conn
+        return conn
 
     def _call(self, method: str, body: dict) -> dict:
-        req = urllib.request.Request(
-            f"{self.url}/v1/{method}", data=json.dumps(body).encode(),
-            headers={"Content-Type": "application/json"})
-        try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-                return json.loads(resp.read())
-        except urllib.error.HTTPError as e:
-            detail = e.read().decode(errors="replace")[:500]
-            raise ClientError(f"worker {method} failed: {e.code} {detail}")
-        except urllib.error.URLError as e:
-            raise ClientError(f"worker unreachable at {self.url}: {e.reason}")
+        """One POST per Driver-seam call over a per-thread persistent
+        connection (a fresh TCP handshake per admission review costs
+        more than the evaluation itself)."""
+        payload = json.dumps(body).encode()
+        import socket
+        for attempt in (0, 1):
+            conn = self._conn()
+            try:
+                if conn.sock is None:
+                    conn.connect()
+                    # Nagle off: request = two small writes (headers,
+                    # body); coalescing against delayed ACK can cost
+                    # ~40ms per call
+                    conn.sock.setsockopt(socket.IPPROTO_TCP,
+                                         socket.TCP_NODELAY, 1)
+                conn.request("POST", f"/v1/{method}", body=payload,
+                             headers={"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                data = resp.read()
+            except (ConnectionError, OSError, __import__("http").client
+                    .HTTPException) as e:
+                conn.close()
+                self._local.conn = None
+                if attempt == 0:
+                    continue    # stale keep-alive: reconnect once
+                raise ClientError(f"worker unreachable at {self.url}: {e}")
+            if resp.status != 200:
+                detail = data.decode(errors="replace")[:500]
+                raise ClientError(
+                    f"worker {method} failed: {resp.status} {detail}")
+            return json.loads(data)
+        raise AssertionError("unreachable")
 
     # ------------------------------------------------------------------
 
@@ -239,6 +300,13 @@ class RemoteDriver(Driver):
             "meta": {"api_version": meta.api_version, "kind": meta.kind,
                      "name": meta.name, "namespace": meta.namespace}})
 
+    def put_data_batch(self, target: str, entries) -> None:
+        self._call("put_data_batch", {"target": target, "entries": [
+            {"key": key, "obj": obj,
+             "meta": {"api_version": meta.api_version, "kind": meta.kind,
+                      "name": meta.name, "namespace": meta.namespace}}
+            for key, meta, obj in entries]})
+
     def delete_data(self, target: str, key: str) -> bool:
         return bool(self._call("delete_data",
                                {"target": target, "key": key})["removed"])
@@ -251,6 +319,14 @@ class RemoteDriver(Driver):
         out = self._call("query_review", {"target": target, "review": review,
                                           "opts": _opts_to_wire(opts)})
         return [_result_from_wire(r) for r in out["results"]], out.get("trace")
+
+    def query_review_batch(self, target: str, reviews: list[dict],
+                           opts: QueryOpts | None = None) -> list[tuple]:
+        out = self._call("query_review_batch",
+                         {"target": target, "reviews": reviews,
+                          "opts": _opts_to_wire(opts)})
+        return [([_result_from_wire(r) for r in e["results"]], e.get("trace"))
+                for e in out["batch"]]
 
     def query_audit(self, target: str, opts: QueryOpts | None = None):
         out = self._call("query_audit", {"target": target,

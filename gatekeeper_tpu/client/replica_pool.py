@@ -1,0 +1,176 @@
+"""Replicated engine pool: the reference's HA story as a Driver.
+
+The reference scales admission horizontally: N webhook pods each hold a
+FULL copy of the engine state (templates, constraints, synced data —
+rebuilt per pod from watches) and the Service load-balances admission
+requests across them (deploy/gatekeeper.yaml:161 StatefulSet +
+pkg/util/ha_status.go per-pod status slots; no state is sharded).
+
+``ReplicaPool`` packages that shape behind the Driver seam: mutations
+broadcast to every replica (the watch-replication analogue), reviews
+round-robin across replicas (the Service analogue), audits run on one
+replica (the reference audits per pod too — results are idempotent
+status writes).  With subprocess workers (``spawn_workers``) this turns
+the GIL-bound scalar admission path into true multi-core serving on one
+host, exactly as multiple pods would on one node.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import subprocess
+import sys
+import threading
+import time
+from typing import Any
+
+from gatekeeper_tpu.client.interface import Driver, QueryOpts
+from gatekeeper_tpu.client.remote_driver import RemoteDriver
+from gatekeeper_tpu.client.targets import TargetHandler
+from gatekeeper_tpu.errors import ClientError
+from gatekeeper_tpu.store.table import ResourceMeta
+
+
+class ReplicaPool(Driver):
+    """Driver fan-out over N equivalent replicas."""
+
+    def __init__(self, drivers: list[Driver]):
+        if not drivers:
+            raise ClientError("ReplicaPool needs at least one replica")
+        self.drivers = list(drivers)
+        self._rr = itertools.count()
+        self._procs: list[subprocess.Popen] = []
+
+    # -- replica selection ------------------------------------------------
+
+    def _next(self) -> Driver:
+        return self.drivers[next(self._rr) % len(self.drivers)]
+
+    def _all(self, fn: str, *args) -> list:
+        """Apply a mutation on every replica.  Broadcast is sequential
+        and fail-fast: a dead replica surfaces immediately instead of
+        serving stale policy (the reference equivalent is a pod that
+        falls out of the Service on readiness failure)."""
+        return [getattr(d, fn)(*args) for d in self.drivers]
+
+    # -- Driver seam: mutations broadcast ---------------------------------
+
+    def init(self, targets: dict[str, TargetHandler]) -> None:
+        self._all("init", targets)
+
+    def put_template(self, target: str, kind: str, compiled) -> None:
+        self._all("put_template", target, kind, compiled)
+
+    def delete_template(self, target: str, kind: str) -> None:
+        self._all("delete_template", target, kind)
+
+    def put_constraint(self, target: str, kind: str, name: str,
+                       constraint: dict) -> None:
+        self._all("put_constraint", target, kind, name, constraint)
+
+    def delete_constraint(self, target: str, kind: str, name: str) -> None:
+        self._all("delete_constraint", target, kind, name)
+
+    def put_data(self, target: str, key: str, meta: ResourceMeta,
+                 obj: dict) -> None:
+        self._all("put_data", target, key, meta, obj)
+
+    def put_data_batch(self, target: str, entries) -> None:
+        self._all("put_data_batch", target, entries)
+
+    def delete_data(self, target: str, key: str) -> bool:
+        return any(self._all("delete_data", target, key))
+
+    def wipe_data(self, target: str) -> None:
+        self._all("wipe_data", target)
+
+    # -- Driver seam: queries distributed ---------------------------------
+
+    def query_review(self, target: str, review: dict,
+                     opts: QueryOpts | None = None):
+        return self._next().query_review(target, review, opts)
+
+    def query_review_batch(self, target: str, reviews: list[dict],
+                           opts: QueryOpts | None = None) -> list[tuple]:
+        d = self._next()
+        batched = getattr(d, "query_review_batch", None)
+        if batched is not None:
+            return batched(target, reviews, opts)
+        return [d.query_review(target, rv, opts) for rv in reviews]
+
+    def query_audit(self, target: str, opts: QueryOpts | None = None):
+        # audits are whole-state queries; any single replica answers
+        # (the reference runs the audit on each pod independently and
+        # the status writes are last-writer-wins, ha_status.go)
+        return self.drivers[0].query_audit(target, opts)
+
+    def dump(self) -> dict:
+        return self.drivers[0].dump()
+
+    # -- subprocess worker management -------------------------------------
+
+    @classmethod
+    def spawn_workers(cls, n: int, timeout: float = 60.0,
+                      env: dict | None = None) -> "ReplicaPool":
+        """Launch ``n`` engine-worker subprocesses
+        (``python -m gatekeeper_tpu.cmd.worker``) on ephemeral ports and
+        return a pool of RemoteDrivers over them.  Workers are separate
+        OS processes, so scalar admission evaluation escapes the GIL —
+        one host serves like ``n`` webhook pods."""
+        procs: list[tuple[subprocess.Popen, str]] = []
+        try:
+            for _ in range(n):
+                proc = subprocess.Popen(
+                    [sys.executable, "-m", "gatekeeper_tpu.cmd.worker",
+                     "--port", "0"],
+                    stdout=subprocess.DEVNULL, stderr=subprocess.PIPE,
+                    env={**os.environ, **(env or {})}, text=True,
+                    cwd=os.path.dirname(os.path.dirname(
+                        os.path.dirname(os.path.abspath(__file__)))))
+                # the worker prints "engine worker up at <url>" once ready
+                line = ""
+                deadline = time.monotonic() + timeout
+                while time.monotonic() < deadline:
+                    line = proc.stderr.readline()
+                    if "engine worker up at" in line or not line:
+                        break
+                if "engine worker up at" not in line:
+                    raise ClientError(
+                        f"worker failed to start (exit={proc.poll()})")
+                url = line.rsplit(" ", 1)[-1].strip()
+                procs.append((proc, url))
+                # drain further stderr so the pipe never blocks the child
+                threading.Thread(target=_drain, args=(proc.stderr,),
+                                 daemon=True).start()
+            pool = cls([RemoteDriver(url) for _proc, url in procs])
+            pool._procs = [p for p, _u in procs]
+            return pool
+        except Exception:
+            for proc, _url in procs:
+                proc.terminate()
+            raise
+
+    def close(self) -> None:
+        for proc in self._procs:
+            proc.terminate()
+        for proc in self._procs:
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        self._procs.clear()
+
+    def __enter__(self) -> "ReplicaPool":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.close()
+
+
+def _drain(stream) -> None:
+    try:
+        for _ in stream:
+            pass
+    except Exception:
+        pass
