@@ -265,9 +265,9 @@ class Client:
         evaluated as one matrix per target; otherwise per-review scalar
         queries run under the shared snapshot."""
         with self._lock.read():
-            batched = getattr(self.driver, "query_review_batch", None)
-            if batched is None or tracing:
+            if tracing:
                 return [self._review_locked(obj, tracing) for obj in objs]
+            batched = self.driver.query_review_batch
             responses = [Responses() for _ in objs]
             for name, handler in self.targets.items():
                 idx: list[int] = []

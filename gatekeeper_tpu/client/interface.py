@@ -82,6 +82,14 @@ class Driver(abc.ABC):
     def query_review(self, target: str, review: dict,
                      opts: QueryOpts | None = None) -> tuple[list[Result], str | None]: ...
 
+    def query_review_batch(self, target: str, reviews: list[dict],
+                           opts: QueryOpts | None = None) -> list[tuple]:
+        """Batch admission; drivers override to evaluate as one pass
+        (JaxDriver's [C, B] device path, RemoteDriver's single wire
+        call).  The default is the per-review loop, so every call site
+        may invoke this unconditionally."""
+        return [self.query_review(target, rv, opts) for rv in reviews]
+
     @abc.abstractmethod
     def query_audit(self, target: str,
                     opts: QueryOpts | None = None) -> tuple[list[Result], str | None]: ...

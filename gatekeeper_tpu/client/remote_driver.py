@@ -18,7 +18,9 @@ cannot tell the difference (the conformance suite runs against it).
 
 from __future__ import annotations
 
+import http.client
 import json
+import socket
 import threading
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -181,12 +183,7 @@ class EngineWorker:
                     "trace": trace}
         if method == "query_review_batch":
             opts = _opts_from_wire(b.get("opts"))
-            batched = getattr(d, "query_review_batch", None)
-            if batched is not None:
-                pairs = batched(b["target"], b["reviews"], opts)
-            else:
-                pairs = [d.query_review(b["target"], rv, opts)
-                         for rv in b["reviews"]]
+            pairs = d.query_review_batch(b["target"], b["reviews"], opts)
             return {"batch": [{"results": [_result_to_wire(r) for r in rs],
                                "trace": tr} for rs, tr in pairs]}
         if method == "query_audit":
@@ -229,7 +226,6 @@ class RemoteDriver(Driver):
         self._local = threading.local()   # per-thread keep-alive conn
 
     def _conn(self):
-        import http.client
         conn = getattr(self._local, "conn", None)
         if conn is None:
             conn = http.client.HTTPConnection(self._host, self._port,
@@ -237,14 +233,19 @@ class RemoteDriver(Driver):
             self._local.conn = conn
         return conn
 
-    def _call(self, method: str, body: dict) -> dict:
+    def _call(self, method: str, body: dict,
+              no_retry: bool = False) -> dict:
         """One POST per Driver-seam call over a per-thread persistent
         connection (a fresh TCP handshake per admission review costs
-        more than the evaluation itself)."""
+        more than the evaluation itself).  A failure on a REUSED
+        connection is retried once — the server closing an idle
+        keep-alive between requests is routine — but never a timeout
+        (the call may still be executing) and never when `no_retry`
+        (non-idempotent answers, e.g. delete_data's removed flag)."""
         payload = json.dumps(body).encode()
-        import socket
         for attempt in (0, 1):
             conn = self._conn()
+            was_reused = conn.sock is not None
             try:
                 if conn.sock is None:
                     conn.connect()
@@ -257,11 +258,16 @@ class RemoteDriver(Driver):
                              headers={"Content-Type": "application/json"})
                 resp = conn.getresponse()
                 data = resp.read()
-            except (ConnectionError, OSError, __import__("http").client
-                    .HTTPException) as e:
+            except socket.timeout:
                 conn.close()
                 self._local.conn = None
-                if attempt == 0:
+                raise ClientError(
+                    f"worker {method} timed out after {self.timeout}s")
+            except (ConnectionError, OSError,
+                    http.client.HTTPException) as e:
+                conn.close()
+                self._local.conn = None
+                if attempt == 0 and was_reused and not no_retry:
                     continue    # stale keep-alive: reconnect once
                 raise ClientError(f"worker unreachable at {self.url}: {e}")
             if resp.status != 200:
@@ -308,8 +314,8 @@ class RemoteDriver(Driver):
             for key, meta, obj in entries]})
 
     def delete_data(self, target: str, key: str) -> bool:
-        return bool(self._call("delete_data",
-                               {"target": target, "key": key})["removed"])
+        return bool(self._call("delete_data", {"target": target, "key": key},
+                               no_retry=True)["removed"])
 
     def wipe_data(self, target: str) -> None:
         self._call("wipe_data", {"target": target})

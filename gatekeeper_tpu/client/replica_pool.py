@@ -22,7 +22,6 @@ import os
 import subprocess
 import sys
 import threading
-import time
 from typing import Any
 
 from gatekeeper_tpu.client.interface import Driver, QueryOpts
@@ -48,11 +47,30 @@ class ReplicaPool(Driver):
         return self.drivers[next(self._rr) % len(self.drivers)]
 
     def _all(self, fn: str, *args) -> list:
-        """Apply a mutation on every replica.  Broadcast is sequential
-        and fail-fast: a dead replica surfaces immediately instead of
-        serving stale policy (the reference equivalent is a pod that
-        falls out of the Service on readiness failure)."""
-        return [getattr(d, fn)(*args) for d in self.drivers]
+        """Apply a mutation on every replica.  A replica whose
+        broadcast fails is EVICTED from rotation before the error
+        surfaces — the remaining replicas stay mutually consistent and
+        queries never round-robin onto half-updated state (the
+        reference analogue: a failing pod drops out of the Service on
+        readiness; it does not keep receiving admission traffic)."""
+        out: list = []
+        failed: list[tuple[Driver, Exception]] = []
+        for d in list(self.drivers):
+            try:
+                out.append(getattr(d, fn)(*args))
+            except Exception as e:
+                failed.append((d, e))
+        if failed:
+            dead = {id(d) for d, _e in failed}
+            survivors = [d for d in self.drivers if id(d) not in dead]
+            if not survivors:
+                raise ClientError(
+                    f"all replicas failed {fn}: {failed[0][1]}")
+            self.drivers = survivors     # atomic swap for readers
+            raise ClientError(
+                f"{len(failed)} replica(s) evicted after failed {fn}: "
+                f"{failed[0][1]}")
+        return out
 
     # -- Driver seam: mutations broadcast ---------------------------------
 
@@ -93,11 +111,7 @@ class ReplicaPool(Driver):
 
     def query_review_batch(self, target: str, reviews: list[dict],
                            opts: QueryOpts | None = None) -> list[tuple]:
-        d = self._next()
-        batched = getattr(d, "query_review_batch", None)
-        if batched is not None:
-            return batched(target, reviews, opts)
-        return [d.query_review(target, rv, opts) for rv in reviews]
+        return self._next().query_review_batch(target, reviews, opts)
 
     def query_audit(self, target: str, opts: QueryOpts | None = None):
         # audits are whole-state queries; any single replica answers
@@ -128,16 +142,17 @@ class ReplicaPool(Driver):
                     env={**os.environ, **(env or {})}, text=True,
                     cwd=os.path.dirname(os.path.dirname(
                         os.path.dirname(os.path.abspath(__file__)))))
-                # the worker prints "engine worker up at <url>" once ready
-                line = ""
-                deadline = time.monotonic() + timeout
-                while time.monotonic() < deadline:
-                    line = proc.stderr.readline()
-                    if "engine worker up at" in line or not line:
-                        break
-                if "engine worker up at" not in line:
+                # the worker prints "engine worker up at <url>" once
+                # ready; read it on a thread so a silently-hung worker
+                # (stuck import, buffered output) cannot block past the
+                # deadline — readline() alone would wait forever
+                line = _readline_with_timeout(
+                    proc.stderr, timeout,
+                    lambda ln: "engine worker up at" in ln)
+                if line is None or "engine worker up at" not in line:
                     raise ClientError(
-                        f"worker failed to start (exit={proc.poll()})")
+                        f"worker failed to start within {timeout}s "
+                        f"(exit={proc.poll()})")
                 url = line.rsplit(" ", 1)[-1].strip()
                 procs.append((proc, url))
                 # drain further stderr so the pipe never blocks the child
@@ -174,3 +189,25 @@ def _drain(stream) -> None:
             pass
     except Exception:
         pass
+
+
+def _readline_with_timeout(stream, timeout: float, want) -> str | None:
+    """First line matching `want` (or the line that ended the stream),
+    or None on timeout.  Runs the blocking readline on a daemon thread;
+    on timeout the thread is abandoned (the caller terminates the
+    subprocess, which unblocks it)."""
+    box: list[str | None] = [None]
+    done = threading.Event()
+
+    def run():
+        while True:
+            ln = stream.readline()
+            if not ln or want(ln):
+                box[0] = ln or None
+                done.set()
+                return
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    done.wait(timeout)
+    return box[0]

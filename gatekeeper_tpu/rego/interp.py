@@ -59,6 +59,15 @@ class Interpreter:
         self._builtinfn: dict[int, Any] = {}  # Call -> resolved builtin
         for r in self.module.rules:
             _walk_rule(r, self._index_term)
+        # closure-compiled body tier (rego/closures.py): rule bodies run
+        # as pre-compiled closure trees; the recursive path below stays
+        # the oracle (GATEKEEPER_NO_CLOSURES=1 forces it, and the parity
+        # suite diffs the two over the library + fuzz corpus)
+        import os
+        self._closures = None
+        if os.environ.get("GATEKEEPER_NO_CLOSURES") != "1":
+            from gatekeeper_tpu.rego.closures import ClosureCompiler
+            self._closures = ClosureCompiler(self)
 
     def _index_term(self, term) -> None:
         t = term.__class__
@@ -93,7 +102,7 @@ class Interpreter:
             if rule.kind != "partial_set":
                 continue
             for env in self._eval_body(ctx, rule.body, 0, {}):
-                for v, _ in self._eval_term(ctx, rule.key, env):
+                for v, _ in self._term_eval(ctx, rule.key, env):
                     if v not in seen:
                         seen.add(v)
                         out.append(v)
@@ -114,6 +123,12 @@ class Interpreter:
     # ------------------------------------------------------------------
     # rule evaluation
 
+    def _term_eval(self, ctx: _Ctx, term, env: dict):
+        """Rule-level term evaluation through the compiled tier when on."""
+        if self._closures is not None:
+            return self._closures.term(term)(ctx, env)
+        return self._eval_term(ctx, term, env)
+
     def _rule_value(self, ctx: _Ctx, name: str) -> Any:
         key = ("rule", name)
         if key in ctx.memo:
@@ -131,7 +146,7 @@ class Interpreter:
                 if rule.is_default:
                     continue
                 for env in self._eval_body(ctx, rule.body, 0, {}):
-                    for v, _ in self._eval_term(ctx, rule.key, env):
+                    for v, _ in self._term_eval(ctx, rule.key, env):
                         if v not in seen:
                             seen.add(v)
                             members.append(v)
@@ -140,8 +155,8 @@ class Interpreter:
             pairs: dict = {}
             for rule in rules:
                 for env in self._eval_body(ctx, rule.body, 0, {}):
-                    for k, env2 in self._eval_term(ctx, rule.key, env):
-                        for v, _ in self._eval_term(ctx, rule.value, env2):
+                    for k, env2 in self._term_eval(ctx, rule.key, env):
+                        for v, _ in self._term_eval(ctx, rule.value, env2):
                             if k in pairs and not (pairs[k] == v and _same_kind(pairs[k], v)):
                                 raise ConflictError(
                                     f"partial object rule {name}: conflicting values for key {k!r}")
@@ -152,14 +167,14 @@ class Interpreter:
             default_val = UNDEFINED
             for rule in rules:
                 if rule.is_default:
-                    for v, _ in self._eval_term(ctx, rule.value, {}):
+                    for v, _ in self._term_eval(ctx, rule.value, {}):
                         default_val = v
                     continue
                 for env in self._eval_body(ctx, rule.body, 0, {}):
                     if rule.value is None:
                         v = True
                     else:
-                        got = list(self._eval_term(ctx, rule.value, env))
+                        got = list(self._term_eval(ctx, rule.value, env))
                         if not got:
                             continue
                         v = got[0][0]
@@ -185,7 +200,7 @@ class Interpreter:
                     if rule.value is None:
                         v = True
                     else:
-                        got = list(self._eval_term(ctx, rule.value, env2))
+                        got = list(self._term_eval(ctx, rule.value, env2))
                         if not got:
                             continue
                         v = got[0][0]
@@ -209,6 +224,9 @@ class Interpreter:
     # body / literal evaluation
 
     def _eval_body(self, ctx: _Ctx, body, i: int, env: dict) -> Iterator[dict]:
+        if self._closures is not None and i == 0:
+            yield from self._closures.body(body)(ctx, env)
+            return
         if i >= len(body):
             yield env
             return

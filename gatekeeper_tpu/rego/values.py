@@ -40,6 +40,23 @@ class Obj(Mapping):
     def __getitem__(self, k):
         return self._d[k]
 
+    def __contains__(self, k) -> bool:
+        # Mapping's default __contains__ probes via __getitem__ +
+        # exception handling — measurably hot on the admission path
+        return k in self._d
+
+    def items(self):
+        return self._d.items()
+
+    def keys(self):
+        return self._d.keys()
+
+    def values(self):
+        return self._d.values()
+
+    def get(self, k, default=None):
+        return self._d.get(k, default)
+
     def __iter__(self) -> Iterator:
         return iter(self._d)
 

@@ -88,18 +88,15 @@ class MicroBatcher:
                         p.event.set()
                     self._queue.clear()
                     return
-                # batch window: let more requests coalesce, but never
-                # sleep once the batch is already full — and leave early
-                # the moment it fills (woken by submit) instead of
-                # unconditionally burning max_wait
-                if self.max_wait > 0 and len(self._queue) < self.max_batch:
-                    deadline = time.monotonic() + self.max_wait
-                    while len(self._queue) < self.max_batch \
-                            and not self._stop:
-                        remaining = deadline - time.monotonic()
-                        if remaining <= 0:
-                            break
-                        self._wake.wait(remaining)
+                # natural batching: under load, requests that arrived
+                # while the previous batch evaluated are already queued
+                # — take them all immediately (a timed window would only
+                # add latency without growing the batch).  The window
+                # engages solely for a singleton queue, giving one more
+                # arrival the chance to share the evaluation pass.
+                if self.max_wait > 0 and len(self._queue) == 1 \
+                        and not self._stop:
+                    self._wake.wait(self.max_wait)
                 batch, self._queue = (self._queue[:self.max_batch],
                                       self._queue[self.max_batch:])
             if not batch:

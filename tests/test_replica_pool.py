@@ -87,6 +87,29 @@ class TestReplicaPool:
             assert results == []
 
 
+class TestEviction:
+    def test_failed_replica_leaves_rotation(self, pool2):
+        c = Backend(pool2).new_client([K8sValidationTarget()])
+        _setup(c)
+        # kill replica 1's worker out from under the pool: the next
+        # broadcast must evict it (queries would otherwise round-robin
+        # onto half-updated state) and survivors stay consistent
+        import pytest as _pytest
+        from gatekeeper_tpu.errors import ClientError
+        victim = pool2.drivers[1]
+        victim.url = "http://127.0.0.1:1"        # unroutable
+        victim._host, victim._port = "127.0.0.1", 1
+        victim._local.__dict__.clear()
+        with _pytest.raises(ClientError, match="evicted"):
+            c.add_data(_ns("late", {}))
+        assert len(pool2.drivers) == 1
+        # the surviving replica took the mutation and keeps serving
+        assert "late" in _audit_names(c)
+        req = {"kind": {"group": "", "version": "v1", "kind": "Namespace"},
+               "name": "q", "operation": "CREATE", "object": _ns("q", {})}
+        assert len(c.review(req).by_target[TARGET_NAME].results) == 1
+
+
 class TestSpawnWorkers:
     def test_subprocess_worker_end_to_end(self):
         with ReplicaPool.spawn_workers(1, timeout=120) as pool:
