@@ -208,8 +208,7 @@ def bench_two_engines(detail, key, resources, templates, constraints,
         for cd in constraints:
             c.add_constraint(cd)
         sub = resources if nm == "jax" or oracle_n is None else resources[:oracle_n]
-        for r in sub:
-            c.add_data(r)
+        c.add_data_batch(sub)
         drv.query_audit(TARGET_NAME, QueryOpts(limit_per_constraint=CAP))
         best, _first, n_res = timed_audit(drv)
         scale = len(resources) / max(len(sub), 1)
@@ -288,6 +287,45 @@ def bench_library(detail):
         "cold_seconds": round(cold_s, 2), "ingest_seconds": round(ingest_s, 2),
         "capped_results": n_res,
         "cpu_oracle_extrapolated_seconds": round(t_cpu, 2)}
+
+
+def bench_selector_heavy(detail):
+    """namespaceSelector-heavy matching at 100k namespaces: the
+    namespace-axis selector evaluation is the cost center (VERDICT r2
+    weak #5 — previously scalar per-namespace)."""
+    n_ns = 2_000 if QUICK else 100_000
+    rng = random.Random(8)
+    resources = []
+    for i in range(n_ns):
+        labels = {"team": rng.choice(["a", "b", "c", "d"]),
+                  "stage": rng.choice(["dev", "prod"])}
+        if rng.random() < 0.5:
+            labels["owner"] = f"u{rng.randrange(64)}"
+        resources.append({"apiVersion": "v1", "kind": "Namespace",
+                          "metadata": {"name": f"ns-{i:06d}",
+                                       "labels": labels}})
+    for i in range(n_ns // 4):                    # pods spread across ns
+        resources.append({
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": f"p-{i:06d}",
+                         "namespace": f"ns-{rng.randrange(n_ns):06d}",
+                         "labels": {"app": rng.choice(["x", "y"])}},
+            "spec": {"containers": [{"name": "c",
+                                     "image": "gcr.io/app:latest"}]}})
+    constraints = []
+    for j in range(8):
+        constraints.append(constraint_doc(
+            "K8sRequiredLabels", f"sel-{j}", {"labels": ["owner"]},
+            match={"namespaceSelector": {
+                "matchExpressions": [
+                    {"key": "team", "operator": "In",
+                     "values": [rng.choice(["a", "b", "c", "d"])]},
+                    {"key": "stage", "operator":
+                        rng.choice(["Exists", "DoesNotExist"])}]}}))
+    bench_two_engines(
+        detail, f"selector_heavy_{n_ns}_namespaces", resources,
+        [template_doc("K8sRequiredLabels", REQUIRED_LABELS)],
+        constraints, oracle_n=2_000)
 
 
 def bench_regex_heavy(detail):
@@ -415,6 +453,7 @@ def main():
     bench_allowed_repos(detail)
     bench_library(detail)
     bench_regex_heavy(detail)
+    bench_selector_heavy(detail)
     bench_admission_replay(detail)
     print(json.dumps({"metric": "audit_constraint_evals_per_sec",
                       "value": round(value, 1), "unit": "evals/s",

@@ -49,9 +49,11 @@ class _LabelIndex:
         self.vals = vals
         self.n = n
         self._value_cache: dict[int, np.ndarray] = {}
+        self._present_cache: dict[int, np.ndarray] = {}
 
     def value_of(self, key_id: int) -> np.ndarray:
-        """int32 [n]: label value id for key, MISSING where absent."""
+        """int32 [n]: label value id for key, MISSING where absent OR
+        where the value is not a string (unrepresentable as an id)."""
         hit = self._value_cache.get(key_id)
         if hit is not None:
             return hit
@@ -63,7 +65,61 @@ class _LabelIndex:
         return out
 
     def has_key(self, key_id: int) -> np.ndarray:
-        return self.value_of(key_id) != MISSING
+        """Key PRESENCE, independent of value representability: a label
+        whose value is non-string has no value id but still exists —
+        `Exists` must see it (the scalar matcher's `key in labels`),
+        else the mask under-approximates and violations are dropped."""
+        hit = self._present_cache.get(key_id)
+        if hit is None:
+            out = np.zeros((self.n,), dtype=bool)
+            if key_id != MISSING and len(self.keys):
+                out[self.row_ids[self.keys == key_id]] = True
+            self._present_cache[key_id] = hit = out
+        return hit
+
+
+def _selector_ok(it, lab: _LabelIndex, selector: dict) -> np.ndarray:
+    """matches_label_selector vectorized over whatever axis `lab`
+    indexes (resources for labelSelector, cached namespaces for
+    namespaceSelector — same semantics, target.go:178-255)."""
+    ok = np.ones((lab.n,), dtype=bool)
+    for k, v in (selector.get("matchLabels") or {}).items():
+        vid = it.lookup(v) if isinstance(v, str) else MISSING
+        ok &= lab.value_of(it.lookup(k) if isinstance(k, str) else MISSING) == vid \
+            if vid != MISSING else np.zeros((lab.n,), dtype=bool)
+    for expr in selector.get("matchExpressions") or []:
+        ok &= ~_expr_violated(it, lab, expr)
+    return ok
+
+
+def _expr_violated(it, lab: _LabelIndex, expr: dict) -> np.ndarray:
+    """Per-operator violation semantics (missing key violates
+    In/Exists, NotIn never violates on missing, empty values disarm
+    In/NotIn) — target.go:178-219."""
+    op = expr.get("operator", "")
+    key = expr.get("key", "")
+    kid = it.lookup(key) if isinstance(key, str) else MISSING
+    values = expr.get("values") or []
+    has = lab.has_key(kid)
+    if op == "Exists":
+        return ~has
+    if op == "DoesNotExist":
+        return has
+    # an unseen selector value has no id: drop it, or lookup's MISSING
+    # would alias the absent-value sentinel and In/NotIn would treat
+    # every unrepresentable label value as a match
+    vids = [x for x in (it.lookup(v) for v in values if isinstance(v, str))
+            if x != MISSING]
+    val = lab.value_of(kid)
+    in_vals = np.isin(val, np.asarray(vids, dtype=np.int32)) if vids \
+        else np.zeros((lab.n,), dtype=bool)
+    if op == "In":
+        if not values:
+            return ~has
+        return ~has | (has & ~in_vals)
+    if op == "NotIn":
+        return has & in_vals if values else np.zeros((lab.n,), dtype=bool)
+    return np.zeros((lab.n,), dtype=bool)  # unknown operator: no clause
 
 
 class _View:
@@ -110,11 +166,28 @@ class _View:
                                            self.n)
         return self._labels
 
-    # -- namespace labels ---------------------------------------------
+    # -- selector primitives -------------------------------------------
 
-    def _namespace_labels(self):
-        """(ns name ids [K] sorted, per-resource slot [n] into 0..K or
-        -1, label dicts per slot)."""
+    def selector_ok_obj(self, selector: dict) -> np.ndarray:
+        """matches_label_selector over object labels, vectorized [n]."""
+        return _selector_ok(self.table.interner, self.labels, selector)
+
+    def selector_ok_ns(self, selector: dict) -> np.ndarray:
+        """namespaceSelector, vectorized over the NAMESPACE axis: the
+        selector is evaluated once per cached namespace with the same
+        primitives as the object path (not a Python loop calling the
+        scalar matcher — 100k namespaces made that the matching
+        bottleneck), then gathered per resource; uncached namespace
+        (slot -1) -> False."""
+        ns_ids, slots, lab = self._namespace_label_index()
+        ok_ns = _selector_ok(self.table.interner, lab, selector)   # [K]
+        padded = np.append(ok_ns, False)                # last = uncached
+        return padded[np.where(slots >= 0, slots, len(ns_ids))] \
+            & (slots >= 0)
+
+    def _namespace_label_index(self):
+        """(ns name ids [K] sorted, per-resource slot [n], _LabelIndex
+        over the K namespaces), built once per view."""
         if self._ns_index is not None:
             return self._ns_index
         items = self.table.namespace_label_items()
@@ -126,61 +199,19 @@ class _View:
             slots = np.where(ns_ids[pos] == col, pos, -1).astype(np.int32)
         else:
             slots = np.full(col.shape, -1, dtype=np.int32)
-        dicts = [dict(items[int(i)]) for i in ns_ids]
-        self._ns_index = (ns_ids, slots, dicts)
+        keys: list[int] = []
+        vals: list[int] = []
+        offsets = np.zeros((len(ns_ids) + 1,), dtype=np.int64)
+        for s, nid in enumerate(ns_ids):
+            for k, v in items[int(nid)]:
+                keys.append(k)
+                vals.append(v)
+            offsets[s + 1] = len(keys)
+        lab = _LabelIndex(np.asarray(keys, dtype=np.int32),
+                          np.asarray(vals, dtype=np.int32),
+                          offsets, len(ns_ids))
+        self._ns_index = (ns_ids, slots, lab)
         return self._ns_index
-
-    # -- selector primitives -------------------------------------------
-
-    def selector_ok_obj(self, selector: dict) -> np.ndarray:
-        """matches_label_selector over object labels, vectorized [n]."""
-        it = self.table.interner
-        lab = self.labels
-        ok = np.ones((lab.n,), dtype=bool)
-        for k, v in (selector.get("matchLabels") or {}).items():
-            vid = it.lookup(v) if isinstance(v, str) else MISSING
-            ok &= lab.value_of(it.lookup(k) if isinstance(k, str) else MISSING) == vid \
-                if vid != MISSING else np.zeros((lab.n,), dtype=bool)
-        for expr in selector.get("matchExpressions") or []:
-            ok &= ~self._expr_violated_obj(expr)
-        return ok
-
-    def _expr_violated_obj(self, expr: dict) -> np.ndarray:
-        it = self.table.interner
-        lab = self.labels
-        op = expr.get("operator", "")
-        key = expr.get("key", "")
-        kid = it.lookup(key) if isinstance(key, str) else MISSING
-        values = expr.get("values") or []
-        has = lab.has_key(kid)
-        if op == "Exists":
-            return ~has
-        if op == "DoesNotExist":
-            return has
-        vids = [it.lookup(v) for v in values if isinstance(v, str)]
-        val = lab.value_of(kid)
-        in_vals = np.isin(val, np.asarray(vids, dtype=np.int32)) if vids \
-            else np.zeros((lab.n,), dtype=bool)
-        if op == "In":
-            if not values:
-                return ~has
-            return ~has | (has & ~in_vals)
-        if op == "NotIn":
-            return has & in_vals if values else np.zeros((lab.n,), dtype=bool)
-        return np.zeros((lab.n,), dtype=bool)  # unknown operator: no clause
-
-    def selector_ok_ns(self, selector: dict) -> np.ndarray:
-        """namespaceSelector: resolve per-namespace then gather; uncached
-        namespace (slot -1) -> False."""
-        from gatekeeper_tpu.target.k8s import matches_label_selector
-        it = self.table.interner
-        ns_ids, slots, dicts = self._namespace_labels()
-        per_ns = np.zeros((len(ns_ids) + 1,), dtype=bool)  # last = uncached
-        for s, d in enumerate(dicts):
-            labels = {it.string(k): (it.string(v) if v != MISSING else None)
-                      for k, v in d.items()}
-            per_ns[s] = matches_label_selector(selector, labels)
-        return per_ns[np.where(slots >= 0, slots, len(ns_ids))] & (slots >= 0)
 
     # -- the mask over this view --------------------------------------
 
@@ -206,14 +237,21 @@ class _View:
                 for ks in kinds:
                     groups = ks.get("apiGroups") or []
                     knames = ks.get("kinds") or []
+                    # unseen names have no id; drop them so lookup's
+                    # MISSING can't alias rows whose identity column
+                    # holds the absent sentinel
+                    gids = [x for x in (it.lookup(g) for g in groups
+                                        if isinstance(g, str))
+                            if x != MISSING]
+                    kids = [x for x in (it.lookup(k) for k in knames
+                                        if isinstance(k, str))
+                            if x != MISSING]
                     gm = np.ones((n,), dtype=bool) if "*" in groups else \
-                        np.isin(self.group_ids, np.asarray(
-                            [it.lookup(g) for g in groups if isinstance(g, str)],
-                            dtype=np.int32))
+                        np.isin(self.group_ids,
+                                np.asarray(gids, dtype=np.int32))
                     nm = np.ones((n,), dtype=bool) if "*" in knames else \
-                        np.isin(self.kind_ids, np.asarray(
-                            [it.lookup(k) for k in knames if isinstance(k, str)],
-                            dtype=np.int32))
+                        np.isin(self.kind_ids,
+                                np.asarray(kids, dtype=np.int32))
                     km |= gm & nm
                 m &= km
 

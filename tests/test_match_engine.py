@@ -109,3 +109,87 @@ def test_match_engine_generation_cache():
     key2, meta2, _ = handler.process_data(obj2)
     table.upsert(key2, obj2, meta2)
     assert engine.mask([c]).tolist() == [[True, False]]
+
+
+def test_exists_with_non_string_label_value():
+    """`Exists` must see a key whose value is not a string (the scalar
+    matcher's `key in labels`); the mask under-approximating here means
+    silently dropped violations."""
+    table = ResourceTable()
+    handler = K8sValidationTarget()
+    obj = {"apiVersion": "v1", "kind": "Pod",
+           "metadata": {"name": "p", "namespace": "ns",
+                        "labels": {"weird": 5, "ok": "x"}}}
+    key, meta, _ = handler.process_data(obj)
+    table.upsert(key, obj, meta)
+    engine = MatchEngine(table)
+    cases = [
+        ({"matchExpressions": [{"key": "weird", "operator": "Exists"}]}, True),
+        ({"matchExpressions": [{"key": "weird",
+                                "operator": "DoesNotExist"}]}, False),
+        ({"matchExpressions": [{"key": "weird", "operator": "In",
+                                "values": ["5"]}]}, False),
+        ({"matchExpressions": [{"key": "weird", "operator": "NotIn",
+                                "values": ["5"]}]}, True),
+    ]
+    for selector, _want in cases:
+        c = {"kind": "K", "metadata": {"name": "c"},
+             "spec": {"match": {"labelSelector": selector}}}
+        review = handler.make_review(meta, obj)
+        expect = any(True for _ in handler.matching_constraints(
+            review, [c], table))
+        got = bool(engine.mask([c])[0, 0])
+        assert got == expect, (selector, got, expect)
+
+
+def test_namespace_selector_vectorized_parity():
+    """namespaceSelector over many namespaces: the vectorized
+    namespace-axis evaluation must agree with the scalar matcher for
+    every resource, including uncached namespaces and non-string
+    namespace label values."""
+    rng = random.Random(23)
+    table = ResourceTable()
+    handler = K8sValidationTarget()
+    for i in range(40):
+        labels = {}
+        for k in ("team", "stage"):
+            if rng.random() < 0.6:
+                labels[k] = rng.choice(["x", "y", "z"])
+        if rng.random() < 0.15:
+            labels["odd"] = i          # non-string value
+        ns = {"apiVersion": "v1", "kind": "Namespace",
+              "metadata": {"name": f"ns{i}", "labels": labels}}
+        k_, m_, _ = handler.process_data(ns)
+        table.upsert(k_, ns, m_)
+    for i in range(120):
+        ns = f"ns{rng.randrange(50)}"   # some namespaces uncached
+        obj = {"apiVersion": "v1", "kind": "Pod",
+               "metadata": {"name": f"p{i}", "namespace": ns}}
+        k_, m_, _ = handler.process_data(obj)
+        table.upsert(k_, obj, m_)
+    engine = MatchEngine(table)
+    selectors = [
+        {"matchLabels": {"team": "x"}},
+        {"matchExpressions": [{"key": "stage", "operator": "Exists"}]},
+        {"matchExpressions": [{"key": "odd", "operator": "Exists"}]},
+        {"matchExpressions": [{"key": "team", "operator": "In",
+                               "values": ["y", "z"]}]},
+        {"matchExpressions": [{"key": "team", "operator": "NotIn",
+                               "values": ["x"]}]},
+        {"matchLabels": {"team": "x"},
+         "matchExpressions": [{"key": "stage", "operator": "DoesNotExist"}]},
+    ]
+    constraints = [{"kind": "K", "metadata": {"name": f"c{j}"},
+                    "spec": {"match": {"namespaceSelector": s}}}
+                   for j, s in enumerate(selectors)]
+    mask = engine.mask(constraints)
+    for ci, c in enumerate(constraints):
+        for row in range(table.n_rows):
+            meta = table.meta_at(row)
+            if meta is None:
+                continue
+            review = handler.make_review(meta, table.object_at(row))
+            expect = any(True for _ in handler.matching_constraints(
+                review, [c], table))
+            assert bool(mask[ci, row]) == expect, (
+                ci, row, meta.namespace, mask[ci, row], expect)
