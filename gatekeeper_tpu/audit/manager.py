@@ -35,6 +35,9 @@ from gatekeeper_tpu.cluster.fake import FakeCluster
 from gatekeeper_tpu.api.config import GVK
 from gatekeeper_tpu.errors import ApiError, NotFoundError
 from gatekeeper_tpu.utils.metrics import Metrics
+from gatekeeper_tpu.utils.log import logger
+
+_log = logger("audit")
 
 CRD_NAME = "constrainttemplates.templates.gatekeeper.sh"
 CRD_GVK = GVK("apiextensions.k8s.io", "v1beta1", "CustomResourceDefinition")
@@ -87,6 +90,13 @@ class AuditManager:
             self.metrics.timer("audit_sweep_seconds").observe(
                 report["total_seconds"])
         self.last_sweep = report
+        if report["skipped"]:
+            _log.debug("audit skipped: template CRD not deployed")
+        else:
+            _log.info("audit sweep complete",
+                      violations=report["violations"],
+                      constraints_updated=report["constraints_updated"],
+                      seconds=round(report.get("total_seconds", 0.0), 3))
         return report
 
     def _sweep(self, t0: float) -> dict:
@@ -230,7 +240,8 @@ class AuditManager:
                 return
             try:
                 self.audit_once()
-            except Exception:  # log-and-continue (:130-133)
+            except Exception as e:  # log-and-continue (:130-133)
+                _log.error("audit sweep failed", error=e)
                 self.metrics.counter("audit_errors").inc()
 
 

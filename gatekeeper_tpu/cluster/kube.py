@@ -28,10 +28,13 @@ import urllib.error
 import urllib.request
 from typing import Callable
 
+from gatekeeper_tpu.utils.log import logger
 from gatekeeper_tpu.api.config import GVK
 from gatekeeper_tpu.cluster.fake import ADDED, DELETED, MODIFIED, Event
 from gatekeeper_tpu.errors import (AlreadyExistsError, ApiConflictError,
                                    ApiError, NotFoundError)
+
+_log = logger("kube")
 
 
 def load_kubeconfig(path: str) -> dict:
@@ -347,10 +350,14 @@ class KubeCluster:
                 # the cached discovery entry so kind_served() turns
                 # false and the watch manager can retire this GVK
                 # instead of re-listing 404s forever
+                _log.info("watched resource gone; invalidating discovery",
+                          gvk=str(gvk))
                 self._invalidate(gvk.group_version)
                 rv = ""
                 stop.wait(self._watch_backoff)
-            except (ApiError, OSError, ValueError):
+            except (ApiError, OSError, ValueError) as e:
                 # connection drop / transient failure: back off, re-list
+                _log.debug("watch stream interrupted; re-listing",
+                           gvk=str(gvk), error=e)
                 rv = ""
                 stop.wait(self._watch_backoff)

@@ -23,6 +23,7 @@ import signal
 import sys
 import threading
 
+from gatekeeper_tpu.utils.log import logger
 from gatekeeper_tpu.api.config import GVK, empty_config_object
 from gatekeeper_tpu.audit.manager import (CRD_NAME, AuditManager,
                                           DEFAULT_AUDIT_INTERVAL,
@@ -38,6 +39,8 @@ from gatekeeper_tpu.utils.metrics import Metrics
 from gatekeeper_tpu.webhook.batcher import MicroBatcher
 from gatekeeper_tpu.webhook.policy import ValidationHandler
 from gatekeeper_tpu.webhook.server import DEFAULT_PORT, WebhookServer
+
+_log = logger("manager")
 
 NS_GVK = GVK("", "v1", "Namespace")
 
@@ -95,7 +98,7 @@ class Manager:
         self.handler = ValidationHandler(self.client, cluster=self.cluster,
                                          batcher=self.batcher,
                                          metrics=self.metrics,
-                                         log=lambda m: print(m, file=sys.stderr))
+                                         log=lambda m: _log.info("admission trace", dump=m))
         # TLS engages when the cert dir exists (reference /certs,
         # policy.go:76-79); otherwise plain HTTP (tests/demo)
         import os as _os
@@ -127,7 +130,7 @@ class Manager:
                     bootstrap_webhook(self.cluster, self._cert_dir,
                                       self.webhook.port)
                 except Exception as e:
-                    print(f"webhook bootstrap failed: {e}", file=sys.stderr)
+                    _log.error("webhook bootstrap failed", error=e)
         self.audit.start()
         # roster poll loop (reference updateManagerLoop, 5 s —
         # watch/manager.go:165-178): a GVK whose CRD becomes served
@@ -139,7 +142,7 @@ class Manager:
                 try:
                     self.plane.watch_manager.poll_once()
                 except Exception as e:   # log-and-continue like the loop
-                    print(f"watch poll error: {e}", file=sys.stderr)
+                    _log.warning("watch poll error", error=e)
         self._poll_thread = threading.Thread(
             target=poll_loop, daemon=True, name="watch-roster-poll")
         self._poll_thread.start()
@@ -256,7 +259,7 @@ def main(argv=None) -> int:
         print(json.dumps(out, indent=2, default=str))
         return 0
     mgr.start()
-    print(f"gatekeeper-tpu manager up "
+    _log.info(f"gatekeeper-tpu manager up "
           f"(webhook :{mgr.webhook.port if mgr.webhook else 'off'}, "
           f"audit every {args.audit_interval}s)", file=sys.stderr)
     stop = threading.Event()

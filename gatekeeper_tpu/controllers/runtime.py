@@ -21,8 +21,11 @@ import dataclasses
 import threading
 from typing import Callable
 
+from gatekeeper_tpu.utils.log import logger
 from gatekeeper_tpu.api.config import GVK
 from gatekeeper_tpu.cluster.fake import Event, FakeCluster
+
+_log = logger("controller")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -114,6 +117,9 @@ class ControllerManager:
             # any reconcile error requeues (controller-runtime requeues on
             # error-result; a raising reconciler must never kill the
             # worker loop)
+            _log.warning("reconcile failed; requeueing",
+                         controller=reconciler.name,
+                         request=str(request), error=e)
             self.errors.append((reconciler.name, request, e))
             result, failed = REQUEUE, True
         if result is not None and result.requeue:

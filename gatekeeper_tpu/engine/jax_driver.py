@@ -131,8 +131,9 @@ class JaxDriver(LocalDriver):
             n_dev = len(jax.devices())
         except RuntimeError as e:       # backend init failure: no devices
             n_dev = 0
-            print(f"gatekeeper-tpu: jax device probe failed ({e}); "
-                  f"single-device engine", flush=True)
+            from gatekeeper_tpu.utils.log import logger
+            logger("engine").warning(
+                "jax device probe failed; single-device engine", error=e)
         if n_dev > 1:
             from gatekeeper_tpu.parallel.sharding import make_mesh
             mesh = make_mesh()          # a real failure here should raise

@@ -14,7 +14,10 @@ import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from gatekeeper_tpu.utils.log import logger
 from gatekeeper_tpu.webhook.policy import ValidationHandler
+
+_log = logger("webhook")
 
 WEBHOOK_PATH = "/v1/admit"
 DEFAULT_PORT = 8443          # the reference defaults to 443 (policy.go:48)
@@ -102,6 +105,8 @@ class WebhookServer:
         self._thread: threading.Thread | None = None
 
     def start(self) -> None:
+        _log.info("webhook serving", port=self.port,
+                  tls=getattr(self, "tls", False))
         if self._thread is None:
             self._thread = threading.Thread(
                 target=self._server.serve_forever, daemon=True,

@@ -1,0 +1,38 @@
+"""Structured logger: format, levels, and error rendering."""
+
+import logging
+
+from gatekeeper_tpu.utils.log import _KVFormatter, logger
+
+
+def _fmt(record):
+    return _KVFormatter().format(record)
+
+
+def test_key_values_render(caplog):
+    log = logger("testcomp")
+    with caplog.at_level(logging.INFO, logger="gatekeeper_tpu.testcomp"):
+        log.info("sweep complete", violations=3, seconds=0.5)
+    rec = caplog.records[-1]
+    line = _fmt(rec)
+    assert "gatekeeper_tpu.testcomp" in line
+    assert "sweep complete" in line
+    assert "violations=3" in line and "seconds=0.5" in line
+
+
+def test_exception_and_spacey_values(caplog):
+    log = logger("testcomp")
+    with caplog.at_level(logging.ERROR, logger="gatekeeper_tpu.testcomp"):
+        log.error("failed", error=ValueError("boom"), msg="two words")
+    line = _fmt(caplog.records[-1])
+    assert "error=ValueError(boom)" in line
+    assert "msg='two words'" in line
+
+
+def test_level_threshold(caplog):
+    log = logger("testcomp")
+    with caplog.at_level(logging.INFO, logger="gatekeeper_tpu.testcomp"):
+        log.debug("invisible", x=1)
+        log.info("visible")
+    msgs = [r.getMessage() for r in caplog.records]
+    assert "visible" in msgs and "invisible" not in msgs
