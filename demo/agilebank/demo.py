@@ -1,0 +1,154 @@
+"""demo/agilebank: the richer multi-policy scenario (reference
+demo/agilebank/demo.sh) against this framework.
+
+Story (mirroring the reference's narrative): a developer creates a
+namespace nobody can later attribute; the admin responds by installing
+policy — required owner labels with a value regex, container limits,
+an approved-repo allowlist for production, and one-Service-per-selector
+(an inventory join).  Every bad resource is then denied at admission
+with the reference's 403 message shape, the good ones are admitted and
+synced, and the audit sweep reports the pre-policy namespace that
+started the story.
+
+Run: python demo/agilebank/demo.py            (in-memory cluster)
+     python demo/agilebank/demo.py --kubeconfig ~/.kube/config
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+import urllib.request
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import yaml
+
+from gatekeeper_tpu.api.config import GVK
+from gatekeeper_tpu.cmd.manager import Manager, parse_args
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def load(path: str) -> dict:
+    with open(path) as f:
+        return yaml.safe_load(f)
+
+
+def say(line: str) -> None:
+    print(line, flush=True)
+
+
+def admit(port: int, obj: dict, operation: str = "CREATE") -> dict:
+    """POST a real AdmissionReview envelope to the webhook."""
+    meta = obj.get("metadata") or {}
+    req = {"apiVersion": "admission.k8s.io/v1", "kind": "AdmissionReview",
+           "request": {"uid": "demo",
+                       "kind": {"group": "", "version": "v1",
+                                "kind": obj.get("kind", "")},
+                       "name": meta.get("name", ""),
+                       "namespace": meta.get("namespace"),
+                       "operation": operation, "object": obj,
+                       "userInfo": {"username": "demo-user"}}}
+    r = urllib.request.urlopen(
+        urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/admit",
+            data=json.dumps(req).encode(),
+            headers={"Content-Type": "application/json"}),
+        timeout=60)
+    return json.load(r)["response"]
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--kubeconfig", default=None)
+    opts = ap.parse_args(argv)
+    args = parse_args(["--port", "0"] +
+                      (["--kubeconfig", opts.kubeconfig]
+                       if opts.kubeconfig else []))
+    mgr = Manager(args)
+    mgr.plane.run_until_idle()
+    if mgr.webhook is None:
+        raise SystemExit("webhook required for the demo")
+    mgr.webhook.start()
+    mgr.batcher.start()
+    settle = 2.0 if mgr.async_cluster else 0.0
+    cluster, port = mgr.cluster, mgr.webhook.port
+
+    say("===== ENTER developer =====")
+    say("$ kubectl create ns advanced-transaction-system")
+    cluster.create({"apiVersion": "v1", "kind": "Namespace",
+                    "metadata": {"name": "advanced-transaction-system"}})
+    say("namespace/advanced-transaction-system created  (no policy yet)\n")
+
+    say("===== ENTER admin: installing policy =====")
+    cluster.create(load(os.path.join(HERE, "sync.yaml")))
+    for path in sorted(glob.glob(os.path.join(HERE, "templates", "*.yaml"))):
+        doc = load(path)
+        cluster.create(doc)
+        say(f"constrainttemplate/{doc['metadata']['name']} created")
+    mgr.plane.run_until_idle(settle=settle)
+    for path in sorted(glob.glob(os.path.join(HERE, "constraints", "*.yaml"))):
+        doc = load(path)
+        cluster.create(doc)
+        say(f"{doc['kind'].lower()}/{doc['metadata']['name']} created")
+    mgr.plane.run_until_idle(settle=settle)
+    say("")
+
+    say("===== ENTER developer: the bad resources =====")
+    denied = 0
+    for path in sorted(glob.glob(os.path.join(HERE, "bad_resources",
+                                              "*.yaml"))):
+        if path.endswith("duplicate-service.yaml"):
+            continue    # only bad once the payments Service is synced
+        obj = load(path)
+        resp = admit(port, obj)
+        name = os.path.basename(path)
+        assert not resp["allowed"], f"{name} should have been denied"
+        denied += 1
+        say(f"$ kubectl apply -f bad_resources/{name}")
+        say(f"DENIED ({resp['status']['code']}): "
+            f"{resp['status']['message'].splitlines()[0]}\n")
+
+    say("===== the good resources =====")
+    for path in sorted(glob.glob(os.path.join(HERE, "good_resources",
+                                              "*.yaml"))):
+        obj = load(path)
+        resp = admit(port, obj)
+        name = os.path.basename(path)
+        assert resp["allowed"], \
+            f"{name} should have been admitted: {resp.get('status')}"
+        cluster.create(obj)
+        say(f"$ kubectl apply -f good_resources/{name}  ->  admitted")
+    mgr.plane.run_until_idle(settle=settle)
+    say("")
+
+    say("===== the inventory join: one Service per selector =====")
+    dup = load(os.path.join(HERE, "bad_resources", "duplicate-service.yaml"))
+    resp = admit(port, dup)
+    assert not resp["allowed"], "duplicate selector must be denied"
+    say("duplicate selector denied now that payments Service is synced:")
+    say(f"  {resp['status']['message'].splitlines()[0]}\n")
+
+    say("===== the audit finds the forgotten namespace =====")
+    report = mgr.audit.audit_once()
+    say(f"audit sweep: {report['violations']} violation(s) in "
+        f"{report.get('total_seconds', 0):.3f}s")
+    con = cluster.get(GVK("constraints.gatekeeper.sh", "v1alpha1",
+                          "K8sAgileLabels"), "all-must-have-owner")
+    for v in (con.get("status") or {}).get("violations") or []:
+        say(f"  {v.get('kind')}/{v.get('name')}: {v.get('message')}")
+    names = [v.get("name") for v in (con.get("status") or {})
+             .get("violations") or []]
+    assert "advanced-transaction-system" in names, names
+    say("\nDEMO PASS")
+    mgr.stop() if hasattr(mgr, "stop") else None
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,8 @@
+#!/usr/bin/env bash
+# demo/agilebank: multi-policy scenario (required owner labels with
+# regex, container limits, prod repo allowlist, unique service
+# selector) against the in-memory cluster; pass --kubeconfig for a
+# real apiserver.
+set -euo pipefail
+cd "$(dirname "$0")"
+exec python demo.py "$@"

@@ -54,7 +54,17 @@ def bootstrap_cluster(cluster) -> None:
     if hasattr(cluster, "register_kind"):
         cluster.register_kind(TEMPLATE_GVK, "constrainttemplates")
         cluster.register_kind(CONFIG_GVK, "configs")
-        cluster.register_kind(NS_GVK, "namespaces")
+        # core kinds every conformant apiserver serves (sync configs
+        # routinely watch these; the fake's discovery must agree)
+        for kind, plural in (("Namespace", "namespaces"), ("Pod", "pods"),
+                             ("Service", "services"),
+                             ("ConfigMap", "configmaps"),
+                             ("Secret", "secrets"),
+                             ("ServiceAccount", "serviceaccounts")):
+            cluster.register_kind(GVK("", "v1", kind), plural)
+        cluster.register_kind(GVK("apps", "v1", "Deployment"), "deployments")
+        cluster.register_kind(GVK("networking.k8s.io", "v1", "Ingress"),
+                              "ingresses")
     from gatekeeper_tpu.webhook.bootstrap import apply_crd
     apply_crd(cluster, CRD_NAME, "templates.gatekeeper.sh", "v1alpha1",
               "ConstraintTemplate", "constrainttemplates")

@@ -1,0 +1,14 @@
+"""demo/agilebank must stay green: it is the end-to-end acceptance
+scenario (multi-policy admission, inventory join, audit catch-up)."""
+
+import subprocess
+import sys
+
+
+def test_agilebank_demo_passes():
+    out = subprocess.run(
+        [sys.executable, "demo/agilebank/demo.py"],
+        capture_output=True, text=True, timeout=300, cwd="/root/repo")
+    assert out.returncode == 0, out.stdout[-2000:] + out.stderr[-2000:]
+    assert "DEMO PASS" in out.stdout
+    assert out.stdout.count("DENIED (403)") == 4
