@@ -146,6 +146,9 @@ class JaxDriver(LocalDriver):
         # assumes.  Execution and host formatting stay concurrent.
         import threading as _threading
         self._prep_lock = _threading.Lock()
+        # one-shot background churn-delta prewarm after the first sweep
+        # (shape changes later recompile lazily on the sweep, as before)
+        self._delta_warmed = False
 
     # ------------------------------------------------------------------
 
@@ -494,6 +497,23 @@ class JaxDriver(LocalDriver):
                                   mask, ordered_rows, row_order, kind, limit,
                                   trace, tagged, rcache)
         tagged.sort(key=lambda kv: kv[0])
+        # warm the churn-delta executables in the background: the first
+        # sweep after data churn otherwise pays one serialized XLA
+        # compile per kind (multiple seconds) right on the sweep
+        if limit is not None and self.executor.mesh is None:
+            warm = [(sp[4], sp[5]) for sp in specs if sp[0] == "topk"]
+            if warm and not self._delta_warmed:
+                self._delta_warmed = True
+                import threading as _threading
+
+                def _warm(items=warm):
+                    for prog, bindings in items:
+                        try:
+                            self.executor.prewarm_deltas(prog, bindings)
+                        except Exception:
+                            pass    # warmup is best-effort
+                _threading.Thread(target=_warm, name="delta-warmup",
+                                  daemon=True).start()
         m = self.metrics
         m.counter("audit_sweeps").inc()
         m.counter("audit_results").inc(len(tagged))

@@ -959,6 +959,32 @@ class ProgramExecutor:
                 ex.append(jax.ShapeDtypeStruct((r_pad,), jnp.int32))
             fn.prewarm(*ex)
 
+    def prewarm_deltas(self, program: Program, bindings: Bindings,
+                       buckets: tuple = (8, 1 << 10, 1 << 14)) -> None:
+        """Compile the churn-delta executables for a ladder of dirty-row
+        buckets ahead of the first churned sweep.  Called from a
+        background thread right after a sweep: the compiles hide inside
+        the audit interval instead of adding multiple seconds to the
+        first sweep after data churn."""
+        if self.mesh is not None or self._sharded_for(bindings):
+            return
+        cache = bindings.__dict__.get("_device_caches", {}).get(id(self))
+        if not cache:
+            return
+        arrays = {nm: dev for nm, (_h, dev) in cache.items()}
+        names = tuple(sorted(arrays))
+        viol_sd = jax.ShapeDtypeStruct((bindings.c_pad, bindings.r_pad),
+                                       jnp.bool_)
+        arg_sds = [jax.ShapeDtypeStruct(arrays[nm].shape, arrays[nm].dtype)
+                   for nm in names]
+        for b in buckets:
+            if self._shutdown.is_set():
+                return
+            fn = self._delta_fn(program, names, b)
+            if isinstance(fn, _LazyTwoTier):
+                fn.prewarm(viol_sd,
+                           jax.ShapeDtypeStruct((b,), jnp.int32), *arg_sds)
+
     def _viol_key(self, program: Program) -> tuple:
         return (id(self), program.cache_key())
 
@@ -1105,7 +1131,11 @@ class ProgramExecutor:
             rows = np.concatenate(
                 [rows, np.full((b - len(rows),),
                                rows[0] if len(rows) else 0,
-                               dtype=np.int64)])
+                               dtype=np.int64)]).astype(np.int32)
+            # int32 keeps the call signature identical to the
+            # prewarm_deltas examples (x64-off device_put would narrow
+            # int64 anyway; being explicit keeps the cache warm even if
+            # that config changes)
             viol = self._delta_fn(program, names, b)(
                 viol_old, jax.device_put(rows),
                 *(arrays[nm] for nm in names))
