@@ -176,6 +176,27 @@ def bench_north_star(detail):
         f"executables: {jd.executor.compiles} compiled, "
         f"{jd.executor.cache_hits} cache hits")
 
+    # restart: a fresh driver in the same environment — state rebuilt
+    # from scratch (the reference rebuilds from watches on every
+    # restart too) but the persistent XLA cache skips the compiles
+    import gc
+    del client
+    jd_old, jd = jd, None
+    del jd_old
+    gc.collect()
+    jd2 = JaxDriver()
+    t0 = time.perf_counter()
+    client2 = setup_north_star(jd2, resources, random.Random(7))
+    restart_ingest_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    jd2.query_audit(TARGET_NAME, QueryOpts(limit_per_constraint=CAP))
+    restart_audit_s = time.perf_counter() - t0
+    log(f"[north-star] restart: ingest {restart_ingest_s:.1f}s, first audit "
+        f"{restart_audit_s:.1f}s (XLA cache hits "
+        f"{jd2.executor.cache_hits}, compiles {jd2.executor.compiles})")
+    del client2, jd2
+    gc.collect()
+
     # CPU oracle baseline on a subsample, linearly extrapolated
     ld = LocalDriver()
     sub = resources[:BASELINE_N]
@@ -191,6 +212,8 @@ def bench_north_star(detail):
         "steady_seconds": round(t_best, 4), "cold_seconds": round(cold_s, 2),
         "ingest_seconds": round(ingest_s, 2),
         "churn_1pct_sweep_seconds": round(churn_s, 4),
+        "restart_ingest_seconds": round(restart_ingest_s, 2),
+        "restart_first_audit_seconds": round(restart_audit_s, 2),
         "device_wait_mean_s": dev.get("mean_seconds"),
         "host_format_mean_s": fmt.get("mean_seconds"),
         "capped_results": n_results,

@@ -450,6 +450,66 @@ violation[{"msg": msg}] {
 """)
 
 
+# ---------------------------------------------------------------- round-3 additions
+# (more of the public gatekeeper-library general/pod-security suite)
+
+_t("K8sDisallowedRepos", {"repos": ["docker.io/"]})("""package k8sdisallowedrepos
+violation[{"msg": msg}] {
+  container := input.review.object.spec.containers[_]
+  repo := input.constraint.spec.parameters.repos[_]
+  startswith(container.image, repo)
+  msg := sprintf("container <%v> image <%v> comes from a disallowed repository <%v>", [container.name, container.image, repo])
+}
+""")
+
+_t("K8sForbiddenSysctls", {"sysctls": ["kernel.msgmax", "net.core.somaxconn"]})("""package k8sforbiddensysctls
+violation[{"msg": msg}] {
+  entry := input.review.object.spec.securityContext.sysctls[_]
+  forbidden := {s | s := input.constraint.spec.parameters.sysctls[_]}
+  forbidden[entry.name]
+  msg := sprintf("sysctl <%v> is forbidden", [entry.name])
+}
+""")
+
+_t("K8sEphemeralStorageLimit", {"max_gi": 2})("""package k8sephemeralstoragelimit
+violation[{"msg": msg}] {
+  container := input.review.object.spec.containers[_]
+  not container.resources.limits["ephemeral-storage"]
+  msg := sprintf("container <%v> has no ephemeral-storage limit", [container.name])
+}
+""")
+
+_t("K8sAutomountServiceAccountToken", {})("""package k8sautomountserviceaccounttoken
+violation[{"msg": msg}] {
+  input.review.object.kind == "Pod"
+  not input.review.object.spec.automountServiceAccountToken == false
+  msg := "automountServiceAccountToken must be set to false"
+}
+""")
+
+_t("K8sAllowedSeccompProfiles", {"profiles": ["RuntimeDefault", "Localhost"]})("""package k8sallowedseccompprofiles
+violation[{"msg": msg}] {
+  ptype := input.review.object.spec.securityContext.seccompProfile.type
+  allowed := {p | p := input.constraint.spec.parameters.profiles[_]}
+  not allowed[ptype]
+  msg := sprintf("seccomp profile <%v> is not allowed", [ptype])
+}
+violation[{"msg": msg}] {
+  input.review.object.kind == "Pod"
+  not input.review.object.spec.securityContext.seccompProfile
+  msg := "a pod-level seccompProfile is required"
+}
+""")
+
+_t("K8sDisallowLatestTag", {})("""package k8sdisallowlatesttag
+violation[{"msg": msg}] {
+  container := input.review.object.spec.containers[_]
+  endswith(container.image, ":latest")
+  msg := sprintf("container <%v> uses the mutable :latest tag", [container.name])
+}
+""")
+
+
 def all_docs() -> list[tuple[dict, dict]]:
     """(template_doc, sample constraint_doc) for every library entry."""
     out = []

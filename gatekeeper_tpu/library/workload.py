@@ -60,9 +60,18 @@ def make_mixed(rng, n):
             if rng.random() < 0.2:
                 spec["hostNetwork"] = True
             if rng.random() < 0.3:
-                spec["securityContext"] = {
-                    "runAsUser": rng.choice([0, 500, 2000]),
-                    "runAsNonRoot": rng.random() < 0.5}
+                sc = {"runAsUser": rng.choice([0, 500, 2000]),
+                      "runAsNonRoot": rng.random() < 0.5}
+                if rng.random() < 0.4:
+                    sc["sysctls"] = [{"name": rng.choice(
+                        ["kernel.msgmax", "net.ipv4.ip_local_port_range",
+                         "net.core.somaxconn"]), "value": "1024"}]
+                if rng.random() < 0.5:
+                    sc["seccompProfile"] = {"type": rng.choice(
+                        ["RuntimeDefault", "Unconfined", "Localhost"])}
+                spec["securityContext"] = sc
+            if rng.random() < 0.3:
+                spec["automountServiceAccountToken"] = rng.random() < 0.5
             if rng.random() < 0.3:
                 spec["volumes"] = [{"name": "v",
                                     "hostPath": {"path": rng.choice(

@@ -30,7 +30,7 @@ def test_library_all_templates_load():
     for tdoc, cdoc in all_docs():
         c.add_template(tdoc)
         c.add_constraint(cdoc)
-    assert len(LIBRARY) >= 30
+    assert len(LIBRARY) >= 39
 
 
 def test_library_driver_parity():
@@ -51,7 +51,7 @@ def test_library_driver_parity():
     assert res["local"] == res["jax"]
     assert len(res["local"]) > 50
     # most of the library must ride the device path, not the fallback
-    assert lowered >= 32, f"only {lowered} lowered"
+    assert lowered >= 38, f"only {lowered} lowered"
 
 
 def test_library_every_template_can_fire():
