@@ -75,6 +75,12 @@ def _opts_from_wire(d: dict | None) -> QueryOpts | None:
                      limit_per_constraint=d.get("limit_per_constraint"))
 
 
+class WorkerUnreachableError(ClientError):
+    """Transport-level failure talking to an engine worker (connect,
+    timeout, torn connection) — retriable on another replica, unlike a
+    semantic 4xx the worker actually answered with."""
+
+
 class EngineWorker:
     """HTTP server hosting a Driver (usually a JaxDriver owning the
     accelerator).  One POST endpoint per seam method.  ``driver`` may be
@@ -261,7 +267,7 @@ class RemoteDriver(Driver):
             except socket.timeout:
                 conn.close()
                 self._local.conn = None
-                raise ClientError(
+                raise WorkerUnreachableError(
                     f"worker {method} timed out after {self.timeout}s")
             except (ConnectionError, OSError,
                     http.client.HTTPException) as e:
@@ -269,7 +275,8 @@ class RemoteDriver(Driver):
                 self._local.conn = None
                 if attempt == 0 and was_reused and not no_retry:
                     continue    # stale keep-alive: reconnect once
-                raise ClientError(f"worker unreachable at {self.url}: {e}")
+                raise WorkerUnreachableError(
+                    f"worker unreachable at {self.url}: {e}")
             if resp.status != 200:
                 detail = data.decode(errors="replace")[:500]
                 raise ClientError(

@@ -25,7 +25,8 @@ import threading
 from typing import Any
 
 from gatekeeper_tpu.client.interface import Driver, QueryOpts
-from gatekeeper_tpu.client.remote_driver import RemoteDriver
+from gatekeeper_tpu.client.remote_driver import (RemoteDriver,
+                                                 WorkerUnreachableError)
 from gatekeeper_tpu.client.targets import TargetHandler
 from gatekeeper_tpu.errors import ClientError
 from gatekeeper_tpu.store.table import ResourceMeta
@@ -105,19 +106,40 @@ class ReplicaPool(Driver):
 
     # -- Driver seam: queries distributed ---------------------------------
 
+    def _failover(self, fn_name: str, *args):
+        """Run a query on the next replica; a replica that errors is
+        evicted and the query fails over to the survivors (a crashed
+        worker must not fail admission — the Service analogue routes
+        around a dead pod).  Raises only when every replica failed."""
+        last: Exception | None = None
+        for _attempt in range(len(self.drivers)):
+            d = self._next()
+            try:
+                return getattr(d, fn_name)(*args)
+            except WorkerUnreachableError as e:
+                # transport failure only: a semantic error (4xx the
+                # worker answered with) would fail identically on
+                # every replica and must surface, not cascade-evict
+                last = e
+                self.drivers = [x for x in self.drivers if x is not d] \
+                    or self.drivers
+                if len(self.drivers) == 1 and self.drivers[0] is d:
+                    break       # d was the only replica left
+        raise ClientError(f"all replicas failed {fn_name}: {last}")
+
     def query_review(self, target: str, review: dict,
                      opts: QueryOpts | None = None):
-        return self._next().query_review(target, review, opts)
+        return self._failover("query_review", target, review, opts)
 
     def query_review_batch(self, target: str, reviews: list[dict],
                            opts: QueryOpts | None = None) -> list[tuple]:
-        return self._next().query_review_batch(target, reviews, opts)
+        return self._failover("query_review_batch", target, reviews, opts)
 
     def query_audit(self, target: str, opts: QueryOpts | None = None):
         # audits are whole-state queries; any single replica answers
         # (the reference runs the audit on each pod independently and
         # the status writes are last-writer-wins, ha_status.go)
-        return self.drivers[0].query_audit(target, opts)
+        return self._failover("query_audit", target, opts)
 
     def dump(self) -> dict:
         return self.drivers[0].dump()

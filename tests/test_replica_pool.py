@@ -110,6 +110,36 @@ class TestEviction:
         assert len(c.review(req).by_target[TARGET_NAME].results) == 1
 
 
+class TestQueryFailover:
+    def test_review_fails_over_to_survivor(self, pool2):
+        c = Backend(pool2).new_client([K8sValidationTarget()])
+        _setup(c)
+        victim = pool2.drivers[0]
+        victim.url = "http://127.0.0.1:1"
+        victim._host, victim._port = "127.0.0.1", 1
+        victim._local.__dict__.clear()
+        req = {"kind": {"group": "", "version": "v1", "kind": "Namespace"},
+               "name": "n", "operation": "CREATE", "object": _ns("n", {})}
+        # several rounds: whichever replica round-robin picks first, the
+        # dead one gets evicted and every review still answers
+        for _ in range(4):
+            assert len(c.review(req).by_target[TARGET_NAME].results) == 1
+        assert len(pool2.drivers) == 1
+
+    def test_all_dead_raises(self, pool2):
+        from gatekeeper_tpu.errors import ClientError
+        c = Backend(pool2).new_client([K8sValidationTarget()])
+        _setup(c)
+        for victim in list(pool2.drivers):
+            victim.url = "http://127.0.0.1:1"
+            victim._host, victim._port = "127.0.0.1", 1
+            victim._local.__dict__.clear()
+        req = {"kind": {"group": "", "version": "v1", "kind": "Namespace"},
+               "name": "n", "operation": "CREATE", "object": _ns("n", {})}
+        with pytest.raises(ClientError, match="all replicas failed"):
+            c.review(req)
+
+
 class TestSpawnWorkers:
     def test_subprocess_worker_end_to_end(self):
         with ReplicaPool.spawn_workers(1, timeout=120) as pool:
