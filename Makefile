@@ -6,7 +6,7 @@
 IMG ?= gatekeeper-tpu:latest
 PY ?= python
 
-.PHONY: all native-test test bench bench-quick demo manager worker \
+.PHONY: all native-test test bench bench-quick demo demo-agilebank manager worker \
         docker-build deploy undeploy lint ci
 
 all: test
@@ -28,6 +28,10 @@ bench-quick:
 # demo/basic flow end-to-end (1k namespaces + required-labels template)
 demo:
 	$(PY) -m gatekeeper_tpu.cmd.manager --demo --port -1
+
+# demo/agilebank: multi-policy scenario with inventory join + audit
+demo-agilebank:
+	$(PY) demo/agilebank/demo.py
 
 manager:
 	$(PY) -m gatekeeper_tpu.cmd.manager
