@@ -11,8 +11,9 @@ topdown audit, measured on a subsample and extrapolated linearly).
 Also measured (stderr, and embedded in the `detail` field):
 - demo/basic:    K8sRequiredLabels over 1k Namespaces (both engines)
 - allowed repos: K8sAllowedRepos allowlist over 10k Pods (both engines)
-- library:       full ~33-template library x 100k mixed resources
+- library:       full 39-template library x 100k mixed resources
 - regex-heavy:   image-digest / tag / wildcard-host templates x 100k
+- selector-heavy: namespaceSelector matching at 100k namespaces
 - admission:     AdmissionReview replay through the webhook handler with
                  micro-batching, p50/p99 latency
 - cold start:    first-audit-complete time (persistent XLA cache makes
