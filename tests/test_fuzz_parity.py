@@ -190,7 +190,7 @@ def cdoc(kind, name, params, match=None):
             "metadata": {"name": name}, "spec": spec}
 
 
-@pytest.mark.parametrize("seed", range(8))
+@pytest.mark.parametrize("seed", range(16))
 def test_fuzz_driver_parity(seed):
     rng = random.Random(seed * 7919)
     local = Backend(LocalDriver()).new_client([K8sValidationTarget()])
