@@ -19,6 +19,7 @@ available = False
 elem_arrays = None
 scalar_col = None
 memb_fill = None
+process_meta = None
 
 MODE_CODES = {"str": 0, "val": 1, "num": 2, "len": 3, "present": 4,
               "truthy": 5}
@@ -80,6 +81,7 @@ if os.environ.get("GATEKEEPER_NO_NATIVE") != "1":
         _mod = _build()
         scalar_col, elem_arrays = _wrap(_mod)
         memb_fill = _mod.memb_fill
+        process_meta = _mod.process_meta
         available = True
     except Exception:  # no toolchain / unexpected platform: Python paths
         available = False

@@ -509,6 +509,88 @@ static PyObject *py_memb_fill(PyObject *self, PyObject *args)
     Py_RETURN_NONE;
 }
 
+/* process_meta(objs, quote_cache, fallback_idx_out_list)
+ * -> (keys list, api list, kind list, name list, ns list)
+ *
+ * Batch extraction of the cache path pieces for the COMMON object
+ * shape: dict with string apiVersion/kind, metadata dict with string
+ * name and absent-or-string namespace, apiVersion present in
+ * quote_cache.  Any other object's index is appended to
+ * fallback_idx_out_list and its slots are filled with None — the
+ * Python caller routes those through the exact scalar path
+ * (process_data), so semantics (errors, UnhandledData) stay there. */
+static PyObject *py_process_meta(PyObject *self, PyObject *args)
+{
+    PyObject *objs, *qcache, *fallback;
+    if (!PyArg_ParseTuple(args, "OOO", &objs, &qcache, &fallback))
+        return NULL;
+    Py_ssize_t n = PyList_GET_SIZE(objs);
+    PyObject *keys = PyList_New(n);
+    PyObject *apis = PyList_New(n);
+    PyObject *kinds = PyList_New(n);
+    PyObject *names = PyList_New(n);
+    PyObject *nss = PyList_New(n);
+    if (!keys || !apis || !kinds || !names || !nss)
+        goto fail;
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *o = PyList_GET_ITEM(objs, i);
+        PyObject *api = NULL, *kind = NULL, *meta = NULL, *name = NULL,
+                 *ns = NULL, *escaped = NULL;
+        int ok = PyDict_Check(o)
+            && (api = PyDict_GetItemString(o, "apiVersion")) != NULL
+            && PyUnicode_Check(api) && PyUnicode_GET_LENGTH(api) > 0
+            && (kind = PyDict_GetItemString(o, "kind")) != NULL
+            && PyUnicode_Check(kind) && PyUnicode_GET_LENGTH(kind) > 0
+            && (meta = PyDict_GetItemString(o, "metadata")) != NULL
+            && PyDict_Check(meta)
+            && (name = PyDict_GetItemString(meta, "name")) != NULL
+            && PyUnicode_Check(name)
+            && (escaped = PyDict_GetItem(qcache, api)) != NULL;
+        if (ok) {
+            ns = PyDict_GetItemString(meta, "namespace");
+            if (ns == Py_None)
+                ns = NULL;
+            if (ns != NULL && !PyUnicode_Check(ns))
+                ok = 0;
+        }
+        if (!ok) {
+            PyObject *idx = PyLong_FromSsize_t(i);
+            if (idx == NULL || PyList_Append(fallback, idx) < 0) {
+                Py_XDECREF(idx);
+                goto fail;
+            }
+            Py_DECREF(idx);
+            PyList_SET_ITEM(keys, i, Py_NewRef(Py_None));
+            PyList_SET_ITEM(apis, i, Py_NewRef(Py_None));
+            PyList_SET_ITEM(kinds, i, Py_NewRef(Py_None));
+            PyList_SET_ITEM(names, i, Py_NewRef(Py_None));
+            PyList_SET_ITEM(nss, i, Py_NewRef(Py_None));
+            continue;
+        }
+        PyObject *key = ns != NULL
+            ? PyUnicode_FromFormat("namespace/%U/%U/%U/%U",
+                                   ns, escaped, kind, name)
+            : PyUnicode_FromFormat("cluster/%U/%U/%U", escaped, kind, name);
+        if (key == NULL)
+            goto fail;
+        PyList_SET_ITEM(keys, i, key);
+        PyList_SET_ITEM(apis, i, Py_NewRef(api));
+        PyList_SET_ITEM(kinds, i, Py_NewRef(kind));
+        PyList_SET_ITEM(names, i, Py_NewRef(name));
+        PyList_SET_ITEM(nss, i, Py_NewRef(ns != NULL ? ns : Py_None));
+    }
+    {
+        PyObject *out = PyTuple_Pack(5, keys, apis, kinds, names, nss);
+        Py_DECREF(keys); Py_DECREF(apis); Py_DECREF(kinds);
+        Py_DECREF(names); Py_DECREF(nss);
+        return out;
+    }
+fail:
+    Py_XDECREF(keys); Py_XDECREF(apis); Py_XDECREF(kinds);
+    Py_XDECREF(names); Py_XDECREF(nss);
+    return NULL;
+}
+
 static PyMethodDef Methods[] = {
     {"elem_arrays", py_elem_arrays, METH_VARARGS,
      "aligned element-column extraction with '*' flattening"},
@@ -516,6 +598,8 @@ static PyMethodDef Methods[] = {
      "per-resource scalar column extraction"},
     {"memb_fill", py_memb_fill, METH_VARARGS,
      "membership matrix fill"},
+    {"process_meta", py_process_meta, METH_VARARGS,
+     "batch cache-path/meta extraction for common-shape objects"},
     {NULL, NULL, 0, NULL}
 };
 

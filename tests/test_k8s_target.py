@@ -318,3 +318,45 @@ class TestEmptyKindsList:
         rev = {"kind": {"group": "", "version": "v1", "kind": "Pod"},
                "name": "p", "object": make_obj()}
         assert list(h.matching_constraints(rev, [c], ResourceTable())) == []
+
+
+def test_process_data_batch_matches_scalar():
+    """The native batch extractor must agree with process_data on every
+    object — common shapes in C, everything else through the exact
+    scalar path (non-dicts skip, missing api/kind raise)."""
+    import pytest
+
+    from gatekeeper_tpu.client.targets import UnhandledData
+    from gatekeeper_tpu.errors import ClientError
+
+    h = K8sValidationTarget()
+    objs = [
+        {"apiVersion": "v1", "kind": "Pod",
+         "metadata": {"name": "a", "namespace": "ns"}},
+        {"apiVersion": "apps/v1", "kind": "Deployment",
+         "metadata": {"name": "b"}},
+        {"apiVersion": "weird/group/v1", "kind": "X",
+         "metadata": {"name": "c", "namespace": None}},
+        "not-a-dict",
+        42,
+        {"apiVersion": "v1", "kind": "Pod", "metadata": {"name": 5}},
+        {"apiVersion": "v1", "kind": "Pod",
+         "metadata": {"name": "d", "namespace": 7}},
+        {"apiVersion": "v1", "kind": "Namespace", "metadata": {"name": ""}},
+    ]
+    got = h.process_data_batch(objs)
+    assert len(got) == len(objs)
+    for o, g in zip(objs, got):
+        try:
+            want = h.process_data(o)
+        except UnhandledData:
+            want = None
+        if want is None:
+            assert g is None
+        else:
+            assert g[0] == want[0] and g[1] == want[1] and g[2] is o
+
+    # missing kind raises ClientError through the batch path too
+    with pytest.raises(ClientError):
+        h.process_data_batch([{"apiVersion": "v1",
+                               "metadata": {"name": "x"}}])
