@@ -186,15 +186,18 @@ def bench_north_star(detail):
     del jd_old
     gc.collect()
     jd2 = JaxDriver()
+    pc_snap = jd2.executor.persistent_stats.snapshot()
     t0 = time.perf_counter()
     client2 = setup_north_star(jd2, resources, random.Random(7))
     restart_ingest_s = time.perf_counter() - t0
     t0 = time.perf_counter()
     jd2.query_audit(TARGET_NAME, QueryOpts(limit_per_constraint=CAP))
     restart_audit_s = time.perf_counter() - t0
+    pc = jd2.executor.persistent_stats.delta_since(pc_snap)
     log(f"[north-star] restart: ingest {restart_ingest_s:.1f}s, first audit "
-        f"{restart_audit_s:.1f}s (XLA cache hits "
-        f"{jd2.executor.cache_hits}, compiles {jd2.executor.compiles})")
+        f"{restart_audit_s:.1f}s (persistent XLA cache: {pc['hits']} hits / "
+        f"{pc['misses']} writes / {pc['requests']} requests; executor: "
+        f"{jd2.executor.compiles} compiles)")
     del client2, jd2
     gc.collect()
 
@@ -215,6 +218,8 @@ def bench_north_star(detail):
         "churn_1pct_sweep_seconds": round(churn_s, 4),
         "restart_ingest_seconds": round(restart_ingest_s, 2),
         "restart_first_audit_seconds": round(restart_audit_s, 2),
+        "restart_persistent_cache_hits": pc["hits"],
+        "restart_persistent_cache_misses": pc["misses"],
         "device_wait_mean_s": dev.get("mean_seconds"),
         "host_format_mean_s": fmt.get("mean_seconds"),
         "capped_results": n_results,

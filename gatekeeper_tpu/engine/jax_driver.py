@@ -504,16 +504,18 @@ class JaxDriver(LocalDriver):
             warm = [(sp[4], sp[5]) for sp in specs if sp[0] == "topk"]
             if warm and not self._delta_warmed:
                 self._delta_warmed = True
-                import threading as _threading
 
                 def _warm(items=warm):
                     for prog, bindings in items:
+                        if self.executor._shutdown.is_set():
+                            return
                         try:
                             self.executor.prewarm_deltas(prog, bindings)
                         except Exception:
                             pass    # warmup is best-effort
-                _threading.Thread(target=_warm, name="delta-warmup",
-                                  daemon=True).start()
+                # spawn_bg (not a bare daemon thread): a compile in
+                # flight at interpreter teardown aborts the process
+                self.executor.spawn_bg(_warm, "delta-warmup")
         m = self.metrics
         m.counter("audit_sweeps").inc()
         m.counter("audit_results").inc(len(tagged))

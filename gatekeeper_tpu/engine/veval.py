@@ -35,6 +35,32 @@ from gatekeeper_tpu.ir.program import Node, Program, RuleSpec
 
 _3D = (1, 1, 1)
 
+# -- background-compile thread registry ------------------------------
+# Threads that can hold an XLA compile in flight (tier upgrades, delta
+# prewarms, audit warmups) are joined before interpreter teardown via
+# one atexit drain; see ProgramExecutor.spawn_bg.
+_BG_LOCK = __import__("threading").Lock()
+_BG_THREADS: list = []
+_bg_drain_registered = False
+
+
+def _register_bg_drain() -> None:
+    global _bg_drain_registered
+    if _bg_drain_registered:
+        return
+    _bg_drain_registered = True
+    import atexit
+    import time as _time
+
+    def _drain():
+        ProgramExecutor._shutdown.set()
+        deadline = _time.monotonic() + 120
+        with _BG_LOCK:
+            threads = list(_BG_THREADS)
+        for t in threads:
+            t.join(timeout=max(0.0, deadline - _time.monotonic()))
+    atexit.register(_drain)
+
 
 class _LazyTwoTier:
     """Deferred two-tier jit: traces/compiles on first call (shapes come
@@ -572,8 +598,12 @@ class ProgramExecutor:
     skips the multi-second XLA compile per (template, bucket)."""
 
     def __init__(self, mesh=None):
-        from gatekeeper_tpu.utils.compile_cache import enable_persistent_cache
+        from gatekeeper_tpu.utils.compile_cache import (
+            enable_persistent_cache, persistent_cache_stats)
         enable_persistent_cache()
+        # process-wide persistent (on-disk) cache hit/miss counters —
+        # distinct from the in-process counters below
+        self.persistent_stats = persistent_cache_stats()
         self._cache: dict[tuple, Any] = {}
         self._lock = __import__("threading").Lock()   # dispatch runs threaded
         self._trace_lock = __import__("threading").Lock()
@@ -607,6 +637,25 @@ class ProgramExecutor:
     UPGRADE_DELAY_S = 15.0
     _shutdown = __import__("threading").Event()
 
+    @staticmethod
+    def spawn_bg(target, name: str):
+        """Start a background thread that may issue XLA compiles, and
+        register it for the process-exit drain.  A compile (an RPC to
+        the serialized compile service, or a C++ call into XLA) in
+        flight while the interpreter finalizes aborts the whole process
+        — C++ statics destruct under the thread and `terminate` fires
+        with an unrethrowable exception.  Every compile-capable thread
+        must therefore be joined before Python teardown: daemon threads
+        that merely *exist* at exit are exactly the crash."""
+        import threading as _threading
+        t = _threading.Thread(target=target, name=name, daemon=True)
+        with _BG_LOCK:
+            _BG_THREADS[:] = [x for x in _BG_THREADS if x.is_alive()]
+            _BG_THREADS.append(t)
+            _register_bg_drain()
+        t.start()
+        return t
+
     def _compile_two_tier(self, lowered, install):
         """Compile `lowered` fast; schedule the full-effort twin and
         hand it to `install(full_fn)` when ready.  Falls back to a
@@ -624,21 +673,8 @@ class ProgramExecutor:
             self._upgrade_q.append((_time.perf_counter(), lowered, install))
             if self._upgrade_thread is None or \
                     not self._upgrade_thread.is_alive():
-                import threading as _threading
-                t = _threading.Thread(
-                    target=self._upgrade_loop, name="xla-upgrade",
-                    daemon=True)
-                self._upgrade_thread = t
-                # a compile RPC in flight during interpreter teardown
-                # aborts the process (uncatchable C++ throw): stop the
-                # worker and join any in-progress compile at exit
-                import atexit
-
-                def _drain(thread=t):
-                    ProgramExecutor._shutdown.set()
-                    thread.join(timeout=120)
-                atexit.register(_drain)
-                t.start()
+                self._upgrade_thread = self.spawn_bg(
+                    self._upgrade_loop, "xla-upgrade")
         return fast
 
     def _upgrade_loop(self):

@@ -18,6 +18,7 @@ off the serving path (SURVEY §5 checkpoint/warmup bullet).
 
 from __future__ import annotations
 
+import hashlib
 import os
 import threading
 
@@ -25,11 +26,69 @@ _enabled = False
 _lock = threading.Lock()
 
 
+def host_fingerprint() -> str:
+    """A short stable fingerprint of THIS host's CPU capabilities.
+
+    CPU-backend persistent-cache entries contain native machine code
+    (XLA's cpu_aot_loader re-loads AOT-compiled kernels).  An artifact
+    compiled on a host with e.g. AMX/AVX-512 loaded on a host without
+    those features can SIGILL and abort the whole process mid-sweep —
+    observed when a working tree (with its untracked cache dir) moves
+    between the bench host, the remote compile service, and other
+    machines.  Keying the cache directory by the CPU's feature flags
+    means a foreign host's artifacts land in a directory this host
+    never reads.
+    """
+    model, flags = "", ""
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                # x86 spells these "flags"/"model name"; ARM spells
+                # them "Features"/"CPU part" — an SVE vs non-SVE
+                # aarch64 pair must fingerprint differently too
+                if not flags and line.startswith(("flags", "Features")):
+                    flags = line.split(":", 1)[1].strip()
+                elif not model and line.startswith(("model name",
+                                                    "CPU part")):
+                    model = line.split(":", 1)[1].strip()
+                if flags and model:
+                    break
+    except OSError:
+        pass
+    if not (flags or model):  # non-Linux fallback: coarse but safe
+        import platform
+        model = f"{platform.machine()}-{platform.processor()}"
+    digest = hashlib.sha256(f"{model}|{flags}".encode()).hexdigest()[:12]
+    return digest
+
+
+def _backend_subdir(backend: str) -> str:
+    """Cache subdirectory for `backend`, machine-keyed where artifacts
+    are machine-specific.
+
+    - cpu: native code — key by host CPU fingerprint.
+    - tpu/gpu: serialized executables are device-generation-specific,
+      not host-CPU-specific — key by device kind (v5e artifacts must
+      not be fed to a v4 chip; same for GPU compute capabilities).
+    """
+    if backend == "cpu":
+        return f"cpu-{host_fingerprint()}"
+    if backend in ("tpu", "gpu"):
+        try:
+            import jax
+            kind = jax.devices()[0].device_kind.replace(" ", "_")
+        except Exception:
+            kind = "unknown"
+        return f"{backend}-{kind}"
+    return backend
+
+
 def enable_persistent_cache(path: str | None = None) -> str:
-    """Idempotently point JAX's persistent compilation cache at `path`
-    (default: $GATEKEEPER_XLA_CACHE_DIR or ./.gatekeeper_xla_cache).
-    A cache dir the embedding application already configured wins — it
-    is never clobbered.  Returns the path actually in effect."""
+    """Idempotently point JAX's persistent compilation cache at a
+    machine-safe subdirectory of `path` (default:
+    $GATEKEEPER_XLA_CACHE_DIR or ./.gatekeeper_xla_cache).  A cache dir
+    the embedding application already configured wins — it is never
+    clobbered.  Returns the path actually in effect."""
     global _enabled
     with _lock:
         import jax
@@ -43,17 +102,66 @@ def enable_persistent_cache(path: str | None = None) -> str:
             backend = jax.default_backend()
         except Exception:
             backend = "unknown"
-        # per-backend subdirectory: a shared dir accumulates AOT
-        # artifacts from both the CPU tests and the TPU product
-        # process, and loading a mismatched-machine CPU artifact can
-        # SIGILL (cpu_aot_loader refuses with feature-mismatch errors)
-        path = path or os.environ.get("GATEKEEPER_XLA_CACHE_DIR") \
-            or os.path.join(os.getcwd(), ".gatekeeper_xla_cache", backend)
+        root = path or os.environ.get("GATEKEEPER_XLA_CACHE_DIR") \
+            or os.path.join(os.getcwd(), ".gatekeeper_xla_cache")
+        path = os.path.join(root, _backend_subdir(backend))
         jax.config.update("jax_compilation_cache_dir", path)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
         _enabled = True
         return path
+
+
+class PersistentCacheStats:
+    """Process-wide persistent-cache hit/miss counters, fed by JAX's
+    monitoring events.  `restart_first_audit` claims are only credible
+    with these logged (a restart that recompiles everything and one
+    that reloads cached binaries look identical from wall-clock alone
+    when prep dominates)."""
+
+    def __init__(self):
+        self.hits = 0       # executable reloaded from disk
+        self.misses = 0     # compiled AND written to disk (JAX only
+        #                     records a miss when the entry qualifies
+        #                     for persistence, i.e. compile >= the
+        #                     min-compile-time threshold)
+        self.requests = 0   # cache-eligible compile requests
+        self._lock = threading.Lock()
+
+    def _on_event(self, event: str, **kw) -> None:
+        if event == "/jax/compilation_cache/cache_hits":
+            with self._lock:
+                self.hits += 1
+        elif event == "/jax/compilation_cache/cache_misses":
+            with self._lock:
+                self.misses += 1
+        elif event == "/jax/compilation_cache/compile_requests_use_cache":
+            with self._lock:
+                self.requests += 1
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"hits": self.hits, "misses": self.misses,
+                    "requests": self.requests}
+
+    def delta_since(self, snap: dict) -> dict:
+        cur = self.snapshot()
+        return {k: cur[k] - snap.get(k, 0) for k in cur}
+
+
+_stats: PersistentCacheStats | None = None
+
+
+def persistent_cache_stats() -> PersistentCacheStats:
+    """The process-wide stats singleton (registers the monitoring
+    listener on first use)."""
+    global _stats
+    with _lock:
+        if _stats is None:
+            _stats = PersistentCacheStats()
+            from jax._src import monitoring
+            monitoring.register_event_listener(_stats._on_event)
+        return _stats
 
 
 def warm_audit(driver, target: str, cap: int = 20,
@@ -68,8 +176,11 @@ def warm_audit(driver, target: str, cap: int = 20,
         except Exception:
             pass  # warmup is best-effort; real sweeps surface errors
 
-    t = threading.Thread(target=run, name="audit-warmup", daemon=True)
-    t.start()
+    # route through the executor's background-compile registry so the
+    # warmup is joined before interpreter teardown (a compile in flight
+    # at exit aborts the process)
+    from gatekeeper_tpu.engine.veval import ProgramExecutor
+    t = ProgramExecutor.spawn_bg(run, "audit-warmup")
     if block:
         t.join()
     return t

@@ -1,0 +1,101 @@
+"""Machine-safety of the persistent XLA compile cache.
+
+CPU persistent-cache entries contain native machine code; loading an
+artifact compiled on a host with ISA extensions this host lacks can
+SIGILL/SIGABRT the whole process mid-sweep (XLA cpu_aot_loader).  The
+cache directory is therefore keyed by a host CPU fingerprint so a
+working tree carried between machines (bench host, compile service,
+CI) never loads a foreign host's native code.  Reference bar: the Go
+engine never hard-crashes (recovery there is reconcile idempotence,
+constrainttemplate_controller.go:156) — a policy engine that aborts
+mid-audit fails its one job.
+"""
+
+import os
+
+import jax
+
+from gatekeeper_tpu.utils.compile_cache import (
+    PersistentCacheStats,
+    _backend_subdir,
+    enable_persistent_cache,
+    host_fingerprint,
+    persistent_cache_stats,
+)
+
+
+class TestHostFingerprint:
+    def test_stable_and_short(self):
+        a, b = host_fingerprint(), host_fingerprint()
+        assert a == b
+        assert len(a) == 12
+        assert all(c in "0123456789abcdef" for c in a)
+
+    def test_cpu_subdir_is_machine_keyed(self):
+        sub = _backend_subdir("cpu")
+        assert sub == f"cpu-{host_fingerprint()}"
+        # the unkeyed name — the one that crashed cross-machine — must
+        # never come back
+        assert sub != "cpu"
+
+    def test_accelerator_backends_device_keyed(self):
+        # TPU/GPU binaries are device-generation-specific, not host-
+        # CPU-specific: keyed by device kind, never by the bare backend
+        # name (tests run on cpu, so devices[0].device_kind resolves)
+        assert _backend_subdir("gpu").startswith("gpu-")
+        assert _backend_subdir("tpu").startswith("tpu-")
+        # an unknown backend passes through unchanged
+        assert _backend_subdir("neuron") == "neuron"
+
+
+class TestEnablePersistentCache:
+    def test_configured_dir_is_machine_keyed(self):
+        # conftest forces the cpu platform; the path in effect for this
+        # whole test process must carry the fingerprint (a pre-existing
+        # executor may have enabled it already — idempotence means the
+        # first call's machine-keyed path is the one live)
+        path = enable_persistent_cache()
+        assert os.path.basename(path) != "cpu"
+        assert os.path.basename(path) == _backend_subdir(
+            jax.default_backend())
+
+    def test_idempotent(self):
+        assert enable_persistent_cache() == enable_persistent_cache()
+
+
+class TestPersistentCacheStats:
+    def test_counts_monitoring_events(self):
+        assert persistent_cache_stats() is persistent_cache_stats()
+        # a fresh instance, NOT the live singleton: background compile
+        # threads elsewhere in the suite tick the singleton's counters
+        # concurrently and would flake an exact-equality assert
+        stats = PersistentCacheStats()
+        snap = stats.snapshot()
+        stats._on_event("/jax/compilation_cache/cache_hits")
+        stats._on_event("/jax/compilation_cache/cache_misses")
+        stats._on_event("/jax/compilation_cache/cache_misses")
+        stats._on_event("/jax/compilation_cache/compile_requests_use_cache")
+        stats._on_event("/jax/some_other_event")
+        d = stats.delta_since(snap)
+        assert d == {"hits": 1, "misses": 2, "requests": 1}
+
+    def test_real_compile_records_a_cache_request(self):
+        # a fresh jit compile must tick the cache-eligible request
+        # counter — proving the listener is wired to JAX's real event
+        # stream (hit/miss only tick for compiles slow enough to
+        # qualify for persistence, which a tiny probe is not)
+        stats = persistent_cache_stats()
+        snap = stats.snapshot()
+        import jax.numpy as jnp
+
+        @jax.jit
+        def probe(x):
+            return x * 3 + 1
+
+        probe(jnp.arange(7)).block_until_ready()
+        d = stats.delta_since(snap)
+        assert d["requests"] >= 1
+
+    def test_delta_isolated_instances(self):
+        s = PersistentCacheStats()
+        assert s.snapshot() == {"hits": 0, "misses": 0, "requests": 0}
