@@ -43,6 +43,20 @@ _BG_LOCK = __import__("threading").Lock()
 _BG_THREADS: list = []
 _bg_drain_registered = False
 
+# Collective (shard_map) executables must be launched one at a time,
+# held to completion: two in-flight programs with cross-device
+# collectives can interleave on the per-device execution threads so
+# that one program's all-reduce rendezvous never assembles all its
+# participants — XLA's rendezvous watchdog then *kills the process*
+# (rendezvous.cc "Exiting to ensure a consistent program state";
+# observed as the round-3 `Fatal Python error: Aborted` in concurrent
+# dispatch).  Same discipline as NCCL's "issue collectives in a
+# consistent order" rule.  PROCESS-wide, not per-executor: two
+# executors over the same devices (the driver's and a test's) are the
+# same hazard.  Single-device executables are unaffected (their async
+# fetch overlap is the tunnel optimization).
+_COLLECTIVE_EXEC_LOCK = __import__("threading").Lock()
+
 
 def _register_bg_drain() -> None:
     global _bg_drain_registered
@@ -607,6 +621,9 @@ class ProgramExecutor:
         self._cache: dict[tuple, Any] = {}
         self._lock = __import__("threading").Lock()   # dispatch runs threaded
         self._trace_lock = __import__("threading").Lock()
+        # see _COLLECTIVE_EXEC_LOCK below — per-process, because the
+        # hazard is per device set, not per executor instance
+        self._collective_lock = _COLLECTIVE_EXEC_LOCK
         self._compile_inflight: dict[tuple, Any] = {}  # key -> Event
         self.compiles = 0      # executable-cache misses (trace+compile)
         self.cache_hits = 0    # executable-cache hits
@@ -1193,7 +1210,9 @@ class ProgramExecutor:
         arrays = self._arrays(bindings, match, rank)
         if self._sharded_for(bindings):
             fn, names = self._compiled(program, arrays, None, True)
-            mask = fn(tuple(arrays[nm] for nm in names))
+            with self._collective_lock:
+                mask = fn(tuple(arrays[nm] for nm in names))
+                jax.block_until_ready(mask)
         else:
             mask = self._viol_mask_dev(program, bindings, arrays,
                                        base, base_dirty, append_only)
@@ -1235,7 +1254,9 @@ class ProgramExecutor:
         arrays = self._arrays(bindings, match, rank)
         if self._sharded_for(bindings):
             fn, names = self._compiled(program, arrays, k, True)
-            packed = fn(tuple(arrays[nm] for nm in names))
+            with self._collective_lock:
+                packed = fn(tuple(arrays[nm] for nm in names))
+                jax.block_until_ready(packed)
         else:
             viol = self._viol_mask_dev(program, bindings, arrays,
                                        base, base_dirty, append_only)
