@@ -604,13 +604,29 @@ class Lowerer:
 
     def _rule_deps(self, name: str) -> _Deps:
         d = _Deps()
-        for rule in self.interp.rules.get(name, []):
-            params = {a.name for a in (rule.args or ()) if isinstance(a, Var)}
-            fb = frozenset(params)
-            for lit in rule.body:
-                d.merge(self._lit_deps(lit, fb))
-            if rule.value is not None:
-                d.merge(self._deps(rule.value, fb))
+        for top in self.interp.rules.get(name, []):
+            # walk the whole else chain: an else clause touching review
+            # must count toward the rule's dependencies or host-eval
+            # caching would misclassify it as constraint-only
+            rule = top
+            while rule is not None:
+                params = {a.name for a in (rule.args or ())
+                          if isinstance(a, Var)}
+                fb = frozenset(params)
+                # params SHADOW the enclosing rule's lowering env: a
+                # function param named like an outer iteration var
+                # (`port`) must not resolve to the outer leaf, or an
+                # args-only function gets misclassified as impure
+                shadowed = {p: self.env.pop(p) for p in params
+                            if p in self.env}
+                try:
+                    for lit in rule.body:
+                        d.merge(self._lit_deps(lit, fb))
+                    if rule.value is not None:
+                        d.merge(self._deps(rule.value, fb))
+                finally:
+                    self.env.update(shadowed)
+                rule = rule.els
         return d
 
     def _function_extends_args(self, name: str) -> bool:
@@ -1601,10 +1617,21 @@ class Lowerer:
         if self._inline_depth >= _MAX_INLINE_DEPTH:
             raise CannotLower("inline depth exceeded")
         fname = term.name[0]
-        rules = [r for r in self.interp.rules.get(fname, [])
-                 if r.kind == "function" and len(r.args or ()) == len(term.args)]
-        if not rules:
+        chains = [r for r in self.interp.rules.get(fname, [])
+                  if r.kind == "function" and len(r.args or ()) == len(term.args)]
+        if not chains:
             raise CannotLower(f"no matching clauses for {fname}")
+        # flatten else chains: in predicate position only definedness
+        # matters, and a chain is defined iff ANY clause body succeeds
+        # (b1 OR (not b1 AND b2) == b1 OR b2 — the prefix negation is
+        # absorbed by the OR).  Which clause supplies the value is a
+        # head-value question, covered by the inexact over-approximation
+        # below exactly as for multi-clause functions.
+        rules = []
+        for clause in chains:
+            while clause is not None:
+                rules.append(clause)
+                clause = clause.els
         self._inline_depth += 1
         outer_inexact = self._subtree_inexact
         self._subtree_inexact = False

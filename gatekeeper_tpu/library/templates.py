@@ -129,19 +129,16 @@ violation[{"msg": msg}] {
 
 _t("K8sContainerLimits", {"cpu": "2", "memory": "2Gi"})("""package k8scontainerlimits
 canonify_cpu(orig) = new { is_number(orig); new := orig * 1000 }
-canonify_cpu(orig) = new {
-  not is_number(orig)
+else = new {
   endswith(orig, "m")
   new := to_number(replace(orig, "m", ""))
 }
-canonify_cpu(orig) = new {
-  not is_number(orig)
-  not endswith(orig, "m")
+else = new {
   re_match("^[0-9]+(\\\\.[0-9]+)?$", orig)
   new := to_number(orig) * 1000
 }
 canonify_mem(orig) = new { is_number(orig); new := orig }
-canonify_mem(orig) = new { not is_number(orig); new := units.parse_bytes(orig) }
+else = new { new := units.parse_bytes(orig) }
 violation[{"msg": msg}] {
   container := input.review.object.spec.containers[_]
   cpu_orig := container.resources.limits.cpu
@@ -167,19 +164,16 @@ violation[{"msg": msg}] {
 
 _t("K8sContainerRequests", {"cpu": "500m", "memory": "100Mi"})("""package k8scontainerrequests
 canonify_cpu(orig) = new { is_number(orig); new := orig * 1000 }
-canonify_cpu(orig) = new {
-  not is_number(orig)
+else = new {
   endswith(orig, "m")
   new := to_number(replace(orig, "m", ""))
 }
-canonify_cpu(orig) = new {
-  not is_number(orig)
-  not endswith(orig, "m")
+else = new {
   re_match("^[0-9]+(\\\\.[0-9]+)?$", orig)
   new := to_number(orig) * 1000
 }
 canonify_mem(orig) = new { is_number(orig); new := orig }
-canonify_mem(orig) = new { not is_number(orig); new := units.parse_bytes(orig) }
+else = new { new := units.parse_bytes(orig) }
 violation[{"msg": msg}] {
   container := input.review.object.spec.containers[_]
   cpu := canonify_cpu(container.resources.requests.cpu)
@@ -198,14 +192,11 @@ violation[{"msg": msg}] {
 
 _t("K8sContainerRatios", {"ratio": 4})("""package k8scontainerratios
 canonify_cpu(orig) = new { is_number(orig); new := orig * 1000 }
-canonify_cpu(orig) = new {
-  not is_number(orig)
+else = new {
   endswith(orig, "m")
   new := to_number(replace(orig, "m", ""))
 }
-canonify_cpu(orig) = new {
-  not is_number(orig)
-  not endswith(orig, "m")
+else = new {
   re_match("^[0-9]+(\\\\.[0-9]+)?$", orig)
   new := to_number(orig) * 1000
 }
@@ -459,6 +450,18 @@ violation[{"msg": msg}] {
   repo := input.constraint.spec.parameters.repos[_]
   startswith(container.image, repo)
   msg := sprintf("container <%v> image <%v> comes from a disallowed repository <%v>", [container.name, container.image, repo])
+}
+""")
+
+_t("K8sAllowedHostPorts", {"min": 1024, "max": 32767})("""package k8sallowedhostports
+out_of_range(port, min, max) { port.hostPort < min }
+else { port.hostPort > max }
+
+violation[{"msg": msg}] {
+  container := input.review.object.spec.containers[_]
+  port := container.ports[_]
+  out_of_range(port, input.constraint.spec.parameters.min, input.constraint.spec.parameters.max)
+  msg := sprintf("container <%v> hostPort <%v> is outside the allowed range", [container.name, port.hostPort])
 }
 """)
 

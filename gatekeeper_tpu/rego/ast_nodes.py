@@ -144,6 +144,10 @@ class Rule:
     body: tuple[Literal, ...]
     is_default: bool = False
     loc: Location = dataclasses.field(default_factory=Location)
+    # `else` chain, linked like OPA's AST (vendor opa/ast/policy.go:154
+    # Rule.Else; linkage built at parser_ext.go:689): the next clause to
+    # try when this clause's body fails.  First matching clause wins.
+    els: Optional["Rule"] = None
 
 
 @dataclasses.dataclass
@@ -167,6 +171,8 @@ def walk_terms(node, fn) -> None:
             walk_terms(node.value, fn)
         for lit in node.body:
             walk_terms(lit, fn)
+        if node.els is not None:
+            walk_terms(node.els, fn)
         return
     if isinstance(node, Literal):
         e = node.expr

@@ -170,16 +170,29 @@ class Interpreter:
                     for v, _ in self._term_eval(ctx, rule.value, {}):
                         default_val = v
                     continue
-                for env in self._eval_body(ctx, rule.body, 0, {}):
-                    if rule.value is None:
-                        v = True
-                    else:
-                        got = list(self._term_eval(ctx, rule.value, env))
-                        if not got:
-                            continue
-                        v = got[0][0]
-                    if not _contains(results, v):
-                        results.append(v)
+                # `else` chain: first clause that produces a value wins
+                # for this definition (opa/ast/policy.go:154 Rule.Else;
+                # topdown tries clauses in order); separate definitions
+                # still conflict-check against each other below
+                clause = rule
+                while clause is not None:
+                    clause_vals: list = []
+                    for env in self._eval_body(ctx, clause.body, 0, {}):
+                        if clause.value is None:
+                            v = True
+                        else:
+                            got = list(self._term_eval(ctx, clause.value, env))
+                            if not got:
+                                continue
+                            v = got[0][0]
+                        if not _contains(clause_vals, v):
+                            clause_vals.append(v)
+                    if clause_vals:
+                        for v in clause_vals:
+                            if not _contains(results, v):
+                                results.append(v)
+                        break
+                    clause = clause.els
             if len(results) > 1:
                 raise ConflictError(f"complete rule {name} produced multiple values")
             value = results[0] if results else default_val
@@ -195,17 +208,29 @@ class Interpreter:
         for rule in rules:
             if rule.kind != "function" or len(rule.args or ()) != len(argvals):
                 continue
-            for env in self._match_args(ctx, rule.args, argvals, {}):
-                for env2 in self._eval_body(ctx, rule.body, 0, env):
-                    if rule.value is None:
-                        v = True
-                    else:
-                        got = list(self._term_eval(ctx, rule.value, env2))
-                        if not got:
-                            continue
-                        v = got[0][0]
-                    if not _contains(outputs, v):
-                        outputs.append(v)
+            # `else` chain: clauses share the head's params; the first
+            # clause whose body succeeds for these args provides this
+            # definition's output (opa Rule.Else semantics)
+            clause = rule
+            while clause is not None:
+                clause_out: list = []
+                for env in self._match_args(ctx, clause.args, argvals, {}):
+                    for env2 in self._eval_body(ctx, clause.body, 0, env):
+                        if clause.value is None:
+                            v = True
+                        else:
+                            got = list(self._term_eval(ctx, clause.value, env2))
+                            if not got:
+                                continue
+                            v = got[0][0]
+                        if not _contains(clause_out, v):
+                            clause_out.append(v)
+                if clause_out:
+                    for v in clause_out:
+                        if not _contains(outputs, v):
+                            outputs.append(v)
+                    break
+                clause = clause.els
         # OPA: all function clauses that fire must agree on the output
         if len(outputs) > 1:
             raise ConflictError(f"function {name} produced multiple values for one input")
@@ -644,13 +669,17 @@ _MISS = object()
 
 
 def _walk_rule(rule: Rule, visit) -> None:
-    """Apply `visit` to every term in a rule (pre-order)."""
-    for t in (rule.key, rule.value):
-        if t is not None:
-            _walk_term(t, visit)
-    for a in rule.args or ():
-        _walk_term(a, visit)
-    _walk_body(rule.body, visit)
+    """Apply `visit` to every term in a rule (pre-order), including
+    its whole else chain — chain clauses must reach the precomputed
+    canon/const-path/builtin side tables like any other clause."""
+    while rule is not None:
+        for t in (rule.key, rule.value):
+            if t is not None:
+                _walk_term(t, visit)
+        for a in rule.args or ():
+            _walk_term(a, visit)
+        _walk_body(rule.body, visit)
+        rule = rule.els
 
 
 def _walk_body(body, visit) -> None:

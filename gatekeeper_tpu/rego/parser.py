@@ -150,10 +150,54 @@ class Parser:
         elif value is None and kind in ("complete", "partial_set"):
             t = self.cur()
             raise ParseError(f"expected rule body or value, got {t.value!r}", t.loc)
-        if self.at("keyword", "else"):
-            raise ParseError("`else` rules are not supported by the template subset", self.cur().loc)
+        els = None
+        if self._at_else():
+            # OPA accepts else only on complete rules and functions
+            # (ast/parser_ext.go:689 else-linkage; rego.peg:39)
+            if kind not in ("complete", "function") or is_default:
+                raise ParseError(
+                    "`else` is only valid on complete rules and functions",
+                    self.cur().loc)
+            els = self._parse_else_chain(name, kind, args)
         return Rule(name=name, kind=kind, args=args, key=key, value=value,
-                    body=body, is_default=is_default, loc=loc)
+                    body=body, is_default=is_default, loc=loc, els=els)
+
+    def _at_else(self) -> bool:
+        """Is the next non-newline token `else`?  OPA's whitespace rule
+        lets a chain clause start on its own line; `else` is a keyword
+        so the lookahead is unambiguous (no rule can be named else).
+        Consumes the newlines only when the answer is yes."""
+        save = self.pos
+        self.skip_newlines()
+        if self.at("keyword", "else"):
+            return True
+        self.pos = save
+        return False
+
+    def _parse_else_chain(self, name: str, kind: str, args):
+        """One `else [= value] { body }` clause (plus its own tail).
+        Else clauses share the head's params — the clause head cannot
+        rebind them (mirrors OPA's Rule.Else chain)."""
+        loc = self.expect("keyword", "else").loc
+        value = None
+        if self.at("op", "=") or self.at("op", ":="):
+            self.advance()
+            self._nlskip += 1
+            value = self.parse_expr()
+            self._nlskip -= 1
+        body: tuple[Literal, ...] = ()
+        if self.at("op", "{"):
+            body = self.parse_body()
+        elif value is None:
+            t = self.cur()
+            raise ParseError(
+                f"expected `= value` or body after else, got {t.value!r}",
+                t.loc)
+        els = None
+        if self._at_else():
+            els = self._parse_else_chain(name, kind, args)
+        return Rule(name=name, kind=kind, args=args, key=None, value=value,
+                    body=body, is_default=False, loc=loc, els=els)
 
     def parse_body(self) -> tuple[Literal, ...]:
         """`{` newline-or-semicolon separated literals `}`."""

@@ -175,17 +175,20 @@ def _map_comprehensions(lit: Literal, rule_names: set[str]) -> Literal:
     return Literal(expr=e, negated=lit.negated, withs=lit.withs, loc=lit.loc)
 
 
+def _reorder_rule(r: Rule, rule_names: set[str]) -> Rule:
+    params: set[str] = set()
+    for p in (r.args or ()):
+        _collect_pattern_vars(p, params)
+    return Rule(
+        name=r.name, kind=r.kind, args=r.args, key=r.key, value=r.value,
+        body=reorder_body(r.body, rule_names, params),
+        is_default=r.is_default, loc=r.loc,
+        els=_reorder_rule(r.els, rule_names) if r.els is not None else None)
+
+
 def reorder_module(module: Module) -> Module:
     rule_names = {r.name for r in module.rules}
-    new_rules = []
-    for r in module.rules:
-        params: set[str] = set()
-        for p in (r.args or ()):
-            _collect_pattern_vars(p, params)
-        new_rules.append(Rule(
-            name=r.name, kind=r.kind, args=r.args, key=r.key, value=r.value,
-            body=reorder_body(r.body, rule_names, params),
-            is_default=r.is_default, loc=r.loc))
+    new_rules = [_reorder_rule(r, rule_names) for r in module.rules]
     return Module(package=module.package, rules=new_rules, imports=module.imports)
 
 

@@ -1,0 +1,170 @@
+"""`else` rule chains: parse, evaluate, lower.
+
+OPA accepts else chains on complete rules and functions
+(vendor opa/ast/policy.go:154 Rule.Else, rego.peg:39, linkage at
+ast/parser_ext.go:689); real gatekeeper-library templates use them
+(canonify_cpu et al.).  Round-3 VERDICT #3: a valid-in-reference
+template must never be refused — parse the chain, evaluate
+first-matching-clause in both interpreter tiers, and keep templates
+device-lowered where the chain is expressible (value-position pure
+functions are host-tabled; predicate-position definedness is the OR of
+clause bodies).
+"""
+
+import pytest
+
+from gatekeeper_tpu.errors import ParseError
+from gatekeeper_tpu.rego import parse_module
+from gatekeeper_tpu.rego.interp import Interpreter, UNDEFINED
+
+
+def both_tiers(src):
+    """The compiled-closures interpreter and the plain tree-walker."""
+    compiled = Interpreter(parse_module(src))
+    assert compiled._closures is not None
+    plain = Interpreter(parse_module(src))
+    plain._closures = None
+    return [compiled, plain]
+
+
+class TestParse:
+    def test_chain_structure(self):
+        m = parse_module("""package t
+r = 1 { input.a } else = 2 { input.b } else = 3 { input.c }
+""")
+        r = m.rules[0]
+        assert r.value is not None and r.els is not None
+        assert r.els.els is not None and r.els.els.els is None
+        assert r.els.name == "r" and r.els.kind == "complete"
+
+    def test_else_without_value_defaults_true(self):
+        m = parse_module("package t\nr = 1 { input.a } else { input.b }\n")
+        assert m.rules[0].els.value is None
+
+    def test_else_value_without_body(self):
+        m = parse_module("package t\nr = 1 { input.a } else = 99\n")
+        assert m.rules[0].els.value is not None
+        assert m.rules[0].els.body == ()
+
+    def test_function_chain(self):
+        m = parse_module("""package t
+f(x) = 1 { x > 10 } else = 2 { x > 5 } else = 3 { true }
+""")
+        r = m.rules[0]
+        assert r.kind == "function"
+        assert r.els.args == r.args          # clauses share head params
+        assert r.els.els.value is not None
+
+    def test_else_on_partial_set_rejected(self):
+        with pytest.raises(ParseError):
+            parse_module("package t\nv[x] { x := 1 } else = 2 { true }\n")
+
+    def test_else_on_default_rejected(self):
+        with pytest.raises(ParseError):
+            parse_module("package t\ndefault r = 1 else = 2 { true }\n")
+
+    def test_bare_else_rejected(self):
+        with pytest.raises(ParseError):
+            parse_module("package t\nr = 1 { input.a } else\n")
+
+
+class TestCompleteRuleChain:
+    SRC = """package t
+r = "first" { input.a == 1 }
+else = "second" { input.b == 1 }
+else = "third" { input.c == 1 }
+"""
+
+    @pytest.mark.parametrize("tier", [0, 1])
+    def test_first_clause_wins(self, tier):
+        interp = both_tiers(self.SRC)[tier]
+        assert interp.query_value("r", {"a": 1, "b": 1, "c": 1}, {}) == "first"
+
+    @pytest.mark.parametrize("tier", [0, 1])
+    def test_falls_to_second(self, tier):
+        interp = both_tiers(self.SRC)[tier]
+        assert interp.query_value("r", {"b": 1, "c": 1}, {}) == "second"
+
+    @pytest.mark.parametrize("tier", [0, 1])
+    def test_falls_to_third(self, tier):
+        interp = both_tiers(self.SRC)[tier]
+        assert interp.query_value("r", {"c": 1}, {}) == "third"
+
+    @pytest.mark.parametrize("tier", [0, 1])
+    def test_undefined_when_none_fire(self, tier):
+        interp = both_tiers(self.SRC)[tier]
+        assert interp.query_value("r", {"z": 1}, {}) is UNDEFINED
+
+    @pytest.mark.parametrize("tier", [0, 1])
+    def test_default_backstop(self, tier):
+        src = "package t\ndefault r = \"fallback\"\n" + self.SRC.split("\n", 1)[1]
+        interp = both_tiers(src)[tier]
+        assert interp.query_value("r", {}, {}) == "fallback"
+        assert interp.query_value("r", {"b": 1}, {}) == "second"
+
+    @pytest.mark.parametrize("tier", [0, 1])
+    def test_valueless_else_is_true(self, tier):
+        src = "package t\nr = \"x\" { input.a == 1 } else { input.b == 1 }\n"
+        interp = both_tiers(src)[tier]
+        assert interp.query_value("r", {"b": 1}, {}) is True
+
+
+class TestFunctionChain:
+    # the canonical gatekeeper-library shape: canonify_cpu with else
+    SRC = """package t
+canonify_cpu(orig) = new { is_number(orig); new := orig * 1000 }
+else = new { endswith(orig, "m"); new := to_number(replace(orig, "m", "")) }
+else = new { new := to_number(orig) * 1000 }
+
+result = x { x := canonify_cpu(input.v) }
+"""
+
+    @pytest.mark.parametrize("tier", [0, 1])
+    @pytest.mark.parametrize("v,want", [
+        (2, 2000), ("250m", 250), ("1", 1000), ("1.5", 1500)])
+    def test_canonify(self, tier, v, want):
+        interp = both_tiers(self.SRC)[tier]
+        assert interp.query_value("result", {"v": v}, {}) == want
+
+    @pytest.mark.parametrize("tier", [0, 1])
+    def test_chain_order_matters(self, tier):
+        # "100m" must hit the endswith clause, not the to_number one
+        # (to_number("100m") would error/undefine — chain stops first)
+        interp = both_tiers(self.SRC)[tier]
+        assert interp.query_value("result", {"v": "100m"}, {}) == 100
+
+    @pytest.mark.parametrize("tier", [0, 1])
+    def test_two_chains_conflict_check(self, tier):
+        src = """package t
+f(x) = 1 { x > 0 } else = 2 { true }
+f(x) = 9 { x > 100 }
+r = v { v := f(input.n) }
+"""
+        interp = both_tiers(src)[tier]
+        # n=5: chain1 -> 1, chain2 body fails -> single value
+        assert interp.query_value("r", {"n": 5}, {}) == 1
+        # n=200: chain1 -> 1, chain2 -> 9: conflicting outputs
+        from gatekeeper_tpu.errors import ConflictError
+        with pytest.raises(ConflictError):
+            interp.query_value("r", {"n": 200}, {})
+
+    @pytest.mark.parametrize("tier", [0, 1])
+    def test_else_in_violation_predicate(self, tier):
+        src = """package t
+exceeds(v) { is_number(v); v > 100 }
+else { endswith(v, "m"); to_number(replace(v, "m", "")) > 100000 }
+
+violation[{"msg": msg}] {
+  exceeds(input.review.object.spec.v)
+  msg := "too big"
+}
+"""
+        interp = both_tiers(src)[tier]
+        def q(v):
+            return interp.query_set(
+                "violation", {"review": {"object": {"spec": {"v": v}}},
+                              "constraint": {"spec": {"parameters": {}}}}, {})
+        assert len(q(200)) == 1
+        assert len(q(50)) == 0
+        assert len(q("200000m")) == 1
+        assert len(q("5m")) == 0
