@@ -459,6 +459,19 @@ class JaxDriver(LocalDriver):
                             and not small:
                         bindings = self._kind_bindings(st, kind, compiled,
                                                        constraints)
+                        if bindings.f32_unsafe:
+                            # some bound numeric value does not survive a
+                            # float32 round-trip (|v| past 2^24): device
+                            # ordering compares could silently mis-order,
+                            # so this kind runs on the scalar oracle
+                            # (ir/lower.py "known deviations" guard)
+                            self.metrics.counter(
+                                "f32_unsafe_scalar_fallbacks").inc()
+                            spec = ("scalar", kind, compiled, constraints,
+                                    None, None, mask)
+                            futures.append(None)
+                            specs.append(spec)
+                            continue
                         self._install_gates(st, kind, bindings, mask,
                                             mask_dirty, rank, padded)
                         prog = compiled.vectorized.program
@@ -588,6 +601,12 @@ class JaxDriver(LocalDriver):
                 lowered = None
             if lowered is not None and ops_ok:
                 bindings = build_bindings(lowered.spec, mt, cons)
+                if bindings.f32_unsafe:
+                    # float32 round-trip-unsafe numerics: the device
+                    # gate could under-approximate (mis-ordered compare
+                    # drops a real violation) — keep the match-only gate
+                    plans.append((kind, compiled, cons, cmask, None))
+                    continue
                 h = self.executor.run_async(lowered.program, bindings,
                                             match=cmask)
                 plans.append((kind, compiled, cons, cmask, h))

@@ -394,9 +394,27 @@ class Bindings:
     # append-only way (value tables gaining entries for ids that only
     # dirty rows reference) — row-sliced delta evaluation stays sound
     base_append_only: set = dataclasses.field(default_factory=set)
+    # True when some numeric value bound for the device is not exactly
+    # representable in float32 (|v| past 2^24 off the even lattice):
+    # device ordering compares could silently mis-order such values
+    # (ir/lower.py "known deviations"), so the driver routes this
+    # kind's evaluation to the scalar oracle instead.
+    f32_unsafe: bool = False
 
     def shapes_key(self) -> tuple:
         return tuple(sorted((k, v.shape, str(v.dtype)) for k, v in self.arrays.items()))
+
+
+def _f32_exact(a) -> bool:
+    """Every finite value in `a` survives a float32 round-trip exactly.
+    False means a device float32 ordering compare could mis-order
+    (integers past 2^24, or floats needing >24 mantissa bits)."""
+    a = np.asarray(a, dtype=np.float64)
+    if a.size == 0:
+        return True
+    with np.errstate(invalid="ignore", over="ignore"):
+        rt = a.astype(np.float32).astype(np.float64)
+        return bool(np.all(np.isnan(a) | (a == rt)))
 
 
 def _eval_host(fn, *args):
@@ -467,6 +485,7 @@ def build_bindings(spec: PrepSpec, table: ResourceTable,
     n_con = len(constraints)
     r_pad, c_pad = audit_pads(n, n_con)
     out: dict[str, np.ndarray] = {}
+    f32_unsafe = False
     # bookkeeping that makes the next update_bindings() possible
     state: dict = {"gen": table.generation, "remap": table.remap_generation,
                    "tables": {}, "ptables": {}, "csets": {},
@@ -495,6 +514,7 @@ def build_bindings(spec: PrepSpec, table: ResourceTable,
             p = np.zeros((r_pad,), dtype=bool)
             v[:n] = col.values.astype(np.float32)
             p[:n] = col.present
+            f32_unsafe = f32_unsafe or not _f32_exact(col.values[col.present])
             out[rc.name + ".v"] = v
             out[rc.name + ".p"] = p
         elif rc.mode in ("present", "truthy"):
@@ -539,6 +559,7 @@ def build_bindings(spec: PrepSpec, table: ResourceTable,
                 if len(flat):
                     v[idx_r, idx_e] = np.nan_to_num(fv).astype(np.float32)
                     p[idx_r, idx_e] = ~np.isnan(fv)
+                    f32_unsafe = f32_unsafe or not _f32_exact(fv)
                 out[ec.name + ".v"] = v
                 out[ec.name + ".p"] = p
             else:  # present / truthy
@@ -619,6 +640,7 @@ def build_bindings(spec: PrepSpec, table: ResourceTable,
                 if isinstance(v, (int, float)) and not isinstance(v, bool):
                     ok[uid] = True
                     vals[uid] = np.float32(v)
+                    f32_unsafe = f32_unsafe or not _f32_exact([v])
             elif tr.out == "id_str":
                 if isinstance(v, str):
                     ok[uid] = True
@@ -793,6 +815,7 @@ def build_bindings(spec: PrepSpec, table: ResourceTable,
                 if isinstance(x, (int, float)) and not isinstance(x, bool):
                     v[ci] = np.float32(x)
                     p[ci] = True
+                    f32_unsafe = f32_unsafe or not _f32_exact([x])
             out[cv.name + ".v"] = v
             out[cv.name + ".p"] = p
         elif cv.kind == "str":
@@ -835,7 +858,7 @@ def build_bindings(spec: PrepSpec, table: ResourceTable,
 
     return Bindings(arrays=out, n_constraints=n_con, n_resources=n,
                     c_pad=c_pad, r_pad=r_pad, e_pads=e_pads,
-                    delta_state=state)
+                    delta_state=state, f32_unsafe=f32_unsafe)
 
 
 def update_bindings(spec: PrepSpec, table: ResourceTable,
@@ -915,6 +938,7 @@ def update_bindings(spec: PrepSpec, table: ResourceTable,
         base_dirty[name] = base_rows
         return arr
 
+    f32_unsafe = prev.f32_unsafe
     alive = cow("__alive__")
     alive[dirty] = [table._metas[int(i)] is not None for i in dirty]
 
@@ -930,6 +954,8 @@ def update_bindings(spec: PrepSpec, table: ResourceTable,
             col = table.column(ColSpec(rc.path, rc.mode))
             cow(rc.name + ".v")[dirty] = col.values[dirty].astype(np.float32)
             cow(rc.name + ".p")[dirty] = col.present[dirty]
+            f32_unsafe = f32_unsafe or not _f32_exact(
+                col.values[dirty][col.present[dirty]])
         else:  # present / truthy
             col = table.column(ColSpec(rc.path, rc.mode))
             cow(rc.name)[dirty] = col.present[dirty]
@@ -976,6 +1002,7 @@ def update_bindings(spec: PrepSpec, table: ResourceTable,
                 if len(flat):
                     v[idx_r, idx_e] = np.nan_to_num(fv).astype(np.float32)
                     p[idx_r, idx_e] = ~np.isnan(fv)
+                    f32_unsafe = f32_unsafe or not _f32_exact(fv)
             else:
                 b = cow(ec.name)
                 b[dirty] = False
@@ -1039,6 +1066,7 @@ def update_bindings(spec: PrepSpec, table: ResourceTable,
                     if isinstance(v, (int, float)) and not isinstance(v, bool):
                         ok[uid] = True
                         vals[uid] = np.float32(v)
+                        f32_unsafe = f32_unsafe or not _f32_exact([v])
                 elif tr.out == "id_str":
                     if isinstance(v, str):
                         ok[uid] = True
@@ -1172,7 +1200,7 @@ def update_bindings(spec: PrepSpec, table: ResourceTable,
                     n_resources=n, c_pad=c_pad, r_pad=r_pad,
                     e_pads=prev.e_pads, delta_state=state,
                     base=prev, base_dirty=base_dirty,
-                    base_append_only=append_only)
+                    base_append_only=append_only, f32_unsafe=f32_unsafe)
 
 
 _META_FIELDS = {

@@ -1,0 +1,119 @@
+"""float32-precision guard (round-3 VERDICT weak #7).
+
+Device numeric columns are float32; integers past 2^24 off the even
+lattice do not survive the round-trip, so an ordering compare on
+device could silently mis-order (ir/lower.py "known deviations").
+Guard: prep flags bindings whose bound numerics are not exactly
+f32-representable (`Bindings.f32_unsafe`) and the driver routes those
+kinds to the scalar oracle — parity over mis-ordering, never silence.
+"""
+
+import random
+
+from gatekeeper_tpu.client.client import Backend
+from gatekeeper_tpu.client.interface import QueryOpts
+from gatekeeper_tpu.client.local_driver import LocalDriver
+from gatekeeper_tpu.engine.jax_driver import JaxDriver
+from gatekeeper_tpu.ir.prep import _f32_exact
+from gatekeeper_tpu.target.k8s import TARGET_NAME, K8sValidationTarget
+
+TEMPLATE = {
+    "apiVersion": "templates.gatekeeper.sh/v1alpha1",
+    "kind": "ConstraintTemplate",
+    "metadata": {"name": "k8smaxquota"},
+    "spec": {"crd": {"spec": {"names": {"kind": "K8sMaxQuota"}}},
+             "targets": [{"target": TARGET_NAME, "rego": """package k8smaxquota
+violation[{"msg": msg}] {
+  input.review.object.spec.quota > input.constraint.spec.parameters.max
+  msg := sprintf("quota %v over max", [input.review.object.spec.quota])
+}
+"""}]},
+}
+
+
+def _constraint(mx):
+    return {"apiVersion": "constraints.gatekeeper.sh/v1alpha1",
+            "kind": "K8sMaxQuota", "metadata": {"name": "maxq"},
+            "spec": {"parameters": {"max": mx}}}
+
+
+def _pod(i, quota):
+    return {"apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": f"p{i:04d}", "namespace": "d"},
+            "spec": {"quota": quota}}
+
+
+class TestF32Exact:
+    def test_lattice(self):
+        assert _f32_exact([0, 1, -5, 2.5, 2**24, 2**24 + 2, 2**25])
+        assert not _f32_exact([2**24 + 1])
+        assert not _f32_exact([-(2**24) - 1])
+        assert not _f32_exact([16777217.0])
+        assert _f32_exact([float("nan"), 3.0])
+        assert _f32_exact([])
+
+
+class TestDriverRouting:
+    def _run(self, quotas, mx, limit=None):
+        res = {}
+        for nm, drv in (("jax", JaxDriver()), ("local", LocalDriver())):
+            c = Backend(drv).new_client([K8sValidationTarget()])
+            c.add_template(TEMPLATE)
+            c.add_constraint(_constraint(mx))
+            for i, q in enumerate(quotas):
+                c.add_data(_pod(i, q))
+            got, _ = drv.query_audit(TARGET_NAME,
+                                     QueryOpts(limit_per_constraint=limit))
+            res[nm] = sorted((r.review or {}).get("name", "") for r in got)
+            if nm == "jax":
+                res["fallbacks"] = drv.metrics.counter(
+                    "f32_unsafe_scalar_fallbacks").value
+        return res
+
+    def test_adjacent_past_2_24_parity(self):
+        # 2^24 and 2^24+1 collapse to the same float32; the oracle says
+        # exactly one of them violates max=2^24
+        rng = random.Random(0)
+        quotas = [2**24, 2**24 + 1] + [rng.randrange(100) for _ in range(40)]
+        res = self._run(quotas, 2**24)
+        assert res["jax"] == res["local"] == ["p0001"]
+        assert res["fallbacks"] >= 1
+
+    def test_small_numbers_stay_on_device(self):
+        quotas = list(range(40))
+        res = self._run(quotas, 20)
+        assert res["jax"] == res["local"]
+        assert res["fallbacks"] == 0
+
+    def test_unsafe_constraint_param(self):
+        # the resource values are safe; the CONSTRAINT bound is not
+        quotas = [2**24 + 2, 5, 9]          # +2 is on the even lattice
+        res = self._run(quotas, 2**24 + 1)
+        assert res["jax"] == res["local"] == ["p0000"]
+        assert res["fallbacks"] >= 1
+
+    def test_churn_introduces_unsafe_value(self):
+        # delta path: bindings start safe, an upsert brings 2^24+1 in —
+        # update_bindings must flip the flag
+        jd = JaxDriver()
+        ld = LocalDriver()
+        cj = Backend(jd).new_client([K8sValidationTarget()])
+        cl = Backend(ld).new_client([K8sValidationTarget()])
+        for c in (cj, cl):
+            c.add_template(TEMPLATE)
+            c.add_constraint(_constraint(2**24))
+        for i in range(40):
+            p = _pod(i, i)
+            cj.add_data(p)
+            cl.add_data(p)
+
+        def audit(drv):
+            got, _ = drv.query_audit(TARGET_NAME, QueryOpts())
+            return sorted((r.review or {}).get("name", "") for r in got)
+
+        assert audit(jd) == audit(ld) == []
+        bump = _pod(3, 2**24 + 1)
+        cj.add_data(bump)
+        cl.add_data(bump)
+        assert audit(jd) == audit(ld) == ["p0003"]
+        assert jd.metrics.counter("f32_unsafe_scalar_fallbacks").value >= 1

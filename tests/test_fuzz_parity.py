@@ -25,7 +25,14 @@ PROBES = ["livenessProbe", "readinessProbe", "startupProbe"]
 
 def gen_conjunct(rng):
     """(body_line, needs_container, needs_env) from the pattern menu."""
-    kind = rng.randrange(13)
+    kind = rng.randrange(14)
+    if kind == 13:
+        # ordering compare in the float32-unsafe zone (>= 2^24): the
+        # driver must route the kind to the scalar oracle (the
+        # f32_unsafe prep guard) or device f32 rounding mis-orders
+        return (f"input.review.object.spec.bigquota "
+                f"{rng.choice(['>', '<', '>=', '<='])} "
+                f"input.constraint.spec.parameters.bigbound", 0, 0)
     neg = "not " if rng.random() < 0.35 else ""
     if kind == 10:
         # compound-value equality (a round-1 soundness trap: must not
@@ -93,6 +100,41 @@ def gen_rule(rng, i, ri):
     return "violation[{\"msg\": msg}] {\n  %s\n}" % "\n  ".join(body)
 
 
+def gen_else_rule(rng, i, ri):
+    """An else-chain helper + a rule using it.  Three shapes, hitting
+    the three lowering routes: predicate-position inline (chain
+    flattened to OR on device), pure value-table (host-tabled through
+    the chain-aware interp), and impure value-position (CannotLower ->
+    scalar fallback).  Parity must hold on every route."""
+    shape = rng.randrange(3)
+    if shape == 0:
+        helper = (
+            f"risky{ri}(c) {{ startswith(c.image, \"{rng.choice(REPOS)}\") }}\n"
+            f"else {{ not c[\"{rng.choice(PROBES)}\"] }}\n"
+            f"else {{ c.env[_].name == \"SECRET\" }}")
+        cond = f"risky{ri}(container)"
+        if rng.random() < 0.5:
+            cond = "not " + cond
+    elif shape == 1:
+        helper = (
+            f"canon{ri}(v) = 3 {{ v == \"{rng.choice(VALUES)}\" }}\n"
+            f"else = 2 {{ v == \"{rng.choice(VALUES)}\" }}\n"
+            f"else = 1 {{ true }}")
+        cond = (f'canon{ri}(input.review.object.metadata.labels'
+                f'["{rng.choice(LABELS)}"]) '
+                f"{rng.choice(['>=', '=='])} {rng.randrange(1, 4)}")
+    else:
+        helper = (
+            f"probecls{ri}(c) = 2 {{ c[\"livenessProbe\"] }}\n"
+            f"else = 1 {{ c[\"readinessProbe\"] }}\n"
+            f"else = 0 {{ true }}")
+        cond = f"probecls{ri}(container) >= {rng.randrange(1, 3)}"
+    body = ["container := input.review.object.spec.containers[_]", cond,
+            f'msg := sprintf("t{i}r{ri}else fired on %v", '
+            '[input.review.object.metadata.name])']
+    return helper + "\nviolation[{\"msg\": msg}] {\n  %s\n}" % "\n  ".join(body)
+
+
 INV_JOIN_RULE = """violation[{"msg": msg}] {
   host := input.review.object.spec.host
   other := data.inventory.namespace[ns][_]["Pod"][name]
@@ -108,6 +150,8 @@ def gen_template(rng, i):
     rules = [gen_rule(rng, i, ri) for ri in range(rng.randint(1, 2))]
     if rng.random() < 0.25:
         rules.append(INV_JOIN_RULE)        # inventory duplicate join
+    if rng.random() < 0.4:
+        rules.append(gen_else_rule(rng, i, len(rules)))
     return "package fuzz%d\n%s\n" % (i, "\n".join(rules))
 
 
@@ -128,6 +172,10 @@ def gen_pod(rng, i):
     spec = {"containers": containers}
     if rng.random() < 0.5:
         spec["replicas"] = rng.randrange(6)
+    if rng.random() < 0.5:
+        # values adjacent on the f32 lattice near/past 2^24
+        spec["bigquota"] = rng.choice(
+            [2**24 - 1, 2**24, 2**24 + 1, 2**24 + 2, 2**24 + 3, 5])
     if rng.random() < 0.3:
         spec["host"] = f"h{rng.randrange(4)}.com"   # inventory-join fodder
     return {"apiVersion": "v1", "kind": "Pod",
@@ -195,6 +243,16 @@ def test_fuzz_driver_parity(seed):
     rng = random.Random(seed * 7919)
     local = Backend(LocalDriver()).new_client([K8sValidationTarget()])
     jx = Backend(JaxDriver()).new_client([K8sValidationTarget()])
+    # template -1 is a fixed always-lowerable anchor: a seed whose
+    # random draws produce only unlowerable templates would otherwise
+    # trip the "no lowerable templates" meta-assertion below
+    anchor = ('package fuzzanchor\nviolation[{"msg": msg}] {\n'
+              '  input.review.object.spec.replicas > 3\n'
+              '  msg := sprintf("anchor fired on %v", '
+              '[input.review.object.metadata.name])\n}\n')
+    for c in (local, jx):
+        c.add_template(tdoc(f"Fuzz{seed}Anchor", anchor))
+        c.add_constraint(cdoc(f"Fuzz{seed}Anchor", "anchor", {}))
     n_templates = 5
     for i in range(n_templates):
         src = gen_template(rng, i)
@@ -203,7 +261,8 @@ def test_fuzz_driver_parity(seed):
                   "repos": rng.sample(REPOS, k=rng.randint(1, 2)),
                   "probes": rng.sample(PROBES, k=rng.randint(1, 2)),
                   "allowed": [rng.choice(REPOS) + f"app{k}" for k in range(2)],
-                  "slack": rng.randrange(4)}
+                  "slack": rng.randrange(4),
+                  "bigbound": rng.choice([2**24, 2**24 + 1, 2**24 + 2])}
         match = gen_match(rng)
         for c in (local, jx):
             c.add_template(tdoc(kind, src))
