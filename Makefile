@@ -6,7 +6,7 @@
 IMG ?= gatekeeper-tpu:latest
 PY ?= python
 
-.PHONY: all native-test test bench bench-quick demo demo-agilebank manager worker \
+.PHONY: all native-test test soak bench bench-quick demo demo-agilebank manager worker \
         docker-build deploy undeploy lint ci
 
 all: test
@@ -17,6 +17,12 @@ native-test:
 	$(PY) -m pytest tests/ -q
 
 test: native-test
+
+# long-running fuzz + race soak sweeps (tests/test_soak.py gates on
+# GATEKEEPER_SOAK=1 so the default suite stays fast).  Cadence: run
+# before cutting a release image and nightly in CI — see ci.sh.
+soak:
+	GATEKEEPER_SOAK=1 $(PY) -m pytest tests/test_soak.py -q
 
 # the ONE-json-line benchmark contract (driver runs this on real TPU)
 bench:

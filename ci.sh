@@ -11,6 +11,14 @@ python -m compileall -q gatekeeper_tpu
 echo "== tests (virtual 8-device CPU mesh) =="
 python -m pytest tests/ -q
 
+# Soak cadence: `make soak` (GATEKEEPER_SOAK=1 long fuzz/race sweeps)
+# runs nightly and before any release image — opt-in here via SOAK=1
+# so the per-commit path stays fast.
+if [ "${SOAK:-0}" = "1" ]; then
+  echo "== soak (long fuzz + race sweeps) =="
+  GATEKEEPER_SOAK=1 python -m pytest tests/test_soak.py -q
+fi
+
 echo "== bench smoke (quick shapes) =="
 GATEKEEPER_BENCH_QUICK=1 GATEKEEPER_BENCH_N=20000 python bench.py > /tmp/bench.json
 python - <<'EOF'

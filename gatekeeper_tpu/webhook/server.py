@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from gatekeeper_tpu.utils.log import logger
@@ -22,6 +23,13 @@ _log = logger("webhook")
 WEBHOOK_PATH = "/v1/admit"
 DEFAULT_PORT = 8443          # the reference defaults to 443 (policy.go:48)
 
+# Hardening bounds (controller-runtime's webhook server enforces the
+# same classes of limit — read timeouts and a bounded decoder —
+# pkg/webhook/policy.go:57-79 rides that server):
+REQUEST_TIMEOUT_S = 10.0     # slowloris: socket read timeout per request
+MAX_BODY_BYTES = 10 << 20    # AdmissionReview objects are etcd-bounded
+DRAIN_TIMEOUT_S = 15.0       # stop(): wait for in-flight admissions
+
 
 class WebhookServer:
     """Serves /v1/admit (+ /metrics).  With ``cert_dir`` holding
@@ -31,7 +39,10 @@ class WebhookServer:
 
     def __init__(self, handler: ValidationHandler, port: int = DEFAULT_PORT,
                  host: str | None = None, metrics=None,
-                 cert_dir: str | None = None):
+                 cert_dir: str | None = None,
+                 request_timeout: float = REQUEST_TIMEOUT_S,
+                 max_body_bytes: int = MAX_BODY_BYTES,
+                 drain_timeout: float = DRAIN_TIMEOUT_S):
         # Default bind: all interfaces when serving TLS (in-cluster the
         # apiserver calls back through a Service to the pod IP — a
         # loopback bind would refuse every callback and, with
@@ -43,9 +54,23 @@ class WebhookServer:
         self.handler = handler
         self.metrics = metrics if metrics is not None else handler.metrics
         self.cert_dir = cert_dir
+        self.drain_timeout = drain_timeout
+        # graceful drain: in-flight admissions finish before stop()
+        # returns (the reference rides controller-runtime's server,
+        # which drains on shutdown; a killed-mid-admission request
+        # surfaces to the apiserver as a webhook failure and, with
+        # failurePolicy: Ignore, silently skips policy)
+        self._inflight = 0
+        self._inflight_cv = threading.Condition()
         outer = self
 
         class _HTTPHandler(BaseHTTPRequestHandler):
+            # socket read timeout for the whole request (header + body):
+            # a slowloris client trickling bytes is cut off here
+            # (StreamRequestHandler applies it via connection.settimeout;
+            # http.server closes the connection on the timeout)
+            timeout = request_timeout
+
             def log_message(self, *args):  # quiet
                 pass
 
@@ -67,8 +92,27 @@ class WebhookServer:
                 if self.path != WEBHOOK_PATH:
                     self.send_error(404)
                     return
+                if "chunked" in (self.headers.get(
+                        "Transfer-Encoding") or "").lower():
+                    # unbounded chunked bodies defeat the size cap; the
+                    # apiserver always sends Content-Length
+                    self.send_error(411, "Content-Length required")
+                    return
                 try:
                     length = int(self.headers.get("Content-Length", "0"))
+                except ValueError:
+                    self.send_error(400, "bad Content-Length")
+                    return
+                if length < 0:
+                    # rfile.read(-1) would read to EOF — unbounded
+                    self.send_error(400, "bad Content-Length")
+                    return
+                if length > max_body_bytes:
+                    self.send_error(413, "request body too large")
+                    return
+                with outer._inflight_cv:
+                    outer._inflight += 1
+                try:
                     body = json.loads(self.rfile.read(length) or b"{}")
                     request = body.get("request") or {}
                     response = outer.handler.handle(request)
@@ -86,7 +130,14 @@ class WebhookServer:
                     self.end_headers()
                     self.wfile.write(payload)
                 except Exception as e:  # malformed body etc.
-                    self.send_error(400, str(e))
+                    try:
+                        self.send_error(400, str(e))
+                    except Exception:
+                        pass   # client already gone
+                finally:
+                    with outer._inflight_cv:
+                        outer._inflight -= 1
+                        outer._inflight_cv.notify_all()
 
         self._server = ThreadingHTTPServer((host, port), _HTTPHandler)
         self.tls = False
@@ -114,7 +165,17 @@ class WebhookServer:
             self._thread.start()
 
     def stop(self) -> None:
-        self._server.shutdown()
+        """Stop accepting, drain in-flight admissions, then close."""
+        self._server.shutdown()          # stop the accept loop
+        deadline = time.monotonic() + self.drain_timeout
+        with self._inflight_cv:
+            while self._inflight > 0:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    _log.info("webhook drain timeout",
+                              inflight=self._inflight)
+                    break
+                self._inflight_cv.wait(remaining)
         self._server.server_close()
         if self._thread is not None:
             self._thread.join(timeout=10)
