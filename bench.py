@@ -368,6 +368,183 @@ def bench_regex_heavy(detail):
                       constraints, oracle_n=2_000)
 
 
+def bench_admission_open_loop(detail, handler, reqs):
+    """Open-loop (fixed-rate) admission replay: requests fire on a
+    schedule regardless of completion, so reported latency includes
+    honest queueing delay at that arrival rate — unlike the closed
+    32-thread loop below, which measures saturation queueing only
+    (round-3 VERDICT weak #3)."""
+    import threading
+
+    out = {}
+    for rate in (1000, 2000, 4000):
+        n = min(len(reqs), max(2000, rate * 3))
+        interval = 1.0 / rate
+        lat: list[float] = []
+        lock = threading.Lock()
+        it = iter(range(n))
+        start = time.perf_counter() + 0.05
+
+        def worker():
+            while True:
+                with lock:
+                    i = next(it, None)
+                if i is None:
+                    return
+                sched = start + i * interval
+                now = time.perf_counter()
+                if sched > now:
+                    time.sleep(sched - now)
+                handler.handle(reqs[i % len(reqs)])
+                done = time.perf_counter()
+                with lock:
+                    lat.append(done - sched)
+
+        threads = [threading.Thread(target=worker) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - start
+        lat.sort()
+        p50 = statistics.median(lat)
+        p99 = lat[int(0.99 * len(lat))]
+        achieved = n / wall
+        saturated = achieved < rate * 0.9
+        log(f"[admission-open-loop] {rate} rps target: p50 {p50*1e3:.2f}ms "
+            f"p99 {p99*1e3:.2f}ms, achieved {achieved:.0f} rps"
+            f"{' (SATURATED)' if saturated else ''}")
+        out[str(rate)] = {"p50_ms": round(p50 * 1e3, 3),
+                          "p99_ms": round(p99 * 1e3, 3),
+                          "achieved_rps": round(achieved, 1),
+                          "saturated": saturated}
+        if saturated:
+            break    # higher rates only measure deeper saturation
+    detail["admission_open_loop"] = out
+
+
+def bench_admission_device_batch(detail):
+    """Device-batched admission (query_review_batch, jax_driver.py) vs
+    the scalar per-review engine at a realistic constraint count: find
+    the batch-size crossover that justifies routing a coalesced batch
+    to the device (round-3 VERDICT weak #4 — the batch path existed
+    but was never measured through the tunnel)."""
+    from gatekeeper_tpu.engine import jax_driver as jd_mod
+
+    rng = random.Random(11)
+    jd = JaxDriver()
+    c = Backend(jd).new_client([K8sValidationTarget()])
+    c.add_template(template_doc("K8sRequiredLabels", REQUIRED_LABELS))
+    c.add_template(template_doc("K8sAllowedRepos", ALLOWED_REPOS))
+    for j in range(100):
+        c.add_constraint(constraint_doc(
+            "K8sRequiredLabels", f"lab-{j:03d}",
+            {"labels": rng.sample([f"l{x}" for x in range(10)], k=2)}))
+        c.add_constraint(constraint_doc(
+            "K8sAllowedRepos", f"rep-{j:03d}",
+            {"repos": rng.sample(["gcr.io/", "docker.io/", "quay.io/",
+                                  "ghcr.io/"], k=2)}))
+    objs = make_resources(4096, rng)
+    reviews = []
+    for i, o in enumerate(objs):
+        reviews.append({"uid": f"u{i}", "kind": {"group": "", "version": "v1",
+                                                 "kind": "Pod"},
+                        "name": o["metadata"]["name"],
+                        "namespace": o["metadata"]["namespace"],
+                        "operation": "CREATE", "object": o,
+                        "userInfo": {"username": "bench"}})
+    n_cons = 200
+
+    # scalar ceiling: single-thread per-review loop
+    for r in reviews[:8]:
+        jd.query_review(TARGET_NAME, r)          # closure warm
+    n_scalar = 512 if QUICK else 1024
+    t0 = time.perf_counter()
+    for r in reviews[:n_scalar]:
+        jd.query_review(TARGET_NAME, r)
+    scalar_rps = n_scalar / (time.perf_counter() - t0)
+
+    out = {"n_constraints": n_cons,
+           "scalar_single_thread_rps": round(scalar_rps, 1), "batched": {}}
+    crossover = None
+    saved = jd_mod.SMALL_WORKLOAD_EVALS
+    jd_mod.SMALL_WORKLOAD_EVALS = 0    # measure the device path itself
+    try:
+        for B in (64, 256, 1024, 4096):
+            batch = reviews[:B]
+            jd.query_review_batch(TARGET_NAME, batch)       # compile warm
+            reps = 2 if B >= 1024 else 4
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                jd.query_review_batch(TARGET_NAME, batch)
+            rps = B * reps / (time.perf_counter() - t0)
+            out["batched"][str(B)] = round(rps, 1)
+            log(f"[admission-device-batch] B={B}: {rps:.0f} reviews/s "
+                f"(scalar single-thread {scalar_rps:.0f}/s)")
+            if crossover is None and rps > scalar_rps:
+                crossover = B
+    finally:
+        jd_mod.SMALL_WORKLOAD_EVALS = saved
+    out["crossover_batch"] = crossover
+    log(f"[admission-device-batch] crossover batch size: {crossover}")
+    detail["admission_device_batch"] = out
+
+
+def bench_regex_high_cardinality(detail):
+    """Regex table build at exploding unique-string cardinality: the
+    per-unique host re.search loop vs the batched byte-DFA engine
+    (ops/regex_dfa, numpy and device twins) — records where each route
+    wins (round-3 VERDICT #10)."""
+    from gatekeeper_tpu.ir.lower import Lowerer
+    from gatekeeper_tpu.ir.prep import build_bindings
+    from gatekeeper_tpu.ops import regex_dfa
+    from gatekeeper_tpu.rego import parse_module
+    from gatekeeper_tpu.rego.interp import Interpreter
+    from gatekeeper_tpu.store.table import ResourceMeta, ResourceTable
+
+    n = 50_000 if QUICK else 500_000
+    rng = random.Random(17)
+    interp = Interpreter(parse_module(LIBRARY["K8sImageDigests"][0]))
+    lowered = Lowerer(interp.module, interp).lower()
+    table = ResourceTable()
+    hexd = "0123456789abcdef"
+    log(f"[regex-hicard] building {n} unique image strings")
+    for i in range(n):
+        if i % 2:
+            img = f"gcr.io/org/app{i}@sha256:" + "".join(
+                rng.choice(hexd) for _ in range(64))
+        else:
+            img = f"gcr.io/org/app{i}:v{i}"
+        table.upsert(f"d/p{i}", {
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": f"p{i}", "namespace": "d"},
+            "spec": {"containers": [{"name": "c", "image": img}]}},
+            ResourceMeta("v1", "Pod", f"p{i}", "d"))
+    cons = [{"kind": "K8sImageDigests", "metadata": {"name": "digests"},
+             "spec": {"parameters": LIBRARY["K8sImageDigests"][1]}}]
+    big = 1 << 60
+    out = {"n_unique": n}
+    saved = (regex_dfa.TABLE_MIN_UNIQUES, regex_dfa.TABLE_DEVICE_MIN_UNIQUES)
+    try:
+        for mode, t_min, d_min in (("host_re_loop", big, big),
+                                   ("dfa_numpy", 1, big),
+                                   ("dfa_device", 1, 1)):
+            regex_dfa.TABLE_MIN_UNIQUES = t_min
+            regex_dfa.TABLE_DEVICE_MIN_UNIQUES = d_min
+            times = []
+            for _ in range(2):
+                t0 = time.perf_counter()
+                build_bindings(lowered.spec, table, cons)
+                times.append(time.perf_counter() - t0)
+            out[mode + "_seconds"] = round(min(times), 3)
+            log(f"[regex-hicard] {mode}: {min(times):.3f}s "
+                f"(bindings build incl. table)")
+    finally:
+        regex_dfa.TABLE_MIN_UNIQUES, \
+            regex_dfa.TABLE_DEVICE_MIN_UNIQUES = saved
+    detail["regex_high_cardinality"] = out
+
+
 def bench_admission_replay(detail):
     """AdmissionReview stream through the webhook ValidationHandler with
     micro-batching (BASELINE.md final config)."""
@@ -423,6 +600,9 @@ def bench_admission_replay(detail):
         "n_reviews": n_reviews, "p50_ms": round(p50 * 1e3, 3),
         "p99_ms": round(p99 * 1e3, 3), "reviews_per_sec": round(rps, 1)}
 
+    # honest tail latency: fixed-rate (open-loop) replay
+    bench_admission_open_loop(detail, handler, reqs)
+
     # replicated serving: N engine-worker processes behind a ReplicaPool
     # (the reference's webhook-pod-replica model on one host) — scalar
     # admission evaluation escapes the GIL.  Pointless without cores to
@@ -476,14 +656,21 @@ def bench_admission_replay(detail):
 
 
 def main():
+    from gatekeeper_tpu.engine.veval import quiesce_upgrades
     detail: dict = {}
     value, vs = bench_north_star(detail)
+    quiesce_upgrades()
     bench_demo_basic(detail)
     bench_allowed_repos(detail)
+    quiesce_upgrades()
     bench_library(detail)
+    quiesce_upgrades()
     bench_regex_heavy(detail)
     bench_selector_heavy(detail)
+    bench_regex_high_cardinality(detail)
+    quiesce_upgrades()
     bench_admission_replay(detail)
+    bench_admission_device_batch(detail)
     print(json.dumps({"metric": "audit_constraint_evals_per_sec",
                       "value": round(value, 1), "unit": "evals/s",
                       "vs_baseline": round(vs, 2),

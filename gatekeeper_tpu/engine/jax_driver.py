@@ -97,6 +97,18 @@ fixed ~100ms through a tunneled accelerator, which only amortizes over
 enough work.  The scalar path produces identical results (it is the
 oracle), so routing is purely a latency decision."""
 
+MIRROR_EAGER_MIN_ROWS = 50_000
+"""Batch ingests at/above this row count eagerly materialize the
+columnar mirror (element axes + per-kind bindings) and kick background
+executable prewarms — the first audit after a restart then spends its
+wall on dispatch + fetch, not on prep the store could have done at
+write time (deliberately NOT test-overridable via
+SMALL_WORKLOAD_EVALS: tiny test ingests must stay cheap)."""
+
+DEFAULT_PREWARM_CAP = 20
+"""Cap assumed for prewarmed audit executables — the audit manager's
+per-constraint violation cap (reference pkg/audit/manager.go:35)."""
+
 
 class JaxTargetState(TargetState):
     def __init__(self):
@@ -308,6 +320,130 @@ class JaxDriver(LocalDriver):
         st.bindings_cache[kind] = (key, bindings)
         return bindings
 
+    def _ensure_order(self, st):
+        """Sorted-cache-key row order (matches the scalar driver) with
+        its key_generation-keyed cache; pure updates never re-sort."""
+        kgen = st.table.key_generation
+        if st.order_cache is not None and st.order_cache[0] == kgen:
+            _, ordered_rows, row_order = st.order_cache
+            return ordered_rows, row_order
+        items = list(st.table.rows_items())
+        if len(items) > 65536:
+            # numpy lexicographic sort of the key strings: ~4s of
+            # Python tuple-sort at 1M rows becomes ~0.5s
+            keys = np.array([k for k, _ in items])
+            rows_arr = np.fromiter((r for _, r in items),
+                                   dtype=np.int64, count=len(items))
+            order = np.argsort(keys, kind="stable")
+            ordered_np = rows_arr[order]
+            ordered_rows = ordered_np.tolist()
+            row_order = _RowOrder(ordered_np)
+        else:
+            ordered_rows = [row for _, row in sorted(items)]
+            row_order = {row: i for i, row in enumerate(ordered_rows)}
+        st.order_cache = (kgen, ordered_rows, row_order)
+        return ordered_rows, row_order
+
+    def _prefetch_axes(self, st) -> None:
+        """Union-prefetch the element-axis extractions: kinds sharing
+        an axis (spec.containers for most of the library) pay ONE
+        full-table walk, not one per kind — per-kind build_bindings
+        then slices the table's superset cache."""
+        axis_union: dict[tuple, set] = {}
+        for kind in st.templates:
+            lowered = st.templates[kind].vectorized
+            if lowered is None or not self._kind_constraints(st, kind):
+                continue
+            abase = dict(lowered.spec.axes)
+            for axis, base in lowered.spec.axes:
+                axis_union.setdefault(base, set())
+            for ec in lowered.spec.e_cols:
+                axis_union[abase[ec.axis]].add((ec.rel, ec.mode))
+        for base, rels in axis_union.items():
+            # only when nothing is cached yet (first build): on churn,
+            # delta sweeps never need a full re-extraction (the dirty
+            # rows re-extract inside update_bindings), and a full
+            # rebuild's first elem_arrays call re-walks the union once
+            # by itself (prefetch_elem_arrays carries coverage)
+            if base not in st.table._elem_cache:
+                st.table.prefetch_elem_arrays(base, sorted(rels))
+
+    @locked
+    def put_data_batch(self, target: str, entries) -> None:
+        # the parent method is itself @locked and the RW lock is not
+        # reentrant — call its unwrapped body under OUR writer hold
+        LocalDriver.put_data_batch.__wrapped__(self, target, entries)
+        st = self._state(target)
+        # keyed to the BATCH size, not the table size: a steady stream
+        # of small watch batches on a large table must not re-run
+        # mirror prep under the writer lock on every write
+        if isinstance(st, JaxTargetState) \
+                and len(entries) >= MIRROR_EAGER_MIN_ROWS:
+            self._materialize_mirror(st)
+
+    def _materialize_mirror(self, st) -> None:
+        """Eagerly build what the first audit would otherwise build
+        lazily: the shared element-axis extraction and each kind's
+        bindings (a columnar store maintains its mirror on write — the
+        reference's informer caches do the same on the watch path).
+        Executable compiles/reloads are then kicked on a background
+        thread: they release the GIL (compile-service RPC / tunnel
+        executable load), so by the time the first sweep dispatches,
+        its executables are compiled or in flight."""
+        import time as _time
+        _t0 = _time.perf_counter()
+        self._prefetch_axes(st)
+        warm: list[tuple] = []
+        with self._prep_lock:
+            for kind in sorted(st.templates):
+                compiled = st.templates[kind]
+                cons = self._kind_constraints(st, kind)
+                if compiled.vectorized is None or not cons:
+                    continue
+                if st.table.n_rows * len(cons) < SMALL_WORKLOAD_EVALS:
+                    continue
+                bindings = self._kind_bindings(st, kind, compiled, cons)
+                warm.append((compiled.vectorized.program, bindings))
+        # the sorted row order + rank gate are table-derived too
+        _, row_order = self._ensure_order(st)
+        self._row_rank(st, row_order)
+        self.metrics.timer("mirror_materialize").observe(
+            _time.perf_counter() - _t0)
+        if warm and self.executor.mesh is None:
+            from gatekeeper_tpu.engine.veval import ProgramExecutor
+
+            def _warm_one(prog, bindings):
+                if self.executor._shutdown.is_set():
+                    return
+                try:
+                    self.executor.prewarm_audit_exec(
+                        prog, bindings, DEFAULT_PREWARM_CAP)
+                    # upload the binding arrays while the GIL is free —
+                    # the first dispatch then reuses the per-bindings
+                    # device cache instead of paying the tunnel
+                    # transfer inside the sweep
+                    self.executor._arrays(bindings, None, None)
+                except Exception:
+                    pass        # warmup is best-effort
+            # a few worker threads over a shared queue: ONE sequential
+            # warm thread serializes a 40-kind library's compiles in
+            # front of the first audit's single-flight waits (measured
+            # 87s library cold), while one-thread-per-kind thrashes the
+            # GIL with 40 concurrent traces.  Compile requests overlap
+            # ~1.4x through the serialized service; loads more.
+            q = list(warm)
+            qlock = __import__("threading").Lock()
+
+            def _drain_q():
+                while True:
+                    with qlock:
+                        if not q:
+                            return
+                        prog, bindings = q.pop(0)
+                    _warm_one(prog, bindings)
+            for _ in range(min(4, len(warm))):
+                ProgramExecutor.spawn_bg(_drain_q, "ingest-prewarm")
+
     def _install_gates(self, st, kind: str, bindings,
                        mask: np.ndarray | None,
                        mask_delta: tuple | None,
@@ -380,161 +516,164 @@ class JaxDriver(LocalDriver):
         # both drivers return identical result lists; the 1M-row sort +
         # index dict are keyed on key_generation — pure updates (the
         # dominant churn in a live cluster) never re-sort
-        kgen = st.table.key_generation
-        if st.order_cache is not None and st.order_cache[0] == kgen:
-            _, ordered_rows, row_order = st.order_cache
-        else:
-            items = list(st.table.rows_items())
-            if len(items) > 65536:
-                # numpy lexicographic sort of the key strings: ~4s of
-                # Python tuple-sort at 1M rows becomes ~0.5s
-                keys = np.array([k for k, _ in items])
-                rows_arr = np.fromiter((r for _, r in items),
-                                       dtype=np.int64, count=len(items))
-                order = np.argsort(keys, kind="stable")
-                ordered_np = rows_arr[order]
-                ordered_rows = ordered_np.tolist()
-                row_order = _RowOrder(ordered_np)
-            else:
-                ordered_rows = [row for _, row in sorted(items)]
-                row_order = {row: i for i, row in enumerate(ordered_rows)}
-            st.order_cache = (kgen, ordered_rows, row_order)
-        rank = self._row_rank(st, row_order)
-
-        # phase 1: dispatch every kind's device evaluation without
-        # blocking — one packed-fetch round-trip per kind, all in
-        # flight at once (run_topk_async; the tunnel latency of fetch
-        # N overlaps the execution of fetch N+1).  Dispatches run on a
-        # thread pool so first-time jit traces / XLA compiles of
-        # different kinds overlap (a 30-template library would
-        # otherwise pay its compiles serially on a cold start).
-        def dispatch(spec):
-            mode, _, _, _, prog, bindings, mask = spec
-            # match/rank gates ride bindings.arrays (_install_gates)
-            if mode == "topk":
-                return self.executor.run_topk_async(prog, bindings, limit)
-            if mode == "mask":
-                return self.executor.run_async(prog, bindings)
-            return None
-
-        # prep + dispatch interleaved: each kind's device step is
-        # submitted the moment its bindings are ready, so kind N's
-        # device execution (and any cold compile, on the pool) overlaps
-        # kind N+1's host prep — on churned sweeps the host delta work
-        # hides most of the device time instead of serializing before it
-        import concurrent.futures
-        pool = concurrent.futures.ThreadPoolExecutor(max_workers=8)
-        specs: list[tuple] = []
-        futures: list = []
-        if limit is not None and self.executor.mesh is None:
-            # the shared top-k reduce executable's shape bucket is known
-            # before any prep — compile it concurrently with host prep
-            # (its XLA compile is the longest pole of a cold audit)
-            from gatekeeper_tpu.ir.prep import audit_pads
-            n_rows = st.table.n_rows
-            pads = set()
-            for kind in st.templates:
-                n_con = len(st.constraints.get(kind, {}))
-                if not n_con or n_rows * n_con < SMALL_WORKLOAD_EVALS:
-                    continue
-                pads.add(audit_pads(n_rows, n_con))
-            # dedupe by bucket: kinds overwhelmingly share one shape,
-            # and duplicate submissions would park pool workers on the
-            # single-flight wait, starving the dispatch futures
-            for r_pad, c_pad in pads:
-                pool.submit(self.executor.prewarm_reduce, limit, c_pad,
-                            r_pad)
-        try:
-            with self._prep_lock:
-                for kind in sorted(st.templates):
-                    compiled = st.templates[kind]
-                    constraints = self._kind_constraints(st, kind)
-                    if not constraints:
-                        continue
-                    mask, mask_dirty, padded = self._kind_mask(
-                        st, target, kind, constraints)
-                    small = len(ordered_rows) * len(constraints) \
-                        < SMALL_WORKLOAD_EVALS
-                    if compiled.vectorized is not None and mask is not None \
-                            and not small:
-                        bindings = self._kind_bindings(st, kind, compiled,
-                                                       constraints)
-                        if bindings.f32_unsafe:
-                            # some bound numeric value does not survive a
-                            # float32 round-trip (|v| past 2^24): device
-                            # ordering compares could silently mis-order,
-                            # so this kind runs on the scalar oracle
-                            # (ir/lower.py "known deviations" guard)
-                            self.metrics.counter(
-                                "f32_unsafe_scalar_fallbacks").inc()
-                            spec = ("scalar", kind, compiled, constraints,
-                                    None, None, mask)
-                            futures.append(None)
-                            specs.append(spec)
-                            continue
-                        self._install_gates(st, kind, bindings, mask,
-                                            mask_dirty, rank, padded)
-                        prog = compiled.vectorized.program
-                        mode = "topk" if limit is not None else "mask"
-                        spec = (mode, kind, compiled, constraints, prog,
-                                bindings, mask)
-                        futures.append(pool.submit(dispatch, spec))
-                    else:
-                        # unlowerable template — or a workload too small
-                        # to amortize a device dispatch round-trip
-                        spec = ("scalar", kind, compiled, constraints, None,
-                                None, mask)
-                        futures.append(None)
-                    specs.append(spec)
-            handles = [f.result() if f is not None else None for f in futures]
-        finally:
-            pool.shutdown(wait=False)
-        plans = [sp + (h,) for sp, h in zip(specs, handles)]
-
-        # phase 2: host formatting per kind.  One (review, frozen)
-        # per violating row for the whole sweep — rows recur across
-        # kinds/constraints, and freeze() is a deep walk
-        rcache: dict[int, tuple] = {}
-        tagged: list[tuple[tuple, Result]] = []
-        for mode, kind, compiled, constraints, prog, bindings, mask, handle in plans:
-            if mode == "topk":
-                self._format_topk(st, target, handler, compiled, constraints,
-                                  prog, bindings, mask, rank, row_order,
-                                  kind, limit, trace, tagged, handle, rcache)
-            elif mode == "mask":
-                self._format_pairs(st, target, handler, compiled, constraints,
-                                   handle.get(), row_order, kind, limit, trace,
-                                   tagged, rcache)
-            else:
-                self._scalar_kind(st, target, handler, compiled, constraints,
-                                  mask, ordered_rows, row_order, kind, limit,
-                                  trace, tagged, rcache)
-        tagged.sort(key=lambda kv: kv[0])
-        # warm the churn-delta executables in the background: the first
-        # sweep after data churn otherwise pays one serialized XLA
-        # compile per kind (multiple seconds) right on the sweep
-        if limit is not None and self.executor.mesh is None:
-            warm = [(sp[4], sp[5]) for sp in specs if sp[0] == "topk"]
-            if warm and not self._delta_warmed:
-                self._delta_warmed = True
-
-                def _warm(items=warm):
-                    for prog, bindings in items:
-                        if self.executor._shutdown.is_set():
-                            return
-                        try:
-                            self.executor.prewarm_deltas(prog, bindings)
-                        except Exception:
-                            pass    # warmup is best-effort
-                # spawn_bg (not a bare daemon thread): a compile in
-                # flight at interpreter teardown aborts the process
-                self.executor.spawn_bg(_warm, "delta-warmup")
         m = self.metrics
-        m.counter("audit_sweeps").inc()
-        m.counter("audit_results").inc(len(tagged))
-        m.timer("audit_sweep_wall").observe(_time.perf_counter() - _t0)
-        m.gauge("audit_resources").set(len(ordered_rows))
-        return [r for _, r in tagged], ("\n".join(trace) if trace is not None else None)
+        _tphase = _time.perf_counter()
+
+        def _phase(name):
+            # wall-clock audit phase timers: order/prep+dispatch-submit,
+            # handle-resolve (device upload+exec+compile wait), format
+            nonlocal _tphase
+            now = _time.perf_counter()
+            m.timer(name).observe(now - _tphase)
+            _tphase = now
+
+        ordered_rows, row_order = self._ensure_order(st)
+        rank = self._row_rank(st, row_order)
+        self.executor.sweep_active.set()
+        try:
+
+            # phase 1: dispatch every kind's device evaluation without
+            # blocking — one packed-fetch round-trip per kind, all in
+            # flight at once (run_topk_async; the tunnel latency of fetch
+            # N overlaps the execution of fetch N+1).  Dispatches run on a
+            # thread pool so first-time jit traces / XLA compiles of
+            # different kinds overlap (a 30-template library would
+            # otherwise pay its compiles serially on a cold start).
+            def dispatch(spec):
+                mode, _, _, _, prog, bindings, mask = spec
+                # match/rank gates ride bindings.arrays (_install_gates)
+                if mode == "topk":
+                    return self.executor.run_topk_async(prog, bindings, limit)
+                if mode == "mask":
+                    return self.executor.run_async(prog, bindings)
+                return None
+
+            # prep + dispatch interleaved: each kind's device step is
+            # submitted the moment its bindings are ready, so kind N's
+            # device execution (and any cold compile, on the pool) overlaps
+            # kind N+1's host prep — on churned sweeps the host delta work
+            # hides most of the device time instead of serializing before it
+            import concurrent.futures
+            pool = concurrent.futures.ThreadPoolExecutor(max_workers=8)
+            specs: list[tuple] = []
+            futures: list = []
+            if limit is not None and self.executor.mesh is None:
+                # the shared top-k reduce executable's shape bucket is known
+                # before any prep — compile it concurrently with host prep
+                # (its XLA compile is the longest pole of a cold audit)
+                from gatekeeper_tpu.ir.prep import audit_pads
+                n_rows = st.table.n_rows
+                pads = set()
+                for kind in st.templates:
+                    n_con = len(st.constraints.get(kind, {}))
+                    if not n_con or n_rows * n_con < SMALL_WORKLOAD_EVALS:
+                        continue
+                    pads.add(audit_pads(n_rows, n_con))
+                # dedupe by bucket: kinds overwhelmingly share one shape,
+                # and duplicate submissions would park pool workers on the
+                # single-flight wait, starving the dispatch futures
+                for r_pad, c_pad in pads:
+                    pool.submit(self.executor.prewarm_reduce, limit, c_pad,
+                                r_pad)
+            try:
+                with self._prep_lock:
+                    self._prefetch_axes(st)
+                    for kind in sorted(st.templates):
+                        compiled = st.templates[kind]
+                        constraints = self._kind_constraints(st, kind)
+                        if not constraints:
+                            continue
+                        mask, mask_dirty, padded = self._kind_mask(
+                            st, target, kind, constraints)
+                        small = len(ordered_rows) * len(constraints) \
+                            < SMALL_WORKLOAD_EVALS
+                        if compiled.vectorized is not None and mask is not None \
+                                and not small:
+                            bindings = self._kind_bindings(st, kind, compiled,
+                                                           constraints)
+                            if bindings.f32_unsafe:
+                                # some bound numeric value does not survive a
+                                # float32 round-trip (|v| past 2^24): device
+                                # ordering compares could silently mis-order,
+                                # so this kind runs on the scalar oracle
+                                # (ir/lower.py "known deviations" guard)
+                                self.metrics.counter(
+                                    "f32_unsafe_scalar_fallbacks").inc()
+                                spec = ("scalar", kind, compiled, constraints,
+                                        None, None, mask)
+                                futures.append(None)
+                                specs.append(spec)
+                                continue
+                            self._install_gates(st, kind, bindings, mask,
+                                                mask_dirty, rank, padded)
+                            prog = compiled.vectorized.program
+                            mode = "topk" if limit is not None else "mask"
+                            spec = (mode, kind, compiled, constraints, prog,
+                                    bindings, mask)
+                            futures.append(pool.submit(dispatch, spec))
+                        else:
+                            # unlowerable template — or a workload too small
+                            # to amortize a device dispatch round-trip
+                            spec = ("scalar", kind, compiled, constraints, None,
+                                    None, mask)
+                            futures.append(None)
+                        specs.append(spec)
+                _phase("audit_prep_submit")
+                handles = [f.result() if f is not None else None for f in futures]
+                _phase("audit_dispatch_wait")
+            finally:
+                pool.shutdown(wait=False)
+            plans = [sp + (h,) for sp, h in zip(specs, handles)]
+
+            # phase 2: host formatting per kind.  One (review, frozen)
+            # per violating row for the whole sweep — rows recur across
+            # kinds/constraints, and freeze() is a deep walk
+            rcache: dict[int, tuple] = {}
+            tagged: list[tuple[tuple, Result]] = []
+            for mode, kind, compiled, constraints, prog, bindings, mask, handle in plans:
+                if mode == "topk":
+                    self._format_topk(st, target, handler, compiled, constraints,
+                                      prog, bindings, mask, rank, row_order,
+                                      kind, limit, trace, tagged, handle, rcache)
+                elif mode == "mask":
+                    self._format_pairs(st, target, handler, compiled, constraints,
+                                       handle.get(), row_order, kind, limit, trace,
+                                       tagged, rcache)
+                else:
+                    self._scalar_kind(st, target, handler, compiled, constraints,
+                                      mask, ordered_rows, row_order, kind, limit,
+                                      trace, tagged, rcache)
+            _phase("audit_format")
+            tagged.sort(key=lambda kv: kv[0])
+            # warm the churn-delta executables in the background: the first
+            # sweep after data churn otherwise pays one serialized XLA
+            # compile per kind (multiple seconds) right on the sweep
+            if limit is not None and self.executor.mesh is None:
+                warm = [(sp[4], sp[5]) for sp in specs if sp[0] == "topk"]
+                if warm and not self._delta_warmed:
+                    self._delta_warmed = True
+
+                    def _warm(items=warm):
+                        for prog, bindings in items:
+                            if self.executor._shutdown.is_set():
+                                return
+                            try:
+                                self.executor.prewarm_deltas(prog, bindings)
+                            except Exception:
+                                pass    # warmup is best-effort
+                    # spawn_bg (not a bare daemon thread): a compile in
+                    # flight at interpreter teardown aborts the process
+                    self.executor.spawn_bg(_warm, "delta-warmup")
+            m = self.metrics
+            m.counter("audit_sweeps").inc()
+            m.counter("audit_results").inc(len(tagged))
+            m.timer("audit_sweep_wall").observe(_time.perf_counter() - _t0)
+            m.gauge("audit_resources").set(len(ordered_rows))
+            return [r for _, r in tagged], ("\n".join(trace) if trace is not None else None)
+        finally:
+            # ALWAYS cleared — a dispatch error leaving this set
+            # would defer background upgrades forever
+            self.executor.sweep_active.clear()
 
     @locked_read
     def query_review_batch(self, target: str, reviews: list[dict],

@@ -57,6 +57,29 @@ _bg_drain_registered = False
 # fetch overlap is the tunnel optimization).
 _COLLECTIVE_EXEC_LOCK = __import__("threading").Lock()
 
+_EXECUTORS = __import__("weakref").WeakSet()
+
+
+def quiesce_upgrades(timeout: float = 120.0) -> bool:
+    """Block until every live executor's upgrade queue and in-flight
+    compiles drain (or timeout).  Benchmarks call this between
+    configs so one phase's background recompiles never contaminate the
+    next phase's measurement on a small host."""
+    import time as _time
+    deadline = _time.monotonic() + timeout
+    while _time.monotonic() < deadline:
+        busy = False
+        for ex in list(_EXECUTORS):
+            with ex._lock:
+                if ex._upgrade_q or ex._compile_inflight \
+                        or getattr(ex, "_upgrade_busy", 0):
+                    busy = True
+                    break
+        if not busy:
+            return True
+        _time.sleep(0.1)
+    return False
+
 
 def _register_bg_drain() -> None:
     global _bg_drain_registered
@@ -83,11 +106,14 @@ class _LazyTwoTier:
     Retraces per distinct input signature like jax.jit would (narrow-
     transferred columns may arrive int8/int16/int32)."""
 
-    def __init__(self, executor, raw, fast: bool = True):
+    def __init__(self, executor, raw, fast: bool = True, name=None,
+                 upgrade=True):
         import threading as _threading
         self._ex = executor
         self._raw = raw
         self._fast = fast
+        self._name = name      # stable marker-key base (upgraded-keys)
+        self._upgrade = upgrade
         self._fns: dict[tuple, Any] = {}
         self._lock = _threading.Lock()
         self._inflight: dict[tuple, Any] = {}   # sig -> Event
@@ -115,7 +141,11 @@ class _LazyTwoTier:
                 self._fns[_sig] = full
 
             if self._fast:
-                fn = self._ex._compile_two_tier(lowered, install)
+                fn = self._ex._compile_two_tier(
+                    lowered, install,
+                    marker_key=(self._name, sig)
+                    if self._name is not None else None,
+                    upgrade=self._upgrade)
             else:
                 fn = lowered.compile()
             with self._lock:
@@ -624,6 +654,11 @@ class ProgramExecutor:
         # see _COLLECTIVE_EXEC_LOCK below — per-process, because the
         # hazard is per device set, not per executor instance
         self._collective_lock = _COLLECTIVE_EXEC_LOCK
+        _EXECUTORS.add(self)
+        # set by the driver around a sweep: background upgrade compiles
+        # defer while a sweep is in flight (GIL-bound retraces would
+        # slow the sweep's host phases)
+        self.sweep_active = __import__("threading").Event()
         self._compile_inflight: dict[tuple, Any] = {}  # key -> Event
         self.compiles = 0      # executable-cache misses (trace+compile)
         self.cache_hits = 0    # executable-cache hits
@@ -651,7 +686,10 @@ class ProgramExecutor:
     # flurry for the (serialized) compile service.
 
     FAST_OPTS = {"exec_time_optimization_effort": -1.0}
-    UPGRADE_DELAY_S = 15.0
+    UPGRADE_DELAY_S = 3.0   # quiesce horizon after a cold flurry —
+    #                         short, so upgrades land between sweeps
+    #                         instead of smearing into later work
+    #                         (sweep_active gates them off live sweeps)
     _shutdown = __import__("threading").Event()
 
     @staticmethod
@@ -673,21 +711,45 @@ class ProgramExecutor:
         t.start()
         return t
 
-    def _compile_two_tier(self, lowered, install):
+    def _compile_two_tier(self, lowered, install, marker_key=None,
+                           upgrade=True):
         """Compile `lowered` fast; schedule the full-effort twin and
         hand it to `install(full_fn)` when ready.  Falls back to a
         single default-effort compile when the option is unsupported
-        (non-TPU backends) or fast compilation fails."""
+        (non-TPU backends) or fast compilation fails.
+
+        When a previous process already upgraded this executable (the
+        persistent cache holds the full-effort twin — recorded in the
+        upgraded-keys marker), compile at full effort directly: the
+        restart then pays ONE cache load instead of a fast-tier load
+        PLUS a background recompile that steals GIL/compile-service
+        time from the first sweeps."""
         import os
         import time as _time
+        from gatekeeper_tpu.utils.compile_cache import is_upgraded, key_hash
         if os.environ.get("GATEKEEPER_NO_FAST_COMPILE") == "1":
             return lowered.compile()
+        h = key_hash(marker_key) if marker_key is not None else None
+        if upgrade and h is not None and is_upgraded(h):
+            try:
+                return lowered.compile()
+            except Exception:
+                pass          # fall through to the two-tier path
         try:
             fast = lowered.compile(compiler_options=dict(self.FAST_OPTS))
         except Exception:
             return lowered.compile()
+        if not upgrade:
+            # fast-FINAL: gather/compare/reduce mask programs compile
+            # ~4x faster at exec_time_optimization_effort=-1 with
+            # near-identical generated code (measured round 3) — the
+            # full-effort twin buys nothing, and the background
+            # recompile it would queue steals GIL/compile-service time
+            # from live sweeps.  Only scan/top_k-bearing executables
+            # (the shared reduce, sharded top-k twins) need full effort.
+            return fast
         with self._lock:
-            self._upgrade_q.append((_time.perf_counter(), lowered, install))
+            self._upgrade_q.append((_time.perf_counter(), lowered, install, h))
             if self._upgrade_thread is None or \
                     not self._upgrade_thread.is_alive():
                 self._upgrade_thread = self.spawn_bg(
@@ -696,6 +758,7 @@ class ProgramExecutor:
 
     def _upgrade_loop(self):
         import time as _time
+        from gatekeeper_tpu.utils.compile_cache import mark_upgraded
         while not self._shutdown.is_set():
             with self._lock:
                 if not self._upgrade_q:
@@ -704,21 +767,32 @@ class ProgramExecutor:
                 # quiesce-based deferral: wait until the whole cold
                 # flurry stopped enqueueing, so upgrades never compete
                 # with first-serve compiles for the serialized service
-                newest = max(t for t, _, _ in self._upgrade_q)
-                t_enq, lowered, install = self._upgrade_q[0]
+                newest = max(t for t, _, _, _ in self._upgrade_q)
+                t_enq, lowered, install, h = self._upgrade_q[0]
             wait = newest + self.UPGRADE_DELAY_S - _time.perf_counter()
-            if wait > 0:
-                if self._shutdown.wait(min(wait, 1.0)):
+            if wait > 0 or self.sweep_active.is_set():
+                # never trace/compile under a live sweep — the jit
+                # retrace is GIL-bound and measurably slows the sweep's
+                # host phases on small hosts
+                if self._shutdown.wait(min(max(wait, 0.2), 1.0)):
                     return
                 continue
             with self._lock:
                 self._upgrade_q.pop(0)
+                # visible to quiesce_upgrades: the compile below runs
+                # outside the lock and must still count as in-flight
+                self._upgrade_busy = getattr(self, "_upgrade_busy", 0) + 1
             try:
                 full = lowered.compile()
                 install(full)
                 self.upgrades += 1
+                if h is not None:
+                    mark_upgraded(h)
             except Exception:
                 pass   # the fast executable stays in service
+            finally:
+                with self._lock:
+                    self._upgrade_busy -= 1
         with self._lock:
             self._upgrade_thread = None
 
@@ -976,7 +1050,8 @@ class ProgramExecutor:
             with self._lock:
                 self._cache[_key] = full
 
-        fn = self._compile_two_tier(lowered, install)
+        fn = self._compile_two_tier(lowered, install, marker_key=key,
+                                     upgrade=(sharded or topk is not None))
         self.compile_seconds += _time.perf_counter() - _t0
         with self._lock:
             self._cache[key] = fn
@@ -1011,6 +1086,25 @@ class ProgramExecutor:
             if with_rank:
                 ex.append(jax.ShapeDtypeStruct((r_pad,), jnp.int32))
             fn.prewarm(*ex)
+
+    def prewarm_audit_exec(self, program: Program, bindings: Bindings,
+                           k: int | None = None) -> None:
+        """Compile (or reload from the persistent cache) the audit
+        executables for `bindings`' shape bucket ahead of the first
+        sweep — from a background thread at ingest time, so the
+        multi-second compile-service round (or the ~0.5s/executable
+        tunnel reload on a warm cache) overlaps host work instead of
+        serializing inside the first audit."""
+        if self.mesh is not None or self._sharded_for(bindings):
+            return       # collective twins compile on dispatch
+        arrays = dict(bindings.arrays)
+        if k is not None and "__rank__" not in arrays:
+            # the capped audit always installs a rank gate; mirror the
+            # dispatch-time name set or the cache key won't match
+            arrays["__rank__"] = np.empty((bindings.r_pad,), np.int32)
+        self._compiled(program, arrays, None, False)
+        if k is not None:
+            self.prewarm_reduce(k, bindings.c_pad, bindings.r_pad)
 
     def prewarm_deltas(self, program: Program, bindings: Bindings,
                        buckets: tuple = (8, 1 << 10, 1 << 14)) -> None:
@@ -1164,7 +1258,11 @@ class ProgramExecutor:
                         sliced[nm] = jnp.take(a, dirty, axis=ax)
                 sub = _eval_program(program, sliced)      # [C, d_bucket]
                 return viol_old.at[:, dirty].set(sub)
-            fn = _LazyTwoTier(self, raw)
+            # two-tier WITH upgrade: the dirty-row scatter (at[].set)
+            # belongs to the scan/top_k class that executes several
+            # times slower at low optimization effort (churn sweep
+            # 0.58s -> 3.8s measured when left fast-final)
+            fn = _LazyTwoTier(self, raw, name=key)
             self._cache[key] = fn
         return fn
 

@@ -76,6 +76,7 @@ class ResourceTable:
         self.ns_generation = 0               # last change to any ns row
         self._ns_touched = False
         self._col_cache: dict[ColSpec, tuple[int, int, Any]] = {}
+        self._elem_cache: dict[tuple, tuple] = {}   # base -> (gen, counts, cols)
         self._identity_cache: tuple[int, int, IdentityColumns] | None = None
         self._ns_items_cache: tuple[int, dict] | None = None
 
@@ -164,6 +165,7 @@ class ResourceTable:
         self._free.clear()
         self._ns_rows.clear()
         self._col_cache.clear()
+        self._elem_cache.clear()
         self._identity_cache = None
         self._ns_items_cache = None
         self.generation += 1
@@ -222,6 +224,40 @@ class ResourceTable:
 
     # ------------------------------------------------------------------
     # columns
+
+    def elem_arrays(self, base: tuple, rels: list):
+        """Element-axis CSR columns for `rels` under `base`, served
+        from a per-(base, generation) superset cache.  Every template
+        kind sharing an axis (spec.containers for most of the library)
+        otherwise pays its own full-table extraction walk per audit —
+        the single biggest host cost of a cold/restart prep at 1M rows.
+        `prefetch_elem_arrays` extracts the union once; per-kind calls
+        then slice the cached superset."""
+        hit = self._elem_cache.get(base)
+        if hit is not None and hit[0] == self.generation:
+            cols = hit[2]
+            if all(rm in cols for rm in rels):
+                return hit[1], {rm: cols[rm] for rm in rels}
+        return self.prefetch_elem_arrays(base, rels)
+
+    def prefetch_elem_arrays(self, base: tuple, rels) -> tuple:
+        """Extract (and cache) `rels` — plus anything already cached
+        for this base — in ONE pass over the table."""
+        from gatekeeper_tpu.ir.prep import build_elem_arrays
+        want = set(rels)
+        hit = self._elem_cache.get(base)
+        if hit is not None:
+            if hit[0] == self.generation and want <= set(hit[2]):
+                return hit[1], {rm: hit[2][rm] for rm in rels}
+            # carry coverage even across generations: after churn, the
+            # FIRST rebuild call must re-walk the whole union once so
+            # sibling kinds hit the refreshed superset instead of each
+            # paying their own full-table walk
+            want |= set(hit[2])
+        counts, cols = build_elem_arrays(self._objs, base, sorted(want),
+                                         self.interner)
+        self._elem_cache[base] = (self.generation, counts, cols)
+        return counts, {rm: cols[rm] for rm in rels}
 
     def column(self, spec: ColSpec):
         hit = self._col_cache.get(spec)

@@ -137,6 +137,58 @@ def enable_persistent_cache(path: str | None = None) -> str:
         return path
 
 
+_upgraded_keys: set | None = None
+
+
+def _marker_path() -> str | None:
+    import jax
+    d = getattr(jax.config, "jax_compilation_cache_dir", None)
+    return os.path.join(d, "upgraded_keys.txt") if d else None
+
+
+def key_hash(obj) -> str:
+    """Stable cross-process hash of an executable cache key (nested
+    tuples of primitives — repr is deterministic)."""
+    return hashlib.sha256(repr(obj).encode()).hexdigest()[:16]
+
+
+def is_upgraded(h: str) -> bool:
+    """Was the full-effort twin of this executable ever compiled and
+    persisted?  If yes, a restart compiles at full effort directly (a
+    persistent-cache load) instead of paying the fast tier AND a
+    background upgrade recompile."""
+    global _upgraded_keys
+    with _lock:
+        if _upgraded_keys is None:
+            _upgraded_keys = set()
+            p = _marker_path()
+            if p and os.path.exists(p):
+                try:
+                    with open(p) as f:
+                        _upgraded_keys = {ln.strip() for ln in f if ln.strip()}
+                except OSError:
+                    pass
+        return h in _upgraded_keys
+
+
+def mark_upgraded(h: str) -> None:
+    global _upgraded_keys
+    with _lock:
+        if _upgraded_keys is None:
+            _upgraded_keys = set()
+        if h in _upgraded_keys:
+            return
+        _upgraded_keys.add(h)
+        p = _marker_path()
+        if p:
+            try:
+                os.makedirs(os.path.dirname(p), exist_ok=True)
+                with open(p, "a") as f:
+                    f.write(h + "\n")
+            except OSError:
+                pass
+
+
 class PersistentCacheStats:
     """Process-wide persistent-cache hit/miss counters, fed by JAX's
     monitoring events.  `restart_first_audit` claims are only credible
