@@ -827,7 +827,21 @@ class Lowerer:
                 return v
             return None
 
-        self.tables.append(TableReq(tname, src, fn, out=out, src_val=True))
+        # pure re_match(<const>, leaf): mark the pattern so prep can
+        # route high-cardinality builds through the batched DFA engine
+        # (ops/regex_dfa) instead of one Python re.search per distinct
+        # string (topdown/regex.go semantics either way)
+        regex = None
+        if out == "bool" and isinstance(term, Call) \
+                and term.name in (("re_match",), ("regex", "match")) \
+                and len(term.args) == 2 \
+                and isinstance(term.args[0], Scalar) \
+                and isinstance(term.args[0].value, str) \
+                and isinstance(term.args[1], Var) \
+                and term.args[1].name == "__leaf0__":
+            regex = term.args[0].value
+        self.tables.append(TableReq(tname, src, fn, out=out, src_val=True,
+                                    regex=regex))
         idx = self._emit_leaf(sym.leaf, "val")
         return self._emit("table", (idx,), (tname,))
 

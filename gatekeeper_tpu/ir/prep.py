@@ -148,6 +148,11 @@ class TableReq:
     marks the encoded-value namespace, decoded before fn).  fn maps the
     python value -> output; exceptions / UNDEFINED -> undefined.
     out: 'bool' | 'num' | 'id_str' | 'id_val'.
+
+    regex: set when fn is exactly re_match(<const pattern>, value) —
+    at high unique-value cardinality the build routes through the
+    batched DFA engine (ops/regex_dfa) instead of one Python
+    re.search per distinct string.
     """
 
     name: str
@@ -155,6 +160,7 @@ class TableReq:
     fn: Callable[[Any], Any] = dataclasses.field(compare=False, hash=False)
     out: str = "bool"
     src_val: bool = False
+    regex: str | None = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -417,6 +423,40 @@ def _f32_exact(a) -> bool:
         return bool(np.all(np.isnan(a) | (a == rt)))
 
 
+def _regex_table_batch(tr, uids: list, interner, ok, vals) -> bool:
+    """Batched DFA route for pure-regex bool tables at high unique
+    cardinality (ops/regex_dfa): one vectorized transition gather per
+    character position instead of one Python re.search per distinct
+    string.  Returns False (caller keeps the per-value host loop) when
+    the table is not a regex, is small, or the pattern/input falls
+    outside the DFA subset — results are bit-identical either way."""
+    if tr.regex is None or tr.out != "bool":
+        return False
+    from gatekeeper_tpu.ops import regex_dfa
+    if len(uids) < regex_dfa.TABLE_MIN_UNIQUES:
+        return False
+    dfa = regex_dfa.cached_dfa(tr.regex)
+    if dfa is None:
+        return False
+    str_uids, strs = [], []
+    for uid in uids:
+        key = interner.string(uid)
+        arg = decode_value(key) if tr.src_val else key
+        if isinstance(arg, str):
+            str_uids.append(uid)
+            strs.append(arg)
+    if not strs:
+        return True              # no string values: all undefined
+    matched = regex_dfa.match_strings(
+        dfa, strs, device=len(strs) >= regex_dfa.TABLE_DEVICE_MIN_UNIQUES)
+    idx = np.asarray(str_uids, dtype=np.int64)
+    # the bool-table host fn returns True or None (never False):
+    # `ok` encodes defined AND truthy — mirror that exactly
+    ok[idx] = matched
+    vals[idx] = matched
+    return True
+
+
 def _eval_host(fn, *args):
     """Host table/cval evaluation: exceptions and UNDEFINED -> None."""
     from gatekeeper_tpu.rego.builtins import UNDEFINED, BuiltinError
@@ -534,7 +574,9 @@ def build_bindings(spec: PrepSpec, table: ResourceTable,
     for axis, base in spec.axes:
         ecs = axis_cols.get(axis, [])
         rels = sorted({(ec.rel, ec.mode) for ec in ecs})
-        counts, cols = build_elem_arrays(objs, base, rels, interner)
+        # served from the table's per-(base, generation) superset cache
+        # — kinds sharing an axis share ONE extraction walk
+        counts, cols = table.elem_arrays(base, rels)
         state["elem_counts"][axis] = counts
         e_max = int(counts.max()) if n else 0
         e_pad = bucket(max(e_max, 1), minimum=2)
@@ -630,6 +672,11 @@ def build_bindings(spec: PrepSpec, table: ResourceTable,
             vals = np.full((t_pad,), MISSING, dtype=np.int32)
         else:
             vals = np.zeros((t_pad,), dtype=bool)
+        if _regex_table_batch(tr, uniq.tolist(), interner, ok, vals):
+            out[tr.name + ".ok"] = ok
+            out[tr.name + ".v"] = vals
+            state["tables"][tr.name] = set(uniq.tolist())
+            continue
         for uid in uniq.tolist():
             key = interner.string(uid)
             arg = decode_value(key) if tr.src_val else key
@@ -1056,6 +1103,9 @@ def update_bindings(spec: PrepSpec, table: ResourceTable,
             ok = out[tr.name + ".ok"] = out[tr.name + ".ok"].copy()
             vals = out[tr.name + ".v"] = out[tr.name + ".v"].copy()
             append_only.update((tr.name + ".ok", tr.name + ".v"))
+            if _regex_table_batch(tr, list(new_ids), interner, ok, vals):
+                state["tables"][tr.name] = evaluated | set(new_ids)
+                continue
             for uid in new_ids:
                 key = interner.string(uid)
                 arg = decode_value(key) if tr.src_val else key

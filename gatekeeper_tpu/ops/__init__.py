@@ -1,0 +1,3 @@
+"""Device/batch kernels: ops.regex_dfa (batched regex -> byte-DFA
+matching, numpy + lax.scan twins) -- the high-cardinality answer for
+regex-heavy templates (SURVEY section 7 hard-part 3)."""
