@@ -47,6 +47,47 @@ def init_distributed(coordinator_address: str | None = None,
                                process_id=process_id)
 
 
+def run_multihost_audit(program, bindings, mesh: Mesh, k: int = 20):
+    """One sharded audit step in a REAL multi-process world: global
+    device arrays are assembled per-process from addressable shards
+    (each process contributes only the slices its devices own — in
+    production each host builds bindings for its own resource slice;
+    here every process holds the full host arrays and the callback
+    reads local indices).  Outputs (sharded over c, replicated over r)
+    are reassembled from addressable shards — with r spanning hosts,
+    every c shard has a replica on every host, so no host needs data it
+    does not own."""
+    from jax.sharding import NamedSharding
+
+    from gatekeeper_tpu.parallel.sharding import (
+        binding_spec, make_sharded_audit_fn, pad_bindings_for_mesh)
+
+    b = pad_bindings_for_mesh(bindings, mesh.shape["c"], mesh.shape["r"])
+    names = tuple(sorted(b.arrays))
+    specs = {nm: binding_spec(nm, b.arrays[nm]) for nm in names}
+    gargs = []
+    for nm in names:
+        arr = b.arrays[nm]
+        sh = NamedSharding(mesh, specs[nm])
+        gargs.append(jax.make_array_from_callback(
+            arr.shape, sh, lambda idx, _a=arr: _a[idx]))
+    fn = make_sharded_audit_fn(program, names, specs, mesh, k, b.r_pad)
+    with mesh:
+        counts, rows, valid = fn(*gargs)
+
+    def collect(garr):
+        out = np.zeros(garr.shape, dtype=garr.dtype)
+        seen = np.zeros(garr.shape, dtype=bool)
+        for s in garr.addressable_shards:
+            out[s.index] = np.asarray(s.data)
+            seen[s.index] = True
+        assert seen.all(), "a shard was not host-addressable"
+        return out
+
+    nc = bindings.n_constraints
+    return (collect(counts)[:nc], collect(rows)[:nc], collect(valid)[:nc])
+
+
 def make_multihost_mesh(c_axis: int = 1, n_hosts: int | None = None) -> Mesh:
     """2-D (c, r) mesh with ``r`` spanning hosts (DCN) and ``c`` kept
     within a host (ICI).  Device order: jax.devices() groups devices by
