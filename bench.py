@@ -137,6 +137,8 @@ def bench_north_star(detail):
     # churn: upsert 1% of rows (label/image edits on existing names),
     # then sweep — delta-maintained columns/bindings/masks must keep the
     # sweep near steady state instead of re-paying full prep
+    from gatekeeper_tpu.engine.veval import quiesce_upgrades
+    quiesce_upgrades()      # cold-flurry upgrades must not bleed in
     churn_rng = random.Random(1234)
     n_churn = max(N // 100, 1)
     churn_times = []
@@ -185,6 +187,7 @@ def bench_north_star(detail):
     jd_old, jd = jd, None
     del jd_old
     gc.collect()
+    quiesce_upgrades()      # measure the restart, not leftover compiles
     jd2 = JaxDriver()
     pc_snap = jd2.executor.persistent_stats.snapshot()
     t0 = time.perf_counter()

@@ -105,6 +105,15 @@ wall on dispatch + fetch, not on prep the store could have done at
 write time (deliberately NOT test-overridable via
 SMALL_WORKLOAD_EVALS: tiny test ingests must stay cheap)."""
 
+REVIEW_BATCH_MIN_EVALS = 200_000
+"""Below this many (review, constraint) pairs, a coalesced admission
+batch stays on the scalar engine.  Measured on the v5e behind the
+~100ms-per-fetch tunnel (bench_admission_device_batch): with 200
+constraints the device batch path only reaches scalar parity around
+batch 1024 (~200k evals) — per-batch prep + the fetch round-trip
+dominate below that.  On co-located TPU the crossover drops sharply;
+re-measure with bench.py when the transport changes."""
+
 DEFAULT_PREWARM_CAP = 20
 """Cap assumed for prewarmed audit executables — the audit manager's
 per-constraint violation cap (reference pkg/audit/manager.go:35)."""
@@ -699,7 +708,7 @@ class JaxDriver(LocalDriver):
         constraints_all = list(st.all_constraints())
         B = len(reviews)
         if tracing or not isinstance(st, JaxTargetState) or not B or \
-                B * len(constraints_all) < SMALL_WORKLOAD_EVALS:
+                B * len(constraints_all) < REVIEW_BATCH_MIN_EVALS:
             return [self.query_review(target, r, opts) for r in reviews]
 
         from gatekeeper_tpu.engine.match import MatchEngine

@@ -159,9 +159,9 @@ class ValidationHandler:
             return self.batch_mode == "always"
         if not hasattr(self.client.driver, "query_review_batch"):
             return False
-        from gatekeeper_tpu.engine.jax_driver import SMALL_WORKLOAD_EVALS
+        from gatekeeper_tpu.engine.jax_driver import REVIEW_BATCH_MIN_EVALS
         n_cons = sum(len(v) for v in self.client.constraints.values())
-        return n_cons * self.batcher.max_batch >= SMALL_WORKLOAD_EVALS
+        return n_cons * self.batcher.max_batch >= REVIEW_BATCH_MIN_EVALS
 
     def _review(self, request: dict):
         """reviewRequest (policy.go:244-277)."""

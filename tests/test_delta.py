@@ -297,6 +297,7 @@ class TestDeviceBatchReview:
         from gatekeeper_tpu.target.k8s import TARGET_NAME, K8sValidationTarget
 
         monkeypatch.setattr(jd_mod, "SMALL_WORKLOAD_EVALS", 1)
+        monkeypatch.setattr(jd_mod, "REVIEW_BATCH_MIN_EVALS", 1)
         rng = random.Random(31)
         jx = Backend(JaxDriver()).new_client([K8sValidationTarget()])
         for t, c in all_docs():
@@ -426,6 +427,7 @@ class TestBatchReviewInventoryGuard:
                                                       template_doc)
         from gatekeeper_tpu.target.k8s import TARGET_NAME, K8sValidationTarget
         monkeypatch.setattr(jd_mod, "SMALL_WORKLOAD_EVALS", 1)
+        monkeypatch.setattr(jd_mod, "REVIEW_BATCH_MIN_EVALS", 1)
         jx = Backend(JaxDriver()).new_client([K8sValidationTarget()])
         jx.add_template(template_doc(
             "K8sUniqueIngressHost", LIBRARY["K8sUniqueIngressHost"][0]))
