@@ -11,13 +11,19 @@ topdown audit, measured on a subsample and extrapolated linearly).
 Also measured (stderr, and embedded in the `detail` field):
 - demo/basic:    K8sRequiredLabels over 1k Namespaces (both engines)
 - allowed repos: K8sAllowedRepos allowlist over 10k Pods (both engines)
-- library:       full 39-template library x 100k mixed resources
+- library:       full 40-template library x 100k mixed resources
 - regex-heavy:   image-digest / tag / wildcard-host templates x 100k
 - selector-heavy: namespaceSelector matching at 100k namespaces
 - admission:     AdmissionReview replay through the webhook handler with
                  micro-batching, p50/p99 latency
-- cold start:    first-audit-complete time (persistent XLA cache makes
-                 restarts skip the per-template compile)
+- cold start:    first-audit-complete time (batch ingest eagerly
+                 materializes the mirror + prewarms executables;
+                 persistent XLA cache + upgraded-keys markers make
+                 restarts reload instead of recompile)
+- regex-hicard:  500k unique strings through the batched byte-DFA
+                 (ops/regex_dfa) vs the per-unique host re loop
+- open-loop:     fixed-rate admission replay, honest p99 at 1k/2k/4k rps
+- device-batch:  query_review_batch crossover vs the scalar engine
 
 Env knobs: GATEKEEPER_BENCH_N (north-star N), GATEKEEPER_BENCH_C
 (constraints per kind), GATEKEEPER_BENCH_QUICK=1 (shrink everything).
