@@ -25,8 +25,24 @@ Also measured (stderr, and embedded in the `detail` field):
 - open-loop:     fixed-rate admission replay, honest p99 at 1k/2k/4k rps
 - device-batch:  query_review_batch crossover vs the scalar engine
 
+Resilience contract (round-4 postmortem: one hung backend probe ran
+the driver into its kill timeout and erased every config's numbers —
+BENCH_r04 rc=124, parsed=null):
+
+- backend bring-up is bounded (utils/device_probe); with a dead tunnel
+  the whole bench runs on the scalar/CPU path at shrunk sizes, flagged
+  ``"backend": "cpu-fallback"``;
+- a tiny device canary runs FIRST and sets a provisional headline —
+  a number of record exists within the first minutes;
+- every phase has a wall-clock budget enforced by a watchdog thread:
+  a phase that hangs (device op stuck mid-tunnel) gets the headline
+  JSON printed from whatever is already measured, then the process
+  exits — partial detail is fine, a dead capture is not;
+- ``detail`` is flushed to BENCH_partial.json as each phase completes.
+
 Env knobs: GATEKEEPER_BENCH_N (north-star N), GATEKEEPER_BENCH_C
-(constraints per kind), GATEKEEPER_BENCH_QUICK=1 (shrink everything).
+(constraints per kind), GATEKEEPER_BENCH_QUICK=1 (shrink everything),
+GATEKEEPER_BENCH_BUDGET_S (global wall budget, default 2700).
 """
 
 from __future__ import annotations
@@ -36,6 +52,7 @@ import os
 import random
 import statistics
 import sys
+import threading
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
@@ -47,12 +64,28 @@ from gatekeeper_tpu.engine.jax_driver import JaxDriver
 from gatekeeper_tpu.library import all_docs, constraint_doc, make_mixed, template_doc
 from gatekeeper_tpu.library.templates import LIBRARY
 from gatekeeper_tpu.target.k8s import K8sValidationTarget, TARGET_NAME
+from gatekeeper_tpu.utils.device_probe import probe_devices
 
 QUICK = os.environ.get("GATEKEEPER_BENCH_QUICK") == "1"
 N = int(os.environ.get("GATEKEEPER_BENCH_N", 100_000 if QUICK else 1_000_000))
 C_PER_KIND = int(os.environ.get("GATEKEEPER_BENCH_C", 67))
 BASELINE_N = int(os.environ.get("GATEKEEPER_BENCH_BASELINE_N", 2_000))
 CAP = 20
+HBM_PEAK_GBPS = 819.0   # TPU v5e HBM bandwidth peak (public spec)
+
+# set by main() after the bounded probe / canary: the device backend is
+# unusable, so phases run scalar-only at sizes the scalar oracle can
+# finish inside the budget
+FALLBACK = False
+
+
+def sized(full: int, fallback: int, quick: int | None = None) -> int:
+    """Workload size for the current mode."""
+    if FALLBACK:
+        return fallback
+    if QUICK and quick is not None:
+        return quick
+    return full
 
 REQUIRED_LABELS = LIBRARY["K8sRequiredLabels"][0]
 ALLOWED_REPOS = LIBRARY["K8sAllowedRepos"][0]
@@ -61,6 +94,140 @@ CONTAINER_LIMITS = LIBRARY["K8sContainerLimits"][0]
 
 def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
+
+
+# ---------------------------------------------------------------------------
+# headline + phase harness
+
+DETAIL: dict = {}
+HEADLINE: dict = {"metric": "audit_constraint_evals_per_sec", "value": 0.0,
+                  "unit": "evals/s", "vs_baseline": 0.0, "detail": DETAIL}
+_T0 = time.monotonic()
+_EMIT_LOCK = threading.Lock()
+_EMITTED = False
+_PARTIAL_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "BENCH_partial.json")
+GLOBAL_BUDGET_S = float(os.environ.get("GATEKEEPER_BENCH_BUDGET_S", "2700"))
+
+# watchdog state: (phase name, absolute deadline)
+_PHASE = {"name": None, "deadline": None}
+_PHASE_LOCK = threading.Lock()
+
+
+def set_headline(value: float, vs_baseline: float,
+                 provisional: bool = False) -> None:
+    """Record the number of record the moment it exists — and surface
+    it on stderr immediately, so even a capture that dies later still
+    shows it in the tail."""
+    HEADLINE["value"] = round(value, 1)
+    HEADLINE["vs_baseline"] = round(vs_baseline, 2)
+    if provisional:
+        HEADLINE["provisional"] = True
+    else:
+        HEADLINE.pop("provisional", None)
+    log(f"[headline]{' (provisional)' if provisional else ''} "
+        + json.dumps({k: v for k, v in HEADLINE.items() if k != "detail"}))
+    flush_partial()
+
+
+def flush_partial() -> None:
+    """Write everything measured so far to BENCH_partial.json (atomic)."""
+    try:
+        tmp = _PARTIAL_PATH + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(HEADLINE, f)
+        os.replace(tmp, _PARTIAL_PATH)
+    except Exception:   # noqa: BLE001 — includes mid-dump dict mutation
+        pass
+
+
+def emit_headline() -> None:
+    """Print THE one stdout JSON line (exactly once, from any thread).
+    The watchdog calls this while a phase thread may be mutating
+    DETAIL — serialization must survive the race (and _EMITTED only
+    latches after a successful print, so a failed attempt does not
+    suppress the headline forever)."""
+    global _EMITTED
+    with _EMIT_LOCK:
+        if _EMITTED:
+            return
+        HEADLINE["wall_seconds"] = round(time.monotonic() - _T0, 1)
+        line = None
+        for _ in range(3):
+            try:
+                line = json.dumps(HEADLINE)
+                break
+            except RuntimeError:        # dict mutated mid-dump; retry
+                time.sleep(0.05)
+        if line is None:                # strip the racing detail
+            slim = {k: v for k, v in HEADLINE.items() if k != "detail"}
+            slim["detail"] = {"aborted": "detail serialization race"}
+            line = json.dumps(slim)
+        print(line, flush=True)
+        _EMITTED = True
+        flush_partial()
+
+
+def _watchdog() -> None:
+    """Emit-and-exit when a phase (or the whole run) blows its budget.
+    A hung device op cannot be interrupted from Python — the only safe
+    recovery that still produces a number of record is to print the
+    headline from what is already measured and leave."""
+    global_deadline = _T0 + GLOBAL_BUDGET_S
+    while True:
+        time.sleep(1.0)
+        now = time.monotonic()
+        with _PHASE_LOCK:
+            name, deadline = _PHASE["name"], _PHASE["deadline"]
+        breach = None
+        if now > global_deadline:
+            breach = f"global budget {GLOBAL_BUDGET_S:.0f}s exceeded"
+        elif name is not None and deadline is not None and now > deadline:
+            breach = f"phase {name!r} exceeded its budget"
+        if breach:
+            log(f"[watchdog] {breach}; emitting headline and exiting")
+            try:
+                DETAIL.setdefault("phases", {}).setdefault(
+                    name or "<none>", {})["timed_out"] = True
+                DETAIL["aborted"] = breach
+                emit_headline()
+                sys.stdout.flush()
+                sys.stderr.flush()
+            finally:
+                os._exit(0)     # the exit must fire even if emit races
+
+
+def run_phase(name: str, fn, budget_s: float) -> None:
+    """Run one bench phase under the watchdog's per-phase budget.  A
+    phase that raises is recorded and skipped — later phases still run.
+    A phase that would not fit in the remaining global budget is
+    skipped outright."""
+    phases = DETAIL.setdefault("phases", {})
+    left = (_T0 + GLOBAL_BUDGET_S) - time.monotonic()
+    if left < min(60.0, budget_s * 0.25):
+        phases[name] = {"skipped": f"only {left:.0f}s of global budget left"}
+        log(f"[{name}] skipped ({left:.0f}s of global budget left)")
+        return
+    with _PHASE_LOCK:
+        _PHASE["name"] = name
+        _PHASE["deadline"] = time.monotonic() + budget_s
+    t0 = time.monotonic()
+    rec = phases.setdefault(name, {})
+    try:
+        fn(DETAIL)
+        rec["ok"] = True
+    except Exception as e:      # noqa: BLE001 — a phase must not kill the run
+        rec["ok"] = False
+        rec["error"] = f"{type(e).__name__}: {e}"
+        log(f"[{name}] FAILED: {type(e).__name__}: {e}")
+    finally:
+        rec["wall_seconds"] = round(time.monotonic() - t0, 1)
+        rec["backend"] = "cpu-fallback" if FALLBACK else \
+            probe_devices().backend_label
+        with _PHASE_LOCK:
+            _PHASE["name"] = None
+            _PHASE["deadline"] = None
+        flush_partial()
 
 
 def make_resources(n, rng):
@@ -125,9 +292,10 @@ def timed_audit(driver, reps=3, cap=CAP):
 
 def bench_north_star(detail):
     rng = random.Random(42)
+    n = sized(N, 1_000)
     n_constraints = 3 * C_PER_KIND
-    log(f"[north-star] building {N} resources x {n_constraints} constraints")
-    resources = make_resources(N, rng)
+    log(f"[north-star] building {n} resources x {n_constraints} constraints")
+    resources = make_resources(n, rng)
 
     jd = JaxDriver()
     t0 = time.perf_counter()
@@ -139,6 +307,11 @@ def bench_north_star(detail):
     snap0 = jd.metrics.snapshot()
     t_best, _t_first, n_results = timed_audit(jd)
     snap = jd.metrics.snapshot()
+    evals = n * n_constraints
+    # number of record, the moment it exists: vs_baseline provisionally
+    # against the round-3-measured scalar-oracle rate (~5.8k evals/s on
+    # this host) until the oracle subsample below replaces it
+    set_headline(evals / t_best, (evals / 5800.0) / t_best, provisional=True)
 
     # churn: upsert 1% of rows (label/image edits on existing names),
     # then sweep — delta-maintained columns/bindings/masks must keep the
@@ -146,11 +319,11 @@ def bench_north_star(detail):
     from gatekeeper_tpu.engine.veval import quiesce_upgrades
     quiesce_upgrades()      # cold-flurry upgrades must not bleed in
     churn_rng = random.Random(1234)
-    n_churn = max(N // 100, 1)
+    n_churn = max(n // 100, 1)
     churn_times = []
-    for _rep in range(3):
+    for _rep in range(1 if FALLBACK else 3):
         t0 = time.perf_counter()
-        for i in churn_rng.sample(range(N), n_churn):
+        for i in churn_rng.sample(range(n), n_churn):
             o = resources[i]
             o["metadata"]["labels"] = {
                 k: "v" for k in [f"l{j}" for j in range(10)]
@@ -173,7 +346,6 @@ def bench_north_star(detail):
 
     dev = {"mean_seconds": delta_mean("device_wait")}
     fmt = {"mean_seconds": delta_mean("host_format")}
-    evals = N * n_constraints
     log(f"[north-star] ingest {ingest_s:.1f}s | first audit (cold) {cold_s:.1f}s"
         f" | steady {t_best*1e3:.0f}ms ({n_results} capped results)"
         f" | 1%-churn sweep {churn_s*1e3:.0f}ms")
@@ -185,55 +357,99 @@ def bench_north_star(detail):
         f"executables: {jd.executor.compiles} compiled, "
         f"{jd.executor.cache_hits} cache hits")
 
+    # roofline context: host-side bytes of every array the steady sweep
+    # reads on device (binding columns, element tables, per-constraint
+    # tensors, match/rank gates).  A lower bound on HBM traffic per
+    # sweep (XLA materializes intermediates on top), so pct_of_peak is
+    # an upper bound on how close the sweep is to the bandwidth floor.
+    roofline = None
+    if not FALLBACK:
+        st = jd.state[TARGET_NAME]
+        kind_bytes = {}
+        b = None
+        for kind, (_key, b) in st.bindings_cache.items():
+            kind_bytes[kind] = int(sum(a.nbytes for a in b.arrays.values()))
+        gates = sum(int(getattr(m, "nbytes", 0))
+                    for m in st.installed_match.values())
+        if st.rank_cache is not None:
+            gates += int(st.rank_cache[1].nbytes)
+        total_bytes = sum(kind_bytes.values()) + gates
+        achieved_gbps = total_bytes / t_best / 1e9
+        roofline = {
+            "bytes_touched_per_sweep": total_bytes,
+            "bytes_by_kind": kind_bytes,
+            "gate_bytes": gates,
+            "achieved_gbps": round(achieved_gbps, 2),
+            "hbm_peak_gbps": HBM_PEAK_GBPS,
+            "pct_of_hbm_peak": round(100 * achieved_gbps / HBM_PEAK_GBPS, 2),
+            "note": "host-side array bytes (lower bound on device "
+                    "traffic); steady sweep also pays fixed dispatch + "
+                    "fetch latency through the tunnel (device_wait_mean_s)",
+        }
+        log(f"[north-star] roofline: {total_bytes/1e9:.3f} GB/sweep -> "
+            f"{achieved_gbps:.1f} GB/s achieved = "
+            f"{100*achieved_gbps/HBM_PEAK_GBPS:.1f}% of v5e HBM peak "
+            f"({HBM_PEAK_GBPS:.0f} GB/s)")
+        # st/b pin the old driver's whole target state (1M-row table,
+        # binding columns, masks) — release before the restart
+        # measurement below frees the driver (same hazard bench_library
+        # handles with its own `del c, st`)
+        del st, b
+
     # restart: a fresh driver in the same environment — state rebuilt
     # from scratch (the reference rebuilds from watches on every
-    # restart too) but the persistent XLA cache skips the compiles
+    # restart too) but the persistent XLA cache skips the compiles.
+    # Meaningless in fallback mode (nothing compiles).
     import gc
-    del client
-    jd_old, jd = jd, None
-    del jd_old
-    gc.collect()
-    quiesce_upgrades()      # measure the restart, not leftover compiles
-    jd2 = JaxDriver()
-    pc_snap = jd2.executor.persistent_stats.snapshot()
-    t0 = time.perf_counter()
-    client2 = setup_north_star(jd2, resources, random.Random(7))
-    restart_ingest_s = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    jd2.query_audit(TARGET_NAME, QueryOpts(limit_per_constraint=CAP))
-    restart_audit_s = time.perf_counter() - t0
-    pc = jd2.executor.persistent_stats.delta_since(pc_snap)
-    log(f"[north-star] restart: ingest {restart_ingest_s:.1f}s, first audit "
-        f"{restart_audit_s:.1f}s (persistent XLA cache: {pc['hits']} hits / "
-        f"{pc['misses']} writes / {pc['requests']} requests; executor: "
-        f"{jd2.executor.compiles} compiles)")
-    del client2, jd2
-    gc.collect()
+    restart_ingest_s = restart_audit_s = None
+    pc = {"hits": 0, "misses": 0}
+    if not FALLBACK:
+        del client
+        jd_old, jd = jd, None
+        del jd_old
+        gc.collect()
+        quiesce_upgrades()  # measure the restart, not leftover compiles
+        jd2 = JaxDriver()
+        pc_snap = jd2.executor.persistent_stats.snapshot()
+        t0 = time.perf_counter()
+        client2 = setup_north_star(jd2, resources, random.Random(7))
+        restart_ingest_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        jd2.query_audit(TARGET_NAME, QueryOpts(limit_per_constraint=CAP))
+        restart_audit_s = time.perf_counter() - t0
+        pc = jd2.executor.persistent_stats.delta_since(pc_snap)
+        log(f"[north-star] restart: ingest {restart_ingest_s:.1f}s, first "
+            f"audit {restart_audit_s:.1f}s (persistent XLA cache: "
+            f"{pc['hits']} hits / {pc['misses']} writes; executor: "
+            f"{jd2.executor.compiles} compiles)")
+        del client2, jd2
+        gc.collect()
 
     # CPU oracle baseline on a subsample, linearly extrapolated
     ld = LocalDriver()
-    sub = resources[:BASELINE_N]
+    sub = resources[:min(BASELINE_N, n)]
     setup_north_star(ld, sub, random.Random(7))
     t0 = time.perf_counter()
     ld.query_audit(TARGET_NAME, QueryOpts())
     t_cpu_sub = time.perf_counter() - t0
-    t_cpu = t_cpu_sub * (N / max(len(sub), 1))
+    t_cpu = t_cpu_sub * (n / max(len(sub), 1))
     log(f"[north-star] cpu oracle: {t_cpu_sub:.2f}s for {len(sub)} -> "
-        f"extrapolated {t_cpu:.1f}s for {N}")
+        f"extrapolated {t_cpu:.1f}s for {n}")
     detail["north_star"] = {
-        "n_resources": N, "n_constraints": n_constraints,
+        "n_resources": n, "n_constraints": n_constraints,
         "steady_seconds": round(t_best, 4), "cold_seconds": round(cold_s, 2),
         "ingest_seconds": round(ingest_s, 2),
         "churn_1pct_sweep_seconds": round(churn_s, 4),
-        "restart_ingest_seconds": round(restart_ingest_s, 2),
-        "restart_first_audit_seconds": round(restart_audit_s, 2),
+        "restart_ingest_seconds": restart_ingest_s and round(restart_ingest_s, 2),
+        "restart_first_audit_seconds": restart_audit_s and round(restart_audit_s, 2),
         "restart_persistent_cache_hits": pc["hits"],
         "restart_persistent_cache_misses": pc["misses"],
         "device_wait_mean_s": dev.get("mean_seconds"),
         "host_format_mean_s": fmt.get("mean_seconds"),
         "capped_results": n_results,
+        "roofline": roofline,
         "cpu_oracle_extrapolated_seconds": round(t_cpu, 2)}
-    return evals / t_best, t_cpu / t_best
+    set_headline(evals / t_best, t_cpu / t_best)
 
 
 def bench_two_engines(detail, key, resources, templates, constraints,
@@ -286,7 +502,7 @@ def bench_allowed_repos(detail):
 
 
 def bench_library(detail):
-    n = 10_000 if QUICK else 100_000
+    n = sized(100_000, 2_000, 10_000)
     log(f"[library] building {n} mixed resources x {len(LIBRARY)} templates")
     rng = random.Random(5)
     resources = make_mixed(rng, n)
@@ -306,32 +522,36 @@ def bench_library(detail):
     lowered = sum(1 for t in st.templates.values() if t.vectorized is not None)
     # restart: the cold number above is one serialized compile-service
     # round per template and is paid once per cluster lifetime — a
-    # process restart reloads all executables from the persistent cache
-    from gatekeeper_tpu.engine.veval import quiesce_upgrades
-    quiesce_upgrades()
+    # process restart reloads all executables from the persistent cache.
+    # Nothing compiles in fallback mode, so nothing to measure there.
+    restart_ingest_s = restart_audit_s = None
+    pc = {"hits": 0}
     import gc as _gc
-    del c, st                 # st pins the old driver's target state
-    jd_old, jd = jd, None
-    del jd_old
-    _gc.collect()
-    jd2 = JaxDriver()
-    pc_snap = jd2.executor.persistent_stats.snapshot()
-    c2 = Backend(jd2).new_client([K8sValidationTarget()])
-    for tdoc, cdoc in all_docs():
-        c2.add_template(tdoc)
-        c2.add_constraint(cdoc)
-    t0 = time.perf_counter()
-    c2.add_data_batch(resources)
-    restart_ingest_s = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    jd2.query_audit(TARGET_NAME, QueryOpts(limit_per_constraint=CAP))
-    restart_audit_s = time.perf_counter() - t0
-    pc = jd2.executor.persistent_stats.delta_since(pc_snap)
-    log(f"[library] restart: ingest {restart_ingest_s:.1f}s, first audit "
-        f"{restart_audit_s:.1f}s (persistent XLA cache: {pc['hits']} hits / "
-        f"{pc['misses']} writes / {pc['requests']} requests)")
-    del c2, jd2               # release before the CPU-oracle phase
-    _gc.collect()
+    if not FALLBACK:
+        from gatekeeper_tpu.engine.veval import quiesce_upgrades
+        quiesce_upgrades()
+        del c, st             # st pins the old driver's target state
+        jd_old, jd = jd, None
+        del jd_old
+        _gc.collect()
+        jd2 = JaxDriver()
+        pc_snap = jd2.executor.persistent_stats.snapshot()
+        c2 = Backend(jd2).new_client([K8sValidationTarget()])
+        for tdoc, cdoc in all_docs():
+            c2.add_template(tdoc)
+            c2.add_constraint(cdoc)
+        t0 = time.perf_counter()
+        c2.add_data_batch(resources)
+        restart_ingest_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        jd2.query_audit(TARGET_NAME, QueryOpts(limit_per_constraint=CAP))
+        restart_audit_s = time.perf_counter() - t0
+        pc = jd2.executor.persistent_stats.delta_since(pc_snap)
+        log(f"[library] restart: ingest {restart_ingest_s:.1f}s, first audit "
+            f"{restart_audit_s:.1f}s (persistent XLA cache: {pc['hits']} hits"
+            f" / {pc['misses']} writes / {pc['requests']} requests)")
+        del c2, jd2           # release before the CPU-oracle phase
+        _gc.collect()
     # oracle on a subsample
     ld = LocalDriver()
     cl = Backend(ld).new_client([K8sValidationTarget()])
@@ -351,8 +571,8 @@ def bench_library(detail):
         "n_resources": n, "n_templates": len(LIBRARY),
         "device_lowered": lowered, "steady_seconds": round(best, 4),
         "cold_seconds": round(cold_s, 2), "ingest_seconds": round(ingest_s, 2),
-        "restart_ingest_seconds": round(restart_ingest_s, 2),
-        "restart_first_audit_seconds": round(restart_audit_s, 2),
+        "restart_ingest_seconds": restart_ingest_s and round(restart_ingest_s, 2),
+        "restart_first_audit_seconds": restart_audit_s and round(restart_audit_s, 2),
         "restart_persistent_cache_hits": pc["hits"],
         "capped_results": n_res,
         "cpu_oracle_extrapolated_seconds": round(t_cpu, 2)}
@@ -362,7 +582,7 @@ def bench_selector_heavy(detail):
     """namespaceSelector-heavy matching at 100k namespaces: the
     namespace-axis selector evaluation is the cost center (VERDICT r2
     weak #5 — previously scalar per-namespace)."""
-    n_ns = 2_000 if QUICK else 100_000
+    n_ns = sized(100_000, 2_000, 2_000)
     rng = random.Random(8)
     resources = []
     for i in range(n_ns):
@@ -398,7 +618,7 @@ def bench_selector_heavy(detail):
 
 
 def bench_regex_heavy(detail):
-    n = 10_000 if QUICK else 100_000
+    n = sized(100_000, 2_000, 10_000)
     rng = random.Random(6)
     resources = make_resources(n, rng)
     kinds = ["K8sImageDigests", "K8sDisallowedTags", "K8sNoEnvVarSecrets"]
@@ -471,6 +691,10 @@ def bench_admission_device_batch(detail):
     but was never measured through the tunnel)."""
     from gatekeeper_tpu.engine import jax_driver as jd_mod
 
+    if FALLBACK:
+        detail["admission_device_batch"] = {
+            "skipped": "device backend unavailable"}
+        return
     rng = random.Random(11)
     jd = JaxDriver()
     c = Backend(jd).new_client([K8sValidationTarget()])
@@ -507,8 +731,15 @@ def bench_admission_device_batch(detail):
     out = {"n_constraints": n_cons,
            "scalar_single_thread_rps": round(scalar_rps, 1), "batched": {}}
     crossover = None
-    saved = jd_mod.SMALL_WORKLOAD_EVALS
-    jd_mod.SMALL_WORKLOAD_EVALS = 0    # measure the device path itself
+    # zero BOTH routing thresholds: with only SMALL_WORKLOAD_EVALS
+    # zeroed, sub-threshold batches silently fell back to the scalar
+    # loop inside query_review_batch and the "measured crossover" was
+    # the REVIEW_BATCH_MIN_EVALS threshold echoing itself (round-4
+    # advisor finding) — every batch size below must actually run the
+    # device path to make the threshold derivation non-circular
+    saved = (jd_mod.SMALL_WORKLOAD_EVALS, jd_mod.REVIEW_BATCH_MIN_EVALS)
+    jd_mod.SMALL_WORKLOAD_EVALS = 0
+    jd_mod.REVIEW_BATCH_MIN_EVALS = 0
     try:
         for B in (64, 256, 1024, 4096):
             batch = reviews[:B]
@@ -524,9 +755,16 @@ def bench_admission_device_batch(detail):
             if crossover is None and rps > scalar_rps:
                 crossover = B
     finally:
-        jd_mod.SMALL_WORKLOAD_EVALS = saved
+        jd_mod.SMALL_WORKLOAD_EVALS, jd_mod.REVIEW_BATCH_MIN_EVALS = saved
     out["crossover_batch"] = crossover
-    log(f"[admission-device-batch] crossover batch size: {crossover}")
+    out["crossover_evals"] = crossover and crossover * n_cons
+    out["shipped_threshold_evals"] = jd_mod.REVIEW_BATCH_MIN_EVALS
+    out["threshold_engages_at_default_webhook_batch"] = (
+        crossover is not None and
+        jd_mod.REVIEW_BATCH_MIN_EVALS <= 64 * n_cons)
+    log(f"[admission-device-batch] crossover batch size: {crossover} "
+        f"({out['crossover_evals']} evals; shipped threshold "
+        f"{jd_mod.REVIEW_BATCH_MIN_EVALS} evals)")
     detail["admission_device_batch"] = out
 
 
@@ -542,7 +780,7 @@ def bench_regex_high_cardinality(detail):
     from gatekeeper_tpu.rego.interp import Interpreter
     from gatekeeper_tpu.store.table import ResourceMeta, ResourceTable
 
-    n = 50_000 if QUICK else 500_000
+    n = sized(500_000, 20_000, 50_000)
     rng = random.Random(17)
     interp = Interpreter(parse_module(LIBRARY["K8sImageDigests"][0]))
     lowered = Lowerer(interp.module, interp).lower()
@@ -566,9 +804,10 @@ def bench_regex_high_cardinality(detail):
     out = {"n_unique": n}
     saved = (regex_dfa.TABLE_MIN_UNIQUES, regex_dfa.TABLE_DEVICE_MIN_UNIQUES)
     try:
-        for mode, t_min, d_min in (("host_re_loop", big, big),
-                                   ("dfa_numpy", 1, big),
-                                   ("dfa_device", 1, 1)):
+        modes = [("host_re_loop", big, big), ("dfa_numpy", 1, big)]
+        if not FALLBACK:
+            modes.append(("dfa_device", 1, 1))
+        for mode, t_min, d_min in modes:
             regex_dfa.TABLE_MIN_UNIQUES = t_min
             regex_dfa.TABLE_DEVICE_MIN_UNIQUES = d_min
             times = []
@@ -604,7 +843,7 @@ def bench_admission_replay(detail):
     handler.batcher = batcher
     batcher.start()
 
-    n_reviews = 2_000 if QUICK else 20_000
+    n_reviews = sized(20_000, 5_000, 2_000)
     rng = random.Random(9)
     objs = make_resources(512, rng)
     reqs = []
@@ -695,26 +934,83 @@ def bench_admission_replay(detail):
             "reviews_per_sec": round(rrps, 1)}
 
 
+def bench_canary(detail):
+    """Tiny end-to-end device run, FIRST: proves the tunnel actually
+    executes + fetches (the probe only proves backend init), warms the
+    compile service connection, and sets a provisional headline so a
+    number of record exists minutes in.  A canary failure demotes the
+    whole run to fallback sizing."""
+    global FALLBACK
+    if FALLBACK:
+        detail["canary"] = {"skipped": "probe already failed"}
+        return
+    rng = random.Random(99)
+    n = 2_000
+    resources = make_resources(n, rng)
+    jd = JaxDriver()
+    client = Backend(jd).new_client([K8sValidationTarget()])
+    client.add_template(template_doc("K8sRequiredLabels", REQUIRED_LABELS))
+    for j in range(4):
+        client.add_constraint(constraint_doc(
+            "K8sRequiredLabels", f"canary-{j}",
+            {"labels": [f"l{j}", f"l{j+1}"]}))
+    client.add_data_batch(resources)
+    from gatekeeper_tpu.engine import jax_driver as jd_mod
+    saved = jd_mod.SMALL_WORKLOAD_EVALS
+    jd_mod.SMALL_WORKLOAD_EVALS = 0     # force the device path
+    try:
+        t0 = time.perf_counter()
+        jd.query_audit(TARGET_NAME, QueryOpts(limit_per_constraint=CAP))
+        cold = time.perf_counter() - t0
+        best, _first, _nres = timed_audit(jd, reps=2)
+    finally:
+        jd_mod.SMALL_WORKLOAD_EVALS = saved
+    evals = n * 4
+    detail["canary"] = {"n_resources": n, "n_constraints": 4,
+                        "cold_seconds": round(cold, 2),
+                        "steady_seconds": round(best, 4)}
+    log(f"[canary] device path live: cold {cold:.1f}s, steady "
+        f"{best*1e3:.0f}ms at {n}x4")
+    # provisional number of record (the real north star overwrites it);
+    # vs_baseline against the round-3-measured scalar rate
+    set_headline(evals / best, (evals / 5800.0) / best, provisional=True)
+
+
 def main():
+    global FALLBACK
     from gatekeeper_tpu.engine.veval import quiesce_upgrades
-    detail: dict = {}
-    value, vs = bench_north_star(detail)
+    threading.Thread(target=_watchdog, name="bench-watchdog",
+                     daemon=True).start()
+    res = probe_devices()
+    FALLBACK = not res.ok
+    DETAIL["backend"] = res.backend_label
+    DETAIL["backend_probe"] = res.reason
+    log(f"[bench] backend: {res.backend_label} ({res.reason}); "
+        f"global budget {GLOBAL_BUDGET_S:.0f}s")
+    if FALLBACK:
+        log("[bench] FALLBACK MODE: scalar-only at shrunk sizes")
+
+    run_phase("canary", bench_canary, 300)
+    if DETAIL.get("phases", {}).get("canary", {}).get("ok") is False \
+            and not FALLBACK:
+        # the tunnel answered the probe but cannot execute — demote
+        FALLBACK = True
+        DETAIL["backend"] = "cpu-fallback"
+        log("[bench] canary failed; demoting to FALLBACK sizing")
+    run_phase("north_star", bench_north_star, 1500)
     quiesce_upgrades()
-    bench_demo_basic(detail)
-    bench_allowed_repos(detail)
+    run_phase("demo_basic", bench_demo_basic, 240)
+    run_phase("allowed_repos", bench_allowed_repos, 240)
     quiesce_upgrades()
-    bench_library(detail)
+    run_phase("library", bench_library, 700)
     quiesce_upgrades()
-    bench_regex_heavy(detail)
-    bench_selector_heavy(detail)
-    bench_regex_high_cardinality(detail)
+    run_phase("regex_heavy", bench_regex_heavy, 300)
+    run_phase("selector_heavy", bench_selector_heavy, 300)
+    run_phase("regex_high_cardinality", bench_regex_high_cardinality, 400)
     quiesce_upgrades()
-    bench_admission_replay(detail)
-    bench_admission_device_batch(detail)
-    print(json.dumps({"metric": "audit_constraint_evals_per_sec",
-                      "value": round(value, 1), "unit": "evals/s",
-                      "vs_baseline": round(vs, 2),
-                      "detail": detail}))
+    run_phase("admission_replay", bench_admission_replay, 600)
+    run_phase("admission_device_batch", bench_admission_device_batch, 400)
+    emit_headline()
 
 
 if __name__ == "__main__":

@@ -3,5 +3,5 @@
 # in-memory cluster — sync config -> template -> constraint -> 1k
 # namespaces -> one audit sweep -> constraint status written.
 set -euo pipefail
-cd "$(dirname "$0")/../.."
-exec python -m gatekeeper_tpu.cmd.manager --demo
+cd "$(dirname "$0")"
+exec python demo.py

@@ -267,7 +267,15 @@ class LocalDriver(Driver):
         # templates that never read data.inventory
         inv = st.inventory_doc() if compiled.uses_inventory else None
         tracer: list | None = [] if trace is not None else None
-        for v in compiled.interp.query_set("violation", input_doc, inv, tracer=tracer):
+        step = None
+        if trace is not None:
+            # per-step event trace (OPA topdown/trace.go equivalent):
+            # tracing already bypasses memo caches, so the extra cost of
+            # the stepped oracle path is confined to this debug surface
+            from gatekeeper_tpu.rego.trace import StepTracer
+            step = StepTracer()
+        for v in compiled.interp.query_set("violation", input_doc, inv,
+                                           tracer=tracer, step_tracer=step):
             if not isinstance(v, Obj) or "msg" not in v:
                 continue  # regolib accesses r.msg; absent msg -> no response
             details = v["details"] if "details" in v else Obj()
@@ -277,10 +285,14 @@ class LocalDriver(Driver):
                 constraint=constraint,
                 review=review,
             )
-        if trace is not None and tracer:
+        if trace is not None:
             cname = (constraint.get("metadata") or {}).get("name")
-            for line in tracer:
+            for line in tracer or ():
                 trace.append(f"[{compiled.kind}/{cname}] {line}")
+            if step is not None and step.events:
+                trace.append(f"[{compiled.kind}/{cname}] steps:")
+                trace.extend(f"[{compiled.kind}/{cname}] {ln}"
+                             for ln in step.pretty().splitlines())
 
     @locked_read
     def query_review(self, target: str, review: dict,

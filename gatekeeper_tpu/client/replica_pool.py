@@ -157,11 +157,16 @@ class ReplicaPool(Driver):
         procs: list[tuple[subprocess.Popen, str]] = []
         try:
             for _ in range(n):
+                # child_env: if THIS process already fell back to the
+                # scalar/CPU path (dead device tunnel), the workers are
+                # pinned to JAX_PLATFORMS=cpu instead of each burning a
+                # probe timeout rediscovering the dead plugin
+                from gatekeeper_tpu.utils.device_probe import child_env
                 proc = subprocess.Popen(
                     [sys.executable, "-m", "gatekeeper_tpu.cmd.worker",
                      "--port", "0"],
                     stdout=subprocess.DEVNULL, stderr=subprocess.PIPE,
-                    env={**os.environ, **(env or {})}, text=True,
+                    env={**child_env(), **(env or {})}, text=True,
                     cwd=os.path.dirname(os.path.dirname(
                         os.path.dirname(os.path.abspath(__file__)))))
                 # the worker prints "engine worker up at <url>" once

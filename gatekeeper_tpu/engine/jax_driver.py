@@ -21,9 +21,11 @@ reference client.go:584-607 + regolib/src.go:38-52):
      reference manager.go:35) the host formats at most
      limit x n_constraints pairs regardless of inventory size.
 
-Templates outside the lowerable subset (e.g. data.inventory joins) run
-on the scalar oracle restricted to match-mask candidates — same
-results, no silent behavior split (SURVEY §7 hard-part 6).
+Templates outside the lowerable subset run on the scalar oracle
+restricted to match-mask candidates — same results, no silent behavior
+split (SURVEY §7 hard-part 6).  data.inventory joins in the
+duplicate-detection shape DO lower (ir/lower.py `_try_inventory_join`);
+the per-template bucket is pinned in library/lowering_buckets.json.
 
 The review path delegates to the scalar engine: single-review latency
 is interpreter-bound and the reference's semantics (autoreject,
@@ -123,7 +125,7 @@ class JaxTargetState(TargetState):
     def __init__(self):
         super().__init__()
         self.con_version: dict[str, int] = {}      # kind -> bump on change
-        self.bindings_cache: dict[str, tuple] = {}  # kind -> (gen, ver, b)
+        self.bindings_cache: dict[str, tuple] = {}  # kind -> (cache key, b)
         self.bindings_retired: dict[str, tuple] = {}  # kind -> (ver, old b)
         self.mask_cache: dict[str, tuple] = {}
         # kind -> the padded mask currently installed as a bindings
@@ -147,15 +149,21 @@ class JaxDriver(LocalDriver):
     def __init__(self, tracing: bool = False):
         super().__init__(tracing=tracing)
         mesh = None
-        try:
-            import jax
-            n_dev = len(jax.devices())
-        except RuntimeError as e:       # backend init failure: no devices
-            n_dev = 0
+        # bounded bring-up (utils/device_probe): a backend that errors
+        # OR hangs must not block construction — the reference's driver
+        # always constructs (drivers/local/local.go:28-48), and SURVEY
+        # §5 requires CPU fallback on device failure.  scalar_only
+        # routes every evaluation through the scalar oracle (which
+        # never touches jax) for the life of this process.
+        from gatekeeper_tpu.utils.device_probe import probe_devices
+        res = probe_devices()
+        self.scalar_only = not res.ok
+        if not res.ok:
             from gatekeeper_tpu.utils.log import logger
             logger("engine").warning(
-                "jax device probe failed; single-device engine", error=e)
-        if n_dev > 1:
+                "device backend unavailable; scalar-only engine",
+                reason=res.reason)
+        elif res.n_devices > 1:
             from gatekeeper_tpu.parallel.sharding import make_mesh
             mesh = make_mesh()          # a real failure here should raise
         self.executor = ProgramExecutor(mesh=mesh)
@@ -409,10 +417,16 @@ class JaxDriver(LocalDriver):
                 cons = self._kind_constraints(st, kind)
                 if compiled.vectorized is None or not cons:
                     continue
-                if st.table.n_rows * len(cons) < SMALL_WORKLOAD_EVALS:
+                if self.scalar_only or \
+                        st.table.n_rows * len(cons) < SMALL_WORKLOAD_EVALS:
                     continue
                 bindings = self._kind_bindings(st, kind, compiled, cons)
-                warm.append((compiled.vectorized.program, bindings))
+                # mirror the dispatch-time gate set: kinds with match
+                # criteria get a __match__ binding at _install_gates
+                with_match = any((c.get("spec") or {}).get("match")
+                                 for c in cons)
+                warm.append((compiled.vectorized.program, bindings,
+                             with_match))
         # the sorted row order + rank gate are table-derived too
         _, row_order = self._ensure_order(st)
         self._row_rank(st, row_order)
@@ -421,12 +435,13 @@ class JaxDriver(LocalDriver):
         if warm and self.executor.mesh is None:
             from gatekeeper_tpu.engine.veval import ProgramExecutor
 
-            def _warm_one(prog, bindings):
+            def _warm_one(prog, bindings, with_match):
                 if self.executor._shutdown.is_set():
                     return
                 try:
                     self.executor.prewarm_audit_exec(
-                        prog, bindings, DEFAULT_PREWARM_CAP)
+                        prog, bindings, DEFAULT_PREWARM_CAP,
+                        with_match=with_match)
                     # upload the binding arrays while the GIL is free —
                     # the first dispatch then reuses the per-bindings
                     # device cache instead of paying the tunnel
@@ -448,8 +463,8 @@ class JaxDriver(LocalDriver):
                     with qlock:
                         if not q:
                             return
-                        prog, bindings = q.pop(0)
-                    _warm_one(prog, bindings)
+                        prog, bindings, with_match = q.pop(0)
+                    _warm_one(prog, bindings, with_match)
             for _ in range(min(4, len(warm))):
                 ProgramExecutor.spawn_bg(_drain_q, "ingest-prewarm")
 
@@ -566,7 +581,17 @@ class JaxDriver(LocalDriver):
             pool = concurrent.futures.ThreadPoolExecutor(max_workers=8)
             specs: list[tuple] = []
             futures: list = []
-            if limit is not None and self.executor.mesh is None:
+            # cross-host collective ordering: on a mesh spanning
+            # processes, collective launches must happen in the SAME
+            # order on every process (see veval._COLLECTIVE_EXEC_LOCK
+            # scope note).  The kind loop below is sorted, so inline
+            # dispatch from this one thread is deterministic; the
+            # threaded pool (whose completion order is not) stays for
+            # single-process meshes where only mutual exclusion matters.
+            from gatekeeper_tpu.engine.veval import mesh_spans_processes
+            ordered_dispatch = mesh_spans_processes(self.executor.mesh)
+            if limit is not None and not self.scalar_only \
+                    and self.executor.mesh is None:
                 # the shared top-k reduce executable's shape bucket is known
                 # before any prep — compile it concurrently with host prep
                 # (its XLA compile is the longest pole of a cold audit)
@@ -594,7 +619,8 @@ class JaxDriver(LocalDriver):
                             continue
                         mask, mask_dirty, padded = self._kind_mask(
                             st, target, kind, constraints)
-                        small = len(ordered_rows) * len(constraints) \
+                        small = self.scalar_only or \
+                            len(ordered_rows) * len(constraints) \
                             < SMALL_WORKLOAD_EVALS
                         if compiled.vectorized is not None and mask is not None \
                                 and not small:
@@ -619,7 +645,15 @@ class JaxDriver(LocalDriver):
                             mode = "topk" if limit is not None else "mask"
                             spec = (mode, kind, compiled, constraints, prog,
                                     bindings, mask)
-                            futures.append(pool.submit(dispatch, spec))
+                            if ordered_dispatch:
+                                f = concurrent.futures.Future()
+                                try:
+                                    f.set_result(dispatch(spec))
+                                except Exception as e:  # noqa: BLE001
+                                    f.set_exception(e)
+                                futures.append(f)
+                            else:
+                                futures.append(pool.submit(dispatch, spec))
                         else:
                             # unlowerable template — or a workload too small
                             # to amortize a device dispatch round-trip
@@ -657,7 +691,8 @@ class JaxDriver(LocalDriver):
             # warm the churn-delta executables in the background: the first
             # sweep after data churn otherwise pays one serialized XLA
             # compile per kind (multiple seconds) right on the sweep
-            if limit is not None and self.executor.mesh is None:
+            if limit is not None and not self.scalar_only \
+                    and self.executor.mesh is None:
                 warm = [(sp[4], sp[5]) for sp in specs if sp[0] == "topk"]
                 if warm and not self._delta_warmed:
                     self._delta_warmed = True
@@ -707,7 +742,8 @@ class JaxDriver(LocalDriver):
         tracing = opts.tracing if opts is not None else self.default_tracing
         constraints_all = list(st.all_constraints())
         B = len(reviews)
-        if tracing or not isinstance(st, JaxTargetState) or not B or \
+        if tracing or self.scalar_only or not isinstance(st, JaxTargetState) \
+                or not B or \
                 B * len(constraints_all) < REVIEW_BATCH_MIN_EVALS:
             return [self.query_review(target, r, opts) for r in reviews]
 
@@ -815,6 +851,9 @@ class JaxDriver(LocalDriver):
         ci = names.index(constraint_name)
         if compiled.vectorized is None:
             return f"template {kind!r} runs on the scalar engine (not lowered)"
+        if self.scalar_only:
+            return ("device backend unavailable (scalar-only engine); "
+                    "use tracing on the scalar oracle instead")
         with self._prep_lock:
             bindings = self._kind_bindings(st, kind, compiled, constraints)
             mask, _, _ = self._kind_mask(st, target, kind, constraints)

@@ -55,7 +55,28 @@ _bg_drain_registered = False
 # executors over the same devices (the driver's and a test's) are the
 # same hazard.  Single-device executables are unaffected (their async
 # fetch overlap is the tunnel optimization).
+#
+# SCOPE: this (unfair) lock only serializes launches WITHIN one
+# process.  On a mesh spanning processes (jax.distributed), each
+# process's threads could still acquire their local lock in different
+# orders and launch cross-host collective programs in different orders
+# — the same rendezvous deadlock, now across DCN.  Multi-host meshes
+# therefore require single-flight, deterministically ORDERED dispatch:
+# the driver detects a spanning mesh (mesh_spans_processes) and
+# dispatches collective kinds serially in sorted-kind order from the
+# sweep thread (engine/jax_driver.query_audit), which every process
+# reproduces identically.
 _COLLECTIVE_EXEC_LOCK = __import__("threading").Lock()
+
+
+def mesh_spans_processes(mesh) -> bool:
+    """True when the mesh includes devices of other processes — the
+    cross-host collective-ordering discipline then applies."""
+    if mesh is None:
+        return False
+    import jax
+    pid = jax.process_index()
+    return any(d.process_index != pid for d in mesh.devices.flat)
 
 _EXECUTORS = __import__("weakref").WeakSet()
 
@@ -1088,7 +1109,8 @@ class ProgramExecutor:
             fn.prewarm(*ex)
 
     def prewarm_audit_exec(self, program: Program, bindings: Bindings,
-                           k: int | None = None) -> None:
+                           k: int | None = None,
+                           with_match: bool = False) -> None:
         """Compile (or reload from the persistent cache) the audit
         executables for `bindings`' shape bucket ahead of the first
         sweep — from a background thread at ingest time, so the
@@ -1102,6 +1124,15 @@ class ProgramExecutor:
             # the capped audit always installs a rank gate; mirror the
             # dispatch-time name set or the cache key won't match
             arrays["__rank__"] = np.empty((bindings.r_pad,), np.int32)
+        if with_match and "__match__" not in arrays:
+            # kinds whose constraints carry match criteria get a
+            # "__match__" gate installed at dispatch (_install_gates);
+            # without this placeholder the prewarm compiles under a
+            # name-set the first sweep never requests — a wasted
+            # compile-service round AND the real compile still lands on
+            # the cold sweep (round-4 advisor finding)
+            arrays["__match__"] = np.empty(
+                (bindings.c_pad, bindings.r_pad), np.bool_)
         self._compiled(program, arrays, None, False)
         if k is not None:
             self.prewarm_reduce(k, bindings.c_pad, bindings.r_pad)

@@ -33,8 +33,12 @@ fallback.  Known deviations (documented, not load-bearing for k8s
 data): float32 ordering comparisons near 2^24, and ordering (not
 equality) between mixed types.
 
-Templates that reach into ``data.inventory`` (cross-resource joins)
-are not lowered in this version.
+Templates that reach into ``data.inventory`` lower when they match the
+duplicate-detection join shape (``_try_inventory_join`` below — one
+host-built InvJoinReq column per join, e.g. K8sUniqueIngressHost);
+other inventory access raises CannotLower and runs on the scalar
+oracle.  The shipped corpus's bucket per template is pinned in
+library/lowering_buckets.json (CI-checked).
 """
 
 from __future__ import annotations

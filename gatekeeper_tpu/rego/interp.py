@@ -40,6 +40,10 @@ class _Ctx:
     tracer: list | None
     memo: dict
     depth: int = 0
+    # per-step event tracer (rego/trace.StepTracer) — when attached,
+    # evaluation routes through the recursive oracle (closures bypassed:
+    # the tracer must observe every literal)
+    step: Any = None
 
 
 class Interpreter:
@@ -94,9 +98,13 @@ class Interpreter:
     # public entry points
 
     def query_set(self, name: str, input_doc: Any = UNDEFINED,
-                  data_doc: Any = None, tracer: list | None = None) -> list:
+                  data_doc: Any = None, tracer: list | None = None,
+                  step_tracer=None) -> list:
         """Evaluate a partial-set rule; returns its members (frozen values)."""
-        ctx = self._ctx(input_doc, data_doc, tracer)
+        ctx = self._ctx(input_doc, data_doc, tracer, step_tracer)
+        st = ctx.step
+        if st is not None:
+            st.enter(name)
         out, seen = [], set()
         for rule in self.rules.get(name, []):
             if rule.kind != "partial_set":
@@ -106,26 +114,30 @@ class Interpreter:
                     if v not in seen:
                         seen.add(v)
                         out.append(v)
+        if st is not None:
+            st.exit(name, out)
         return out
 
     def query_value(self, name: str, input_doc: Any = UNDEFINED,
-                    data_doc: Any = None, tracer: list | None = None) -> Any:
+                    data_doc: Any = None, tracer: list | None = None,
+                    step_tracer=None) -> Any:
         """Evaluate a complete rule's value; UNDEFINED if no clause fires."""
-        ctx = self._ctx(input_doc, data_doc, tracer)
+        ctx = self._ctx(input_doc, data_doc, tracer, step_tracer)
         return self._rule_value(ctx, name)
 
-    def _ctx(self, input_doc, data_doc, tracer) -> _Ctx:
+    def _ctx(self, input_doc, data_doc, tracer, step_tracer=None) -> _Ctx:
         if input_doc is not UNDEFINED:
             input_doc = freeze(input_doc)
         data = freeze(data_doc) if data_doc is not None else Obj()
-        return _Ctx(input=input_doc, data=data, tracer=tracer, memo={})
+        return _Ctx(input=input_doc, data=data, tracer=tracer, memo={},
+                    step=step_tracer)
 
     # ------------------------------------------------------------------
     # rule evaluation
 
     def _term_eval(self, ctx: _Ctx, term, env: dict):
         """Rule-level term evaluation through the compiled tier when on."""
-        if self._closures is not None:
+        if self._closures is not None and ctx.step is None:
             return self._closures.term(term)(ctx, env)
         return self._eval_term(ctx, term, env)
 
@@ -137,6 +149,10 @@ class Interpreter:
                 raise EvalError(f"recursive rule reference: {name}")
             return v
         ctx.memo[key] = _IN_PROGRESS
+        st = ctx.step
+        if st is not None:
+            rs = self.rules.get(name, [])
+            st.enter(name, rs[0].loc if rs else None)
         rules = self.rules.get(name, [])
         value = UNDEFINED
         if rules and rules[0].kind == "partial_set":
@@ -197,12 +213,17 @@ class Interpreter:
                 raise ConflictError(f"complete rule {name} produced multiple values")
             value = results[0] if results else default_val
         ctx.memo[key] = value
+        if st is not None:
+            st.exit(name, value)
         return value
 
     def _call_function(self, ctx: _Ctx, name: str, argvals: tuple) -> Any:
         if ctx.depth > _MAX_DEPTH:
             raise EvalError(f"max call depth exceeded in {name}")
         rules = self.rules.get(name, [])
+        st = ctx.step
+        if st is not None:
+            st.enter(name, rules[0].loc if rules else None)
         outputs: list = []
         ctx = dataclasses.replace(ctx, depth=ctx.depth + 1)
         for rule in rules:
@@ -234,7 +255,10 @@ class Interpreter:
         # OPA: all function clauses that fire must agree on the output
         if len(outputs) > 1:
             raise ConflictError(f"function {name} produced multiple values for one input")
-        return outputs[0] if outputs else UNDEFINED
+        out = outputs[0] if outputs else UNDEFINED
+        if st is not None:
+            st.exit(name, out)
+        return out
 
     def _match_args(self, ctx: _Ctx, params, argvals, env) -> Iterator[dict]:
         def rec(i, env):
@@ -249,7 +273,7 @@ class Interpreter:
     # body / literal evaluation
 
     def _eval_body(self, ctx: _Ctx, body, i: int, env: dict) -> Iterator[dict]:
-        if self._closures is not None and i == 0:
+        if self._closures is not None and i == 0 and ctx.step is None:
             yield from self._closures.body(body)(ctx, env)
             return
         if i >= len(body):
@@ -259,6 +283,28 @@ class Interpreter:
             yield from self._eval_body(ctx, body, i + 1, env2)
 
     def _eval_literal(self, ctx: _Ctx, lit: Literal, env: dict) -> Iterator[dict]:
+        if ctx.step is not None:
+            yield from self._eval_literal_stepped(ctx, lit, env)
+            return
+        yield from self._eval_literal_raw(ctx, lit, env)
+
+    def _eval_literal_stepped(self, ctx: _Ctx, lit: Literal,
+                              env: dict) -> Iterator[dict]:
+        """Emit Eval/Redo/Fail step events around one literal (OPA's
+        per-literal op sequence, topdown/trace.go)."""
+        st = ctx.step
+        st.step("Eval", lit, env, lit.loc)
+        n = 0
+        for env2 in self._eval_literal_raw(ctx, lit, env):
+            if n:
+                st.step("Redo", lit, env2, lit.loc)
+            n += 1
+            yield env2
+        if n == 0:
+            st.step("Fail", lit, env, lit.loc)
+
+    def _eval_literal_raw(self, ctx: _Ctx, lit: Literal,
+                          env: dict) -> Iterator[dict]:
         if isinstance(lit.expr, SomeDecl):
             env2 = {k: v for k, v in env.items() if k not in lit.expr.names}
             yield env2

@@ -121,10 +121,17 @@ def enable_persistent_cache(path: str | None = None) -> str:
             return existing
         if _enabled:
             return getattr(jax.config, "jax_compilation_cache_dir", "") or ""
-        try:
-            backend = jax.default_backend()
-        except Exception:
-            backend = "unknown"
+        # backend identity comes from the bounded probe, never from a
+        # direct jax.default_backend() call: in a process whose probe
+        # timed out (utils/device_probe), the hung probe thread may hold
+        # jax's backend-init lock — touching the backend here would
+        # block cache setup (and with it driver construction) forever.
+        from gatekeeper_tpu.utils.device_probe import probe_devices
+        res = probe_devices()
+        if res.poisoned:
+            _enabled = True
+            return ""       # no usable backend: persistence is moot
+        backend = res.platform if res.ok else "unknown"
         root = path or os.environ.get("GATEKEEPER_XLA_CACHE_DIR") \
             or os.path.join(os.getcwd(), ".gatekeeper_xla_cache")
         path = resolve_cache_path(backend, root)
@@ -146,9 +153,48 @@ def _marker_path() -> str | None:
     return os.path.join(d, "upgraded_keys.txt") if d else None
 
 
+_PRIMITIVES = (type(None), bool, int, float, str, bytes)
+
+
+def _check_hashable_key(obj, _root=None) -> None:
+    """Reject key components whose repr is not deterministic across
+    processes (anything with the default `<... object at 0x...>` repr
+    would silently disable the upgraded-keys restart fast path — no
+    error, just no marker hits, and a slower restart nobody attributes
+    to this line).  Fail fast instead.
+
+    Accepted: primitives; tuples/lists/dicts (insertion-ordered reprs);
+    dataclasses (field-order reprs), all recursively.  Rejected: sets
+    (repr order follows per-process string hashing) and anything else —
+    notably objects carrying the default address-bearing repr."""
+    root = _root if _root is not None else obj
+    if isinstance(obj, _PRIMITIVES):
+        return
+    if isinstance(obj, (tuple, list)):
+        for x in obj:
+            _check_hashable_key(x, root)
+        return
+    if isinstance(obj, dict):
+        for k, v in obj.items():
+            _check_hashable_key(k, root)
+            _check_hashable_key(v, root)
+        return
+    import dataclasses as _dc
+    if _dc.is_dataclass(obj) and not isinstance(obj, type):
+        for f in _dc.fields(obj):
+            if f.repr:
+                _check_hashable_key(getattr(obj, f.name), root)
+        return
+    raise TypeError(
+        f"executable cache key component {obj!r} ({type(obj).__name__}) "
+        f"does not have a cross-process-deterministic repr "
+        f"(full key: {_root!r})")
+
+
 def key_hash(obj) -> str:
     """Stable cross-process hash of an executable cache key (nested
-    tuples of primitives — repr is deterministic)."""
+    tuples of primitives — repr is deterministic; enforced)."""
+    _check_hashable_key(obj)
     return hashlib.sha256(repr(obj).encode()).hexdigest()[:16]
 
 
@@ -197,6 +243,7 @@ class PersistentCacheStats:
     when prep dominates)."""
 
     def __init__(self):
+        self.wired = True   # False: monitoring listener unavailable
         self.hits = 0       # executable reloaded from disk
         self.misses = 0     # compiled AND written to disk (JAX only
         #                     records a miss when the entry qualifies
@@ -219,11 +266,13 @@ class PersistentCacheStats:
     def snapshot(self) -> dict:
         with self._lock:
             return {"hits": self.hits, "misses": self.misses,
-                    "requests": self.requests}
+                    "requests": self.requests, "wired": self.wired}
 
     def delta_since(self, snap: dict) -> dict:
         cur = self.snapshot()
-        return {k: cur[k] - snap.get(k, 0) for k in cur}
+        out = {k: cur[k] - snap.get(k, 0) for k in cur if k != "wired"}
+        out["wired"] = cur["wired"]
+        return out
 
 
 _stats: PersistentCacheStats | None = None
@@ -236,8 +285,20 @@ def persistent_cache_stats() -> PersistentCacheStats:
     with _lock:
         if _stats is None:
             _stats = PersistentCacheStats()
-            from jax._src import monitoring
-            monitoring.register_event_listener(_stats._on_event)
+            try:
+                # private JAX API — a jax upgrade may move it.  Warn
+                # loudly rather than silently reporting 0 hits forever
+                # (cache-hit counters are what make restart-time claims
+                # credible; a silent no-op here corrupts the bench
+                # artifacts, not just a log line).
+                from jax._src import monitoring
+                monitoring.register_event_listener(_stats._on_event)
+            except Exception as e:
+                _stats.wired = False
+                from gatekeeper_tpu.utils.log import logger
+                logger("compile-cache").warning(
+                    "jax monitoring listener unavailable; persistent-cache "
+                    "hit/miss counters will read 0", error=e)
         return _stats
 
 

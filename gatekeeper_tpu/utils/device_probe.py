@@ -1,0 +1,171 @@
+"""Bounded jax backend bring-up: probe once, under a deadline.
+
+The reference's in-process driver can never hang on construction
+(vendor/.../drivers/local/local.go:28-48 — it allocates maps and
+returns); SURVEY §5 demands the same always-available posture here:
+device failure => recompile/retry on CPU fallback.  A jax *error* is
+easy (jax.devices() raises).  The observed failure mode on a tunneled
+accelerator is worse: backend init neither succeeds nor fails — the
+PJRT plugin blocks inside a dead tunnel indefinitely, which (round 4)
+hung driver construction, the engine worker, both demos, and the bench.
+
+This module is the single choke point.  ``probe_devices()`` runs the
+first ``jax.devices()`` of the process on a daemon thread and waits at
+most ``GATEKEEPER_DEVICE_PROBE_TIMEOUT_S`` (default 45 s — first
+contact with the tunneled backend legitimately takes ~10-20 s):
+
+  * success   -> zero added cost (that init had to happen anyway; the
+                 result is simply observed from a thread);
+  * error     -> no devices; callers serve from the scalar/CPU path;
+  * timeout   -> the probe thread is still parked inside backend init
+                 and very likely holds jax's backend-init lock, so ANY
+                 later jax dispatch from this process could block too.
+                 The process is marked *poisoned*: callers must route
+                 every evaluation through the scalar oracle (pure
+                 Python/numpy — the oracle never touches jax, exactly
+                 like the reference's topdown engine) and must pin
+                 ``JAX_PLATFORMS=cpu`` into any child process they
+                 spawn so children don't re-discover the dead plugin.
+
+The verdict is cached process-wide: the decision is per-process by
+nature (a jax backend initializes once).
+
+Test hook: ``GATEKEEPER_PROBE_TEST_HANG=1`` makes the probe thread
+sleep forever instead of calling jax — simulating a blackholed tunnel
+without needing a hanging PJRT plugin installed.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import sys
+import threading
+import time
+
+DEFAULT_TIMEOUT_S = 45.0
+
+
+@dataclasses.dataclass(frozen=True)
+class ProbeResult:
+    ok: bool                # devices answered within the deadline
+    n_devices: int
+    platform: str           # "tpu" / "cpu" / ... ("" when not ok)
+    poisoned: bool          # probe timed out: jax unusable in-process
+    reason: str             # human-readable, logged once
+
+    @property
+    def backend_label(self) -> str:
+        """For bench/metrics artifacts: what actually serves evals."""
+        if self.ok:
+            return self.platform
+        return "cpu-fallback"
+
+
+_RESULT: ProbeResult | None = None
+_LOCK = threading.Lock()
+
+
+def _timeout_s() -> float:
+    try:
+        return float(os.environ.get(
+            "GATEKEEPER_DEVICE_PROBE_TIMEOUT_S", DEFAULT_TIMEOUT_S))
+    except ValueError:
+        return DEFAULT_TIMEOUT_S
+
+
+def probe_devices(timeout_s: float | None = None) -> ProbeResult:
+    """Probe the jax backend once, bounded.  Thread-safe; cached."""
+    global _RESULT
+    if _RESULT is not None:
+        return _RESULT
+    with _LOCK:
+        if _RESULT is not None:
+            return _RESULT
+        _RESULT = _probe_locked(
+            _timeout_s() if timeout_s is None else timeout_s)
+        return _RESULT
+
+
+def _probe_locked(timeout_s: float) -> ProbeResult:
+    if timeout_s <= 0:
+        # probe disabled: trust the environment (callers inline the
+        # historical unbounded behavior — jax.devices() direct)
+        try:
+            import jax
+            devs = jax.devices()
+            return ProbeResult(True, len(devs), devs[0].platform, False,
+                               "probe disabled; direct device init")
+        except RuntimeError as e:
+            return ProbeResult(False, 0, "", False,
+                               f"backend init failed: {e}")
+
+    box: dict = {}
+
+    def _init():
+        try:
+            if os.environ.get("GATEKEEPER_PROBE_TEST_HANG") == "1":
+                time.sleep(3600)    # simulated dead tunnel
+            import jax
+            # a JAX_PLATFORMS env var does NOT reliably stick: PJRT
+            # plugins re-assert themselves during import, so a process
+            # pinned to cpu via env alone still walks into the plugin's
+            # backend init.  jax.config is authoritative — mirror the
+            # env var in before first device contact.
+            plats = os.environ.get("JAX_PLATFORMS")
+            cur = getattr(jax.config, "jax_platforms", None)
+            # Mirror the env var into config when (a) config is unset,
+            # or (b) the env explicitly pins cpu: a PJRT plugin
+            # re-asserts its own platform into jax.config during
+            # import, so a cpu-pinned child would otherwise still walk
+            # into the plugin's (possibly dead) backend init.  A
+            # non-cpu env var never overrides an explicit in-process
+            # pin (the test conftest's cpu config stays authoritative).
+            if plats and plats != cur and (not cur or plats == "cpu"):
+                jax.config.update("jax_platforms", plats)
+            devs = jax.devices()
+            box["devs"] = (len(devs), devs[0].platform)
+        except BaseException as e:   # noqa: BLE001 — report, don't die
+            box["err"] = e
+
+    t = threading.Thread(target=_init, name="device-probe", daemon=True)
+    start = time.perf_counter()
+    t.start()
+    t.join(timeout_s)
+    took = time.perf_counter() - start
+    if t.is_alive():
+        # Poisoned: the hung thread may hold jax's backend-init lock.
+        # Children we spawn must not walk into the same dead plugin.
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        return ProbeResult(
+            False, 0, "", True,
+            f"jax backend init did not answer within {timeout_s:.0f}s; "
+            "serving from the scalar/CPU path (set "
+            "GATEKEEPER_DEVICE_PROBE_TIMEOUT_S to adjust)")
+    if "err" in box:
+        return ProbeResult(False, 0, "", False,
+                           f"backend init failed after {took:.1f}s: "
+                           f"{box['err']}")
+    n, platform = box["devs"]
+    return ProbeResult(True, n, platform, False,
+                       f"{n} {platform} device(s) in {took:.1f}s")
+
+
+def reset_for_tests() -> None:
+    """Drop the cached verdict (tests only — a real process's verdict
+    is immutable because a jax backend initializes once)."""
+    global _RESULT
+    with _LOCK:
+        _RESULT = None
+
+
+def child_env(base: dict | None = None) -> dict:
+    """Environment for child processes we spawn: if this process fell
+    back (or was told to), pin the child to CPU so it doesn't spend
+    its own probe timeout rediscovering the dead plugin."""
+    env = dict(os.environ if base is None else base)
+    r = _RESULT
+    if r is not None and not r.ok:
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("GATEKEEPER_PROBE_TEST_HANG", None)
+    return env

@@ -26,9 +26,43 @@ DEFAULT_PORT = 8443          # the reference defaults to 443 (policy.go:48)
 # Hardening bounds (controller-runtime's webhook server enforces the
 # same classes of limit — read timeouts and a bounded decoder —
 # pkg/webhook/policy.go:57-79 rides that server):
-REQUEST_TIMEOUT_S = 10.0     # slowloris: socket read timeout per request
+REQUEST_TIMEOUT_S = 10.0     # idle timeout per read AND the wall-clock
+#                              deadline for reading one request's body
 MAX_BODY_BYTES = 10 << 20    # AdmissionReview objects are etcd-bounded
 DRAIN_TIMEOUT_S = 15.0       # stop(): wait for in-flight admissions
+
+
+class _DeadlineBody:
+    """Body reader with a hard wall-clock deadline.
+
+    The handler-class ``timeout`` below is only an *idle* timeout
+    (settimeout on the connection): a slowloris client trickling one
+    byte every few seconds never goes idle and would pin a handler
+    thread through an arbitrarily long body read.  This wrapper reads
+    the body in single-recv slices (``read1``) and checks the wall
+    clock between slices, so a request's body phase is cut off at
+    ``deadline`` no matter how lively the trickle is.  (The
+    header-line phase keeps the idle timeout: header sizes/counts are
+    bounded by http.server itself.)"""
+
+    def __init__(self, raw, conn, deadline: float):
+        self._raw = raw
+        self._conn = conn
+        self._deadline = deadline
+
+    def read(self, size: int) -> bytes:
+        import socket as _socket
+        out = bytearray()
+        while len(out) < size:
+            remaining = self._deadline - time.monotonic()
+            if remaining <= 0:
+                raise _socket.timeout("request body deadline exceeded")
+            self._conn.settimeout(remaining)
+            chunk = self._raw.read1(size - len(out))
+            if not chunk:
+                break               # EOF short read; caller json-fails
+            out += chunk
+        return bytes(out)
 
 
 class WebhookServer:
@@ -65,10 +99,10 @@ class WebhookServer:
         outer = self
 
         class _HTTPHandler(BaseHTTPRequestHandler):
-            # socket read timeout for the whole request (header + body):
-            # a slowloris client trickling bytes is cut off here
-            # (StreamRequestHandler applies it via connection.settimeout;
-            # http.server closes the connection on the timeout)
+            # IDLE timeout per socket read (StreamRequestHandler applies
+            # it via connection.settimeout) — cuts off a client that
+            # stops sending, NOT one that trickles; the body read below
+            # additionally enforces a wall-clock deadline (_DeadlineBody)
             timeout = request_timeout
 
             def log_message(self, *args):  # quiet
@@ -113,7 +147,14 @@ class WebhookServer:
                 with outer._inflight_cv:
                     outer._inflight += 1
                 try:
-                    body = json.loads(self.rfile.read(length) or b"{}")
+                    rbody = _DeadlineBody(
+                        self.rfile, self.connection,
+                        time.monotonic() + request_timeout)
+                    payload = rbody.read(length)
+                    # restore the idle timeout the deadline reads shrank
+                    # (keep-alive: the next request starts fresh)
+                    self.connection.settimeout(request_timeout)
+                    body = json.loads(payload or b"{}")
                     request = body.get("request") or {}
                     response = outer.handler.handle(request)
                     envelope = {

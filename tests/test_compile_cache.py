@@ -84,11 +84,12 @@ class TestPersistentCacheStats:
         stats._on_event("/jax/compilation_cache/compile_requests_use_cache")
         stats._on_event("/jax/some_other_event")
         d = stats.delta_since(snap)
-        assert d == {"hits": 1, "misses": 2, "requests": 1}
+        assert d == {"hits": 1, "misses": 2, "requests": 1, "wired": True}
 
     def test_delta_isolated_instances(self):
         s = PersistentCacheStats()
-        assert s.snapshot() == {"hits": 0, "misses": 0, "requests": 0}
+        assert s.snapshot() == {"hits": 0, "misses": 0, "requests": 0,
+                                "wired": True}
 
     def test_wired_to_real_event_stream(self):
         # fresh process (this one latched its cache state long ago):

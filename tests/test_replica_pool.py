@@ -142,7 +142,12 @@ class TestQueryFailover:
 
 class TestSpawnWorkers:
     def test_subprocess_worker_end_to_end(self):
-        with ReplicaPool.spawn_workers(1, timeout=120) as pool:
+        # pin the worker subprocess to CPU: inheriting the environment's
+        # JAX_PLATFORMS (the tunneled TPU plugin) makes worker startup
+        # depend on tunnel health — with a dead tunnel the worker burns
+        # its whole probe timeout before falling back
+        with ReplicaPool.spawn_workers(
+                1, timeout=120, env={"JAX_PLATFORMS": "cpu"}) as pool:
             c = Backend(pool).new_client([K8sValidationTarget()])
             _setup(c)
             assert _audit_names(c) == ["bad-a", "bad-b"]

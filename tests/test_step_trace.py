@@ -1,0 +1,108 @@
+"""Per-step evaluation trace (rego/trace.py — OPA topdown/trace.go
+equivalent): event stream + PrettyTrace-style rendering, surfaced
+through QueryOpts(tracing=True)."""
+
+from gatekeeper_tpu.client.client import Backend
+from gatekeeper_tpu.client.interface import QueryOpts
+from gatekeeper_tpu.client.local_driver import LocalDriver
+from gatekeeper_tpu.rego import parse_module
+from gatekeeper_tpu.rego.interp import Interpreter
+from gatekeeper_tpu.rego.trace import StepTracer, unparse
+from gatekeeper_tpu.target.k8s import K8sValidationTarget, TARGET_NAME
+
+MOD = """package t
+
+violation[{"msg": msg}] {
+	input.review.object.metadata.labels[k]
+	k == "bad"
+	msg := helper(k)
+}
+
+helper(x) = out {
+	out := concat("", ["label ", x, " forbidden"])
+}
+"""
+
+
+def _events(input_doc):
+    interp = Interpreter(parse_module(MOD))
+    st = StepTracer()
+    out = interp.query_set("violation", input_doc, step_tracer=st)
+    return out, st
+
+
+def test_step_events_on_denial():
+    out, st = _events({"review": {"object": {"metadata":
+                                             {"labels": {"bad": "1"}}}}})
+    assert len(out) == 1
+    ops = [e.op for e in st.events]
+    assert ops[0] == "Enter"            # the violation query
+    assert "Eval" in ops
+    assert "Exit" in ops                # helper function + query exit
+    # the helper function got its own Enter/Exit pair
+    enters = [e.node for e in st.events if e.op == "Enter"]
+    assert "violation" in enters and "helper" in enters
+    # locals are captured at steps that have bindings
+    assert any(("k", "'bad'") in e.locals for e in st.events)
+
+
+def test_step_events_on_pass_include_fail():
+    out, st = _events({"review": {"object": {"metadata":
+                                             {"labels": {"ok": "1"}}}}})
+    assert out == []
+    assert any(e.op == "Fail" for e in st.events)
+
+
+def test_redo_on_backtracking():
+    out, st = _events({"review": {"object": {"metadata": {"labels": {
+        "a": "1", "bad": "2", "c": "3"}}}}})
+    assert len(out) == 1
+    # the labels[k] iteration yields multiple solutions -> Redo events
+    assert any(e.op == "Redo" for e in st.events)
+
+
+def test_pretty_renders_indented():
+    _out, st = _events({"review": {"object": {"metadata":
+                                              {"labels": {"bad": "1"}}}}})
+    text = st.pretty()
+    lines = text.splitlines()
+    assert lines[0].startswith("| Enter violation")
+    assert any(ln.startswith("| | ") for ln in lines)   # nested depth
+    assert "helper" in text and "Eval" in text
+
+
+def test_unparse_roundtrips_shape():
+    interp = Interpreter(parse_module(MOD))
+    rule = interp.module.rules_named("violation")[0]
+    rendered = [unparse(lit) for lit in rule.body]
+    assert rendered[0] == "input.review.object.metadata.labels[k]"
+    assert rendered[1] == "k == 'bad'"
+    assert rendered[2] == "msg := helper(k)"
+
+
+def test_driver_trace_includes_steps():
+    d = LocalDriver()
+    c = Backend(d).new_client([K8sValidationTarget()])
+    c.add_template({
+        "apiVersion": "templates.gatekeeper.sh/v1alpha1",
+        "kind": "ConstraintTemplate",
+        "metadata": {"name": "k8sstepdeny"},
+        "spec": {"crd": {"spec": {"names": {"kind": "K8sStepDeny"}}},
+                 "targets": [{
+                     "target": TARGET_NAME,
+                     "rego": 'package x\nviolation[{"msg": "DENIED", '
+                             '"details": {}}] { 1 == 1 }'}]}})
+    c.add_constraint({"apiVersion": "constraints.gatekeeper.sh/v1alpha1",
+                      "kind": "K8sStepDeny", "metadata": {"name": "deny"},
+                      "spec": {}})
+    ns = {"apiVersion": "v1", "kind": "Namespace",
+          "metadata": {"name": "x"}}
+    results, trace = d.query_review(
+        TARGET_NAME,
+        {"kind": {"group": "", "version": "v1", "kind": "Namespace"},
+         "name": "x", "operation": "CREATE", "object": ns},
+        QueryOpts(tracing=True))
+    assert [r.msg for r in results] == ["DENIED"]
+    assert trace is not None and "steps:" in trace
+    assert "Enter violation" in trace and "Eval 1 == 1" in trace
+    assert "Exit violation" in trace

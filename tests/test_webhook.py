@@ -266,6 +266,45 @@ class TestHardening:
         finally:
             server.stop()
 
+    def test_slowloris_trickling_body_cut_at_deadline(self, handler):
+        """A client that keeps the connection LIVELY — one byte at a
+        time, never idle — must still be cut off by the wall-clock body
+        deadline (_DeadlineBody); the idle timeout alone never fires
+        for this client (round-4 advisor finding)."""
+        import socket
+        server = WebhookServer(handler, port=0, request_timeout=1.0)
+        server.start()
+        try:
+            s = socket.create_connection(("127.0.0.1", server.port),
+                                         timeout=5)
+            s.sendall(b"POST /v1/admit HTTP/1.1\r\nHost: x\r\n"
+                      b"Content-Length: 10000\r\n\r\n")
+            t0 = time.monotonic()
+            cut = None
+            for _ in range(200):           # ~0.05s per byte: never idle
+                try:
+                    s.sendall(b"x")
+                except OSError:
+                    cut = time.monotonic() - t0
+                    break
+                time.sleep(0.05)
+                # the server closing only surfaces on send on some
+                # platforms; poll for the FIN too
+                s.setblocking(False)
+                try:
+                    if s.recv(1) == b"":
+                        cut = time.monotonic() - t0
+                        break
+                except BlockingIOError:
+                    pass
+                finally:
+                    s.setblocking(True)
+            assert cut is not None, "trickling body was never cut off"
+            assert cut < 6, f"deadline fired late: {cut:.1f}s"
+            s.close()
+        finally:
+            server.stop()
+
     def test_stop_drains_inflight(self, handler):
         """stop() must let an in-flight admission finish (graceful
         drain), not kill it mid-response."""
