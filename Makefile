@@ -6,7 +6,7 @@
 IMG ?= gatekeeper-tpu:latest
 PY ?= python
 
-.PHONY: all native-test test soak bench bench-quick demo demo-agilebank manager worker \
+.PHONY: all native-test test soak bench bench-quick demo demo-basic demo-agilebank manager worker \
         docker-build deploy undeploy lint ci
 
 all: test
@@ -34,6 +34,10 @@ bench-quick:
 # demo/basic flow end-to-end (1k namespaces + required-labels template)
 demo:
 	$(PY) -m gatekeeper_tpu.cmd.manager --demo --port -1
+
+# demo/basic: the reference's scripted walkthrough with its fixture tree
+demo-basic:
+	$(PY) demo/basic/demo.py
 
 # demo/agilebank: multi-policy scenario with inventory join + audit
 demo-agilebank:

@@ -42,7 +42,8 @@ BENCH_r04 rc=124, parsed=null):
 
 Env knobs: GATEKEEPER_BENCH_N (north-star N), GATEKEEPER_BENCH_C
 (constraints per kind), GATEKEEPER_BENCH_QUICK=1 (shrink everything),
-GATEKEEPER_BENCH_BUDGET_S (global wall budget, default 2700).
+GATEKEEPER_BENCH_BUDGET_S (global wall budget, default 1500 — chosen
+to fire before the driver's external kill timeout).
 """
 
 from __future__ import annotations
@@ -107,11 +108,17 @@ _EMIT_LOCK = threading.Lock()
 _EMITTED = False
 _PARTIAL_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                              "BENCH_partial.json")
-GLOBAL_BUDGET_S = float(os.environ.get("GATEKEEPER_BENCH_BUDGET_S", "2700"))
+# Default chosen to sit INSIDE the driver's own kill timeout (the r4
+# capture was externally killed ≥26 min in, rc=124): the watchdog must
+# always fire first, because only it prints the headline on a breach.
+GLOBAL_BUDGET_S = float(os.environ.get("GATEKEEPER_BENCH_BUDGET_S", "1500"))
 
 # watchdog state: (phase name, absolute deadline)
 _PHASE = {"name": None, "deadline": None}
 _PHASE_LOCK = threading.Lock()
+
+
+_ABANDONED_THREADS: set = set()     # phase threads left behind at timeout
 
 
 def set_headline(value: float, vs_baseline: float,
@@ -119,6 +126,8 @@ def set_headline(value: float, vs_baseline: float,
     """Record the number of record the moment it exists — and surface
     it on stderr immediately, so even a capture that dies later still
     shows it in the tail."""
+    if threading.current_thread() in _ABANDONED_THREADS:
+        return      # a revived zombie phase must not overwrite the record
     HEADLINE["value"] = round(value, 1)
     HEADLINE["vs_baseline"] = round(vs_baseline, 2)
     if provisional:
@@ -197,37 +206,87 @@ def _watchdog() -> None:
                 os._exit(0)     # the exit must fire even if emit races
 
 
+_LEAKED_PHASES: list[str] = []
+
+
 def run_phase(name: str, fn, budget_s: float) -> None:
-    """Run one bench phase under the watchdog's per-phase budget.  A
-    phase that raises is recorded and skipped — later phases still run.
-    A phase that would not fit in the remaining global budget is
-    skipped outright."""
+    """Run one bench phase on a worker thread, joined with the phase's
+    wall-clock budget.  A phase that raises is recorded and skipped —
+    later phases still run.  A phase that HANGS (device op stuck in a
+    dying tunnel) is abandoned: its daemon thread is leaked, the run
+    demotes to scalar fallback, and later phases still produce numbers
+    (fallback phases never touch the device, so the leaked thread
+    cannot contend with them).  A phase that would not fit in the
+    remaining global budget is skipped outright."""
+    global FALLBACK
     phases = DETAIL.setdefault("phases", {})
     left = (_T0 + GLOBAL_BUDGET_S) - time.monotonic()
     if left < min(60.0, budget_s * 0.25):
         phases[name] = {"skipped": f"only {left:.0f}s of global budget left"}
         log(f"[{name}] skipped ({left:.0f}s of global budget left)")
         return
+    hang_hook = os.environ.get("GATEKEEPER_BENCH_TEST_HANG_PHASE") == name
+    if hang_hook:
+        budget_s = min(budget_s, 10.0)  # the test shouldn't wait long
+    budget_s = min(budget_s, max(left, 60.0))
     with _PHASE_LOCK:
         _PHASE["name"] = name
-        _PHASE["deadline"] = time.monotonic() + budget_s
+        # the watchdog backstops the join below (+grace), and still
+        # guards the global budget
+        _PHASE["deadline"] = time.monotonic() + budget_s + 30.0
     t0 = time.monotonic()
     rec = phases.setdefault(name, {})
-    try:
-        fn(DETAIL)
-        rec["ok"] = True
-    except Exception as e:      # noqa: BLE001 — a phase must not kill the run
+
+    def _body():
+        # phase fns write top-level detail keys; stage them in a
+        # private dict so a thread abandoned at timeout cannot later
+        # wake up and clobber results recorded after it (e.g. the
+        # fallback re-measure of the same phase)
+        local: dict = {}
+        try:
+            if hang_hook:
+                time.sleep(3600)    # test hook: simulated hung device op
+            fn(local)
+            if threading.current_thread() in _ABANDONED_THREADS:
+                return
+            DETAIL.update(local)
+            rec["ok"] = True
+        except Exception as e:  # noqa: BLE001 — a phase must not kill the run
+            if threading.current_thread() in _ABANDONED_THREADS:
+                return
+            rec["ok"] = False
+            rec["error"] = f"{type(e).__name__}: {e}"
+            log(f"[{name}] FAILED: {type(e).__name__}: {e}")
+
+    t = threading.Thread(target=_body, name=f"phase-{name}", daemon=True)
+    t.start()
+    t.join(budget_s)
+    if t.is_alive():
+        _ABANDONED_THREADS.add(t)
         rec["ok"] = False
-        rec["error"] = f"{type(e).__name__}: {e}"
-        log(f"[{name}] FAILED: {type(e).__name__}: {e}")
-    finally:
-        rec["wall_seconds"] = round(time.monotonic() - t0, 1)
-        rec["backend"] = "cpu-fallback" if FALLBACK else \
-            probe_devices().backend_label
-        with _PHASE_LOCK:
-            _PHASE["name"] = None
-            _PHASE["deadline"] = None
-        flush_partial()
+        rec["timed_out"] = True
+        _LEAKED_PHASES.append(name)
+        log(f"[{name}] TIMED OUT after {budget_s:.0f}s; abandoning the "
+            f"phase thread")
+        if not FALLBACK:
+            FALLBACK = True
+            DETAIL["backend"] = "cpu-fallback"
+            # one-way process-wide demotion: drivers constructed by
+            # later phases (incl. the north-star fallback re-measure)
+            # must see scalar_only=True, or their >20k-eval kinds
+            # would route straight back to the hung device
+            from gatekeeper_tpu.utils import device_probe
+            device_probe.mark_unavailable(
+                "device execution hung mid-bench; demoted to scalar")
+            log("[bench] demoting to FALLBACK sizing: the device path "
+                "hangs mid-execution")
+    rec["wall_seconds"] = round(time.monotonic() - t0, 1)
+    rec["backend"] = "cpu-fallback" if FALLBACK else \
+        probe_devices().backend_label
+    with _PHASE_LOCK:
+        _PHASE["name"] = None
+        _PHASE["deadline"] = None
+    flush_partial()
 
 
 def make_resources(n, rng):
@@ -993,11 +1052,20 @@ def main():
     run_phase("canary", bench_canary, 300)
     if DETAIL.get("phases", {}).get("canary", {}).get("ok") is False \
             and not FALLBACK:
-        # the tunnel answered the probe but cannot execute — demote
+        # the tunnel answered the probe but cannot execute — demote,
+        # process-wide, so every later driver constructs scalar-only
         FALLBACK = True
         DETAIL["backend"] = "cpu-fallback"
+        from gatekeeper_tpu.utils import device_probe
+        device_probe.mark_unavailable(
+            "device canary failed; demoted to scalar")
         log("[bench] canary failed; demoting to FALLBACK sizing")
-    run_phase("north_star", bench_north_star, 1500)
+    run_phase("north_star", bench_north_star, 1100)
+    if DETAIL["phases"].get("north_star", {}).get("timed_out"):
+        # the device run hung mid-execution (run_phase demoted us to
+        # fallback): re-measure at fallback sizing so the capture still
+        # carries a REAL north-star number, not a provisional canary
+        run_phase("north_star_fallback", bench_north_star, 400)
     quiesce_upgrades()
     run_phase("demo_basic", bench_demo_basic, 240)
     run_phase("allowed_repos", bench_allowed_repos, 240)
@@ -1011,6 +1079,13 @@ def main():
     run_phase("admission_replay", bench_admission_replay, 600)
     run_phase("admission_device_batch", bench_admission_device_batch, 400)
     emit_headline()
+    if _LEAKED_PHASES:
+        # abandoned phase threads are stuck inside C calls (a dying
+        # tunnel); normal interpreter teardown under them can abort
+        # AFTER the headline is out — exit hard instead
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os._exit(0)
 
 
 if __name__ == "__main__":

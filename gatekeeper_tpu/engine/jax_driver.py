@@ -36,6 +36,7 @@ rides the audit kernels via webhook batching (pkg webhook).
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import Any
 
 import numpy as np
@@ -107,14 +108,33 @@ wall on dispatch + fetch, not on prep the store could have done at
 write time (deliberately NOT test-overridable via
 SMALL_WORKLOAD_EVALS: tiny test ingests must stay cheap)."""
 
-REVIEW_BATCH_MIN_EVALS = 200_000
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+REVIEW_BATCH_MIN_EVALS = _env_int(
+    "GATEKEEPER_REVIEW_BATCH_MIN_EVALS", 200_000)
 """Below this many (review, constraint) pairs, a coalesced admission
-batch stays on the scalar engine.  Measured on the v5e behind the
-~100ms-per-fetch tunnel (bench_admission_device_batch): with 200
-constraints the device batch path only reaches scalar parity around
-batch 1024 (~200k evals) — per-batch prep + the fetch round-trip
-dominate below that.  On co-located TPU the crossover drops sharply;
-re-measure with bench.py when the transport changes."""
+batch stays on the scalar engine.
+
+Measured on the v5e behind the ~100ms-per-fetch tunnel
+(bench_admission_device_batch, with BOTH routing thresholds zeroed so
+every batch size actually runs the device path): with 200 constraints
+the device path only reaches scalar parity around batch 1024 (~200k
+evals) — per-batch prep + the fetch round-trip dominate below that.
+
+DELIBERATE SCOPE: with the webhook's default --max-batch 64 (and 200
+constraints = 12.8k evals), admission therefore never routes to a
+TUNNELED device — that dead zone is physics, not an accident: one
+tunnel round-trip (~100ms) costs more than the whole 64-review batch
+on the scalar engine (p50 well under 1ms/review).  On co-located
+TPU the crossover drops sharply; set
+GATEKEEPER_REVIEW_BATCH_MIN_EVALS from the crossover table bench.py
+emits (detail.admission_device_batch) for that transport.  See
+README "Device-batched admission"."""
 
 DEFAULT_PREWARM_CAP = 20
 """Cap assumed for prewarmed audit executables — the audit manager's

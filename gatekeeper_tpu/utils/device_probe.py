@@ -151,6 +151,18 @@ def _probe_locked(timeout_s: float) -> ProbeResult:
                        f"{n} {platform} device(s) in {took:.1f}s")
 
 
+def mark_unavailable(reason: str) -> None:
+    """Downgrade the process-wide verdict after the fact: an execution
+    (not the probe) discovered the backend hangs or died.  Every driver
+    constructed from now on serves scalar-only, and children get pinned
+    to cpu via child_env().  One-way: a dead tunnel does not come back
+    for this process (its in-flight op is still stuck)."""
+    global _RESULT
+    with _LOCK:
+        _RESULT = ProbeResult(False, 0, "", True, reason)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+
 def reset_for_tests() -> None:
     """Drop the cached verdict (tests only — a real process's verdict
     is immutable because a jax backend initializes once)."""

@@ -25,3 +25,8 @@ jax.config.update("jax_num_cpu_devices", 8)
 from gatekeeper_tpu.engine import jax_driver  # noqa: E402
 
 jax_driver.SMALL_WORKLOAD_EVALS = 0
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running end-to-end captures (bench runs)")

@@ -1,0 +1,68 @@
+"""The bench must produce a parsed number of record unconditionally
+(round-4 postmortem: one hung backend probe erased every config's
+numbers — BENCH_r04 rc=124, parsed=null).  These tests run bench.py as
+the driver does (a subprocess, stdout captured) under the two failure
+modes and require a parsed JSON line both times."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(env_extra: dict, timeout: int):
+    env = {**os.environ, **env_extra}
+    t0 = time.monotonic()
+    out = subprocess.run([sys.executable, "bench.py"], cwd=REPO, env=env,
+                         capture_output=True, text=True, timeout=timeout)
+    wall = time.monotonic() - t0
+    assert out.returncode == 0, out.stderr[-3000:]
+    lines = [ln for ln in out.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 1, f"bench must print ONE stdout line: {lines}"
+    return json.loads(lines[0]), wall, out.stderr
+
+
+@pytest.mark.slow
+def test_dead_tunnel_yields_parsed_fallback_capture():
+    doc, wall, _err = _run({
+        "GATEKEEPER_PROBE_TEST_HANG": "1",      # blackholed backend
+        "GATEKEEPER_DEVICE_PROBE_TIMEOUT_S": "2",
+        "GATEKEEPER_BENCH_BUDGET_S": "600",
+    }, timeout=700)
+    assert doc["detail"]["backend"] == "cpu-fallback"
+    assert doc["value"] > 0                     # a real number of record
+    assert doc["detail"]["north_star"]["steady_seconds"] > 0
+    phases = doc["detail"]["phases"]
+    assert phases["north_star"]["ok"]
+    # the device-batch phase cannot run without a device: recorded as
+    # an explicit skip, not silence
+    assert doc["detail"]["admission_device_batch"]["skipped"]
+    assert wall < 400, f"fallback capture took {wall:.0f}s"
+
+
+@pytest.mark.slow
+def test_hung_phase_is_abandoned_and_the_run_continues():
+    """A phase that hangs mid-run (device op stuck in a dying tunnel)
+    must not erase the already-measured headline NOR the rest of the
+    run: the phase thread is abandoned at its budget, the run demotes
+    to fallback sizing, and later phases still produce numbers."""
+    doc, wall, err = _run({
+        "GATEKEEPER_PROBE_TEST_HANG": "1",
+        "GATEKEEPER_DEVICE_PROBE_TIMEOUT_S": "2",
+        "GATEKEEPER_BENCH_TEST_HANG_PHASE": "library",
+        "GATEKEEPER_BENCH_BUDGET_S": "600",
+    }, timeout=700)
+    assert "TIMED OUT" in err
+    lib = doc["detail"]["phases"]["library"]
+    assert lib["timed_out"] and lib["ok"] is False
+    # the north star ran BEFORE the hang: its number survives
+    assert doc["value"] > 0
+    assert doc["detail"]["north_star"]["steady_seconds"] > 0
+    # phases AFTER the hang still ran
+    assert doc["detail"]["phases"]["regex_heavy"]["ok"]
+    assert doc["detail"]["phases"]["admission_replay"]["ok"]
