@@ -258,9 +258,15 @@ class LocalDriver(Driver):
 
     def _eval_pair(self, st: TargetState, target: str, compiled: CompiledTemplate,
                    review: dict, frozen_review: Any, constraint: dict,
-                   trace: list | None) -> Iterator[Result]:
+                   trace: list | None,
+                   shared: dict | None = None) -> Iterator[Result]:
         """One (review, constraint) evaluation — the regolib violation body
-        (src.go:19-34): input = {review, constraint}, data.inventory = inv."""
+        (src.go:19-34): input = {review, constraint}, data.inventory = inv.
+
+        ``shared``: per-review memo dict reused across the constraint
+        loop — review-pure comprehensions (rego/closures) evaluate once
+        per review instead of once per (review, constraint).  Skipped
+        under tracing (the tracer must observe evaluation)."""
         input_doc = Obj({"review": frozen_review,
                          "constraint": self._frozen_constraint(st, constraint)})
         # freezing the whole inventory is O(cache size); skip it for
@@ -274,8 +280,9 @@ class LocalDriver(Driver):
             # the stepped oracle path is confined to this debug surface
             from gatekeeper_tpu.rego.trace import StepTracer
             step = StepTracer()
-        for v in compiled.interp.query_set("violation", input_doc, inv,
-                                           tracer=tracer, step_tracer=step):
+        for v in compiled.interp.query_set(
+                "violation", input_doc, inv, tracer=tracer, step_tracer=step,
+                shared_memo=None if trace is not None else shared):
             if not isinstance(v, Obj) or "msg" not in v:
                 continue  # regolib accesses r.msg; absent msg -> no response
             details = v["details"] if "details" in v else Obj()
@@ -309,6 +316,8 @@ class LocalDriver(Driver):
             results.append(Result(msg=msg, metadata={"details": details},
                                   constraint=c, review=review))
         frozen_review = freeze(review)
+        shared: dict = {}    # one review, many constraints: share
+        #                      review-pure comprehension results
         for c in handler.matching_constraints(review, constraints, st.table):
             compiled = st.templates.get(c.get("kind", ""))
             if compiled is None:
@@ -317,7 +326,7 @@ class LocalDriver(Driver):
                 trace.append(f"eval {c.get('kind')}/{(c.get('metadata') or {}).get('name')} "
                              f"review={review.get('name')}")
             results.extend(self._eval_pair(st, target, compiled, review,
-                                           frozen_review, c, trace))
+                                           frozen_review, c, trace, shared))
         return results, ("\n".join(trace) if trace is not None else None)
 
     @locked_read
@@ -339,12 +348,14 @@ class LocalDriver(Driver):
                 continue
             review = handler.make_review(meta, obj)
             frozen_review = freeze(review)
+            shared: dict = {}
             for c in handler.matching_constraints(review, constraints, st.table):
                 compiled = st.templates.get(c.get("kind", ""))
                 if compiled is None:
                     continue
                 results.extend(self._eval_pair(st, target, compiled, review,
-                                               frozen_review, c, trace))
+                                               frozen_review, c, trace,
+                                               shared))
         return results, ("\n".join(trace) if trace is not None else None)
 
     @locked_read

@@ -613,6 +613,151 @@ class ClosureCompiler:
             yield from interp._eval_call(ctx, term, env)
         return special
 
+    def _review_shareable(self, term: Comprehension):
+        """None, or the sorted free-var names that key a per-review
+        shared-memo entry for this comprehension.
+
+        Eligible when evaluation can only depend on (a) input.review
+        paths and (b) variables visible in the entry env: no data/
+        inventory refs, no input.constraint (or whole-input) refs, no
+        rule or user-function references, no trace/clock builtins.
+        Every var name mentioned anywhere in the comprehension (except
+        wildcards and `input`) goes into the cache key from the ENTRY
+        env — vars bound only during body evaluation read as a
+        consistent miss sentinel there, and enclosing bindings (which
+        may carry constraint-derived values) key the entry correctly."""
+        interp = self.interp
+        impure = False
+        names: set[str] = set()
+
+        def visit(t):
+            nonlocal impure
+            if impure or t is None:
+                return
+            cls = t.__class__
+            if cls is Var:
+                if t.name == "input":
+                    # a BARE input var binds the whole document —
+                    # including .constraint (the Ref branch below
+                    # handles the safe input.review.* base inline, so
+                    # this branch only sees whole-input references)
+                    impure = True
+                    return
+                if t.is_wildcard:
+                    return
+                if t.name in interp.rules:
+                    impure = True       # rule value: may read constraint
+                else:
+                    names.add(t.name)
+                return
+            if cls is Ref:
+                base = t.base
+                if base.__class__ is Var:
+                    if base.name == "data":
+                        impure = True   # inventory / external docs
+                        return
+                    if base.name == "input":
+                        p0 = t.path[0] if t.path else None
+                        if not (p0 is not None and p0.__class__ is Scalar
+                                and p0.value == "review"):
+                            impure = True   # input.constraint / dynamic
+                            return
+                        for p in t.path:
+                            visit(p)
+                        return
+                visit(base)
+                for p in t.path:
+                    visit(p)
+                return
+            if cls is Call:
+                nm = t.name
+                if nm in (("trace",), ("time", "now_ns")) or \
+                        (len(nm) == 1 and nm[0] in interp.rules):
+                    impure = True       # side effects / per-query clock /
+                    return              # user functions (may read constraint)
+                for a in t.args:
+                    visit(a)
+                return
+            if cls is Scalar:
+                return
+            if cls is Comprehension:
+                for h in t.head:
+                    visit(h)
+                for lit in t.body:
+                    _visit_lit(lit)
+                return
+            if cls in (ArrayTerm, SetTerm):
+                for x in t.items:
+                    visit(x)
+                return
+            if cls is ObjectTerm:
+                for k, v in t.pairs:
+                    visit(k)
+                    visit(v)
+                return
+            if cls is BinOp:
+                visit(t.lhs)
+                visit(t.rhs)
+                return
+            if cls is UnaryMinus:
+                visit(t.operand)
+                return
+            impure = True               # unknown node kind: stay safe
+
+        def _visit_lit(lit):
+            nonlocal impure
+            if impure:
+                return
+            if lit.withs:
+                impure = True           # document override inside
+                return
+            e = lit.expr
+            if e.__class__ in (Compare, Assign):
+                visit(e.lhs)
+                visit(e.rhs)
+            elif e.__class__ is SomeDecl:
+                names.update(e.names)
+            else:
+                visit(e)
+
+        for h in term.head:
+            visit(h)
+        for lit in term.body:
+            _visit_lit(lit)
+        return None if impure else tuple(sorted(names))
+
+    def _memoize_review_pure(self, term: Comprehension,
+                             inner: Callable) -> Callable:
+        free = self._review_shareable(term)
+        if free is None:
+            return inner
+        tid = id(term)
+
+        def memo(ctx, env):
+            cache = ctx.shared
+            inp = ctx.input
+            rev = inp["review"] if isinstance(inp, Obj) and "review" in inp \
+                else _MISS
+            if cache is None or rev is _MISS:
+                yield from inner(ctx, env)
+                return
+            # the review object's identity is part of the entry and is
+            # verified on hit: a memo dict (wrongly) reused across
+            # reviews misses instead of serving another review's value
+            key = (tid,) + tuple(env.get(v, _MISS) for v in free)
+            hit = cache.get(key)
+            if hit is not None and hit[0] is rev:
+                yield hit[1], env
+                return
+            got = _MISS
+            for v, _ in inner(ctx, env):
+                got = v                 # comprehensions yield exactly once
+            if got is _MISS:
+                return                  # defensive: nothing to cache
+            cache[key] = (rev, got)
+            yield got, env
+        return memo
+
     def _compile_comprehension(self, term: Comprehension) -> Callable:
         body = self.body(term.body)
         kind = term.kind
@@ -625,7 +770,7 @@ class ClosureCompiler:
                     for v, _ in head(ctx, env2):
                         out.append(v)
                 yield tuple(out), env
-            return arr
+            return self._memoize_review_pure(term, arr)
         if kind == "set":
             head = self.term(term.head[0])
 
@@ -638,7 +783,7 @@ class ClosureCompiler:
                             seen.add(v)
                             out.append(v)
                 yield frozenset(out), env
-            return st
+            return self._memoize_review_pure(term, st)
         khead = self.term(term.head[0])
         vhead = self.term(term.head[1])
         from gatekeeper_tpu.errors import ConflictError
@@ -653,4 +798,4 @@ class ClosureCompiler:
                                 "object comprehension: conflicting keys")
                         pairs[k] = v
             yield Obj(pairs), env
-        return objc
+        return self._memoize_review_pure(term, objc)

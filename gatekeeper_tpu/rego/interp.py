@@ -44,6 +44,10 @@ class _Ctx:
     # evaluation routes through the recursive oracle (closures bypassed:
     # the tracer must observe every literal)
     step: Any = None
+    # cross-query memo shared by all constraint evaluations of ONE
+    # review (rego/closures review-pure comprehension cache) — the
+    # driver passes a fresh dict per review; None disables
+    shared: Any = None
 
 
 class Interpreter:
@@ -99,9 +103,10 @@ class Interpreter:
 
     def query_set(self, name: str, input_doc: Any = UNDEFINED,
                   data_doc: Any = None, tracer: list | None = None,
-                  step_tracer=None) -> list:
+                  step_tracer=None, shared_memo: dict | None = None) -> list:
         """Evaluate a partial-set rule; returns its members (frozen values)."""
-        ctx = self._ctx(input_doc, data_doc, tracer, step_tracer)
+        ctx = self._ctx(input_doc, data_doc, tracer, step_tracer,
+                        shared_memo)
         st = ctx.step
         if st is not None:
             st.enter(name)
@@ -125,12 +130,13 @@ class Interpreter:
         ctx = self._ctx(input_doc, data_doc, tracer, step_tracer)
         return self._rule_value(ctx, name)
 
-    def _ctx(self, input_doc, data_doc, tracer, step_tracer=None) -> _Ctx:
+    def _ctx(self, input_doc, data_doc, tracer, step_tracer=None,
+             shared_memo=None) -> _Ctx:
         if input_doc is not UNDEFINED:
             input_doc = freeze(input_doc)
         data = freeze(data_doc) if data_doc is not None else Obj()
         return _Ctx(input=input_doc, data=data, tracer=tracer, memo={},
-                    step=step_tracer)
+                    step=step_tracer, shared=shared_memo)
 
     # ------------------------------------------------------------------
     # rule evaluation
@@ -351,8 +357,10 @@ class Interpreter:
         # builtin cache also survives `with`)
         if ("time.now_ns",) in ctx.memo:
             memo[("time.now_ns",)] = ctx.memo[("time.now_ns",)]
+        # the shared (per-review) memo keys on the ORIGINAL input
+        # document; under an overridden input/data it must not serve
         return dataclasses.replace(ctx, input=new_input, data=new_data,
-                                   memo=memo)
+                                   memo=memo, shared=None)
 
     def _eval_expr(self, ctx: _Ctx, expr, env: dict) -> Iterator[dict]:
         if isinstance(expr, Assign):

@@ -1,0 +1,103 @@
+"""Per-review shared memo for review-pure comprehensions
+(rego/closures._memoize_review_pure): one evaluation per review across
+the constraint loop, never shared where results could differ."""
+
+from gatekeeper_tpu.rego import parse_module
+from gatekeeper_tpu.rego.interp import Interpreter
+from gatekeeper_tpu.rego.values import Obj, freeze
+
+MOD = """package t
+
+violation[{"msg": m}] {
+	provided := {l | input.review.object.metadata.labels[l]}
+	required := {l | l := input.constraint.spec.parameters.labels[_]}
+	missing := required - provided
+	count(missing) > 0
+	m := sprintf("missing: %v", [missing])
+}
+"""
+
+
+def _input(labels, required):
+    return Obj({"review": freeze({"object": {"metadata":
+                                             {"labels": labels}}}),
+                "constraint": freeze({"spec": {"parameters":
+                                               {"labels": required}}})})
+
+
+def test_shared_memo_caches_review_pure_only():
+    interp = Interpreter(parse_module(MOD))
+    shared: dict = {}
+    # same review, two different constraints: the provided-comprehension
+    # is cached; required/missing still differ per constraint
+    out1 = interp.query_set("violation", _input({"a": "1"}, ["a", "b"]),
+                            shared_memo=shared)
+    assert len(shared) == 1          # exactly the review-pure entry
+    out2 = interp.query_set("violation", _input({"a": "1"}, ["c"]),
+                            shared_memo=shared)
+    assert len(shared) == 1          # reused, not regrown
+    assert [str(v["msg"]) for v in out1] == ['missing: {"b"}']
+    assert [str(v["msg"]) for v in out2] == ['missing: {"c"}']
+
+
+def test_results_match_unshared():
+    interp = Interpreter(parse_module(MOD))
+    shared: dict = {}
+    for labels, req in ((({"a": "1", "b": "2"}), ["a", "b"]),
+                        (({"x": "1"}), ["a"]),
+                        ({}, ["z"])):
+        with_memo = interp.query_set("violation", _input(labels, req),
+                                     shared_memo=shared)
+        without = interp.query_set("violation", _input(labels, req))
+        assert with_memo == without
+
+
+def test_constraint_reading_comprehension_not_shared():
+    interp = Interpreter(parse_module(MOD))
+    from gatekeeper_tpu.rego.ast_nodes import Comprehension
+    comp = [t for r in interp.module.rules for lit in r.body
+            for t in [lit.expr.rhs] if isinstance(t, Comprehension)]
+    shareable = [interp._closures._review_shareable(c) for c in comp]
+    # provided (review-pure) shareable; required (reads constraint) not
+    assert shareable.count(None) == 1
+    assert sum(1 for s in shareable if s is not None) == 1
+
+
+def test_whole_input_binding_not_shared():
+    """A comprehension that binds the WHOLE input document (`i :=
+    input`) can reach input.constraint through the binding — it must
+    never be classified review-pure (round-5 review finding, was
+    serving constraint A's result to constraint B)."""
+    mod = """package t
+violation[{"msg": m}] {
+	vals := {v | i := input; v := i.constraint.spec.parameters.labels[_]}
+	m := sprintf("vals: %v", [vals])
+}
+"""
+    interp = Interpreter(parse_module(mod))
+    shared: dict = {}
+
+    def q(labels):
+        doc = Obj({"review": freeze({"object": {}}),
+                   "constraint": freeze({"spec": {"parameters":
+                                                  {"labels": labels}}})})
+        return [str(v["msg"]) for v in
+                interp.query_set("violation", doc, shared_memo=shared)]
+
+    assert q(["a"]) == ['vals: {"a"}']
+    assert q(["b"]) == ['vals: {"b"}']
+
+
+def test_with_override_bypasses_shared():
+    mod = """package t
+p := {l | input.review.object.metadata.labels[l]}
+violation[{"msg": "x"}] {
+	q := p with input as {"review": {"object": {"metadata": {"labels": {"zz": 1}}}}}
+	q["zz"]
+}
+"""
+    interp = Interpreter(parse_module(mod))
+    shared: dict = {}
+    out = interp.query_set("violation", _input({"a": "1"}, []),
+                           shared_memo=shared)
+    assert len(out) == 1    # the with-override saw zz, not the cached {a}
