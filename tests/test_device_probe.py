@@ -96,6 +96,61 @@ def test_hung_backend_serves_scalar_within_deadline():
     assert wall < 90, f"fallback path took {wall:.0f}s"
 
 
+def test_mark_unavailable_demotes_future_drivers():
+    """The bench (and entry()) downgrade the process verdict when an
+    EXECUTION hangs after a successful probe — drivers constructed
+    after mark_unavailable() must serve scalar-only, and children must
+    be pinned to cpu."""
+    code = textwrap.dedent("""
+        import sys
+        sys.path.insert(0, %r)
+        from gatekeeper_tpu.utils.device_probe import (
+            child_env, mark_unavailable, probe_devices)
+        res = probe_devices()        # healthy cpu probe first
+        assert res.ok, res
+        mark_unavailable("simulated mid-run hang")
+        res2 = probe_devices()
+        assert not res2.ok and "simulated" in res2.reason, res2
+        assert child_env()["JAX_PLATFORMS"] == "cpu"
+        from gatekeeper_tpu.engine.jax_driver import JaxDriver
+        assert JaxDriver().scalar_only
+        print("DEMOTED-OK")
+    """ % REPO)
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    out = subprocess.run([sys.executable, "-c", code], env=env, cwd=REPO,
+                         capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stdout[-2000:] + out.stderr[-2000:]
+    assert "DEMOTED-OK" in out.stdout
+
+
+def test_entry_compile_check_survives_hung_backend():
+    """__graft_entry__.entry() — the driver's single-chip compile check
+    — must complete on cpu when the default backend hangs (its
+    subprocess probe honors the same simulation hook and timeout knob
+    as the rest of the stack)."""
+    code = textwrap.dedent("""
+        import sys
+        sys.path.insert(0, %r)
+        import __graft_entry__ as g
+        import jax
+        fn, args = g.entry()
+        out = jax.jit(fn)(*args)
+        from gatekeeper_tpu.utils.device_probe import probe_devices, child_env
+        res = probe_devices()
+        assert not res.ok and "entry() subprocess probe" in res.reason, res
+        assert child_env()["JAX_PLATFORMS"] == "cpu"
+        print("ENTRY-FALLBACK-OK", [o.shape for o in out])
+    """ % REPO)
+    env = {**os.environ,
+           "GATEKEEPER_PROBE_TEST_HANG": "1",
+           "GATEKEEPER_DEVICE_PROBE_TIMEOUT_S": "3",
+           "JAX_PLATFORMS": ""}    # let the (hanging) default resolve
+    out = subprocess.run([sys.executable, "-c", code], env=env, cwd=REPO,
+                         capture_output=True, text=True, timeout=180)
+    assert out.returncode == 0, out.stdout[-2000:] + out.stderr[-2000:]
+    assert "ENTRY-FALLBACK-OK" in out.stdout
+
+
 def test_worker_starts_with_hung_backend():
     """The engine worker (round-4: hung indefinitely) must come up and
     serve when the backend probe hangs."""
