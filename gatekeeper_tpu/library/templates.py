@@ -513,6 +513,78 @@ violation[{"msg": msg}] {
 """)
 
 
+_t("K8sDisallowInteractiveTTY", {})("""package k8sdisallowinteractivetty
+violation[{"msg": msg}] {
+  container := input.review.object.spec.containers[_]
+  container.tty == true
+  msg := sprintf("container <%v> must not allocate a TTY", [container.name])
+}
+violation[{"msg": msg}] {
+  container := input.review.object.spec.containers[_]
+  container.stdin == true
+  msg := sprintf("container <%v> must not keep stdin open", [container.name])
+}
+""")
+
+_t("K8sPodDisruptionBudget", {})("""package k8spoddisruptionbudget
+violation[{"msg": msg}] {
+  input.review.object.kind == "PodDisruptionBudget"
+  input.review.object.spec.maxUnavailable == 0
+  msg := "PodDisruptionBudget with maxUnavailable 0 blocks all evictions"
+}
+""")
+
+_t("K8sStorageClass", {"allowedStorageClasses": ["standard", "ssd"]})("""package k8sstorageclass
+violation[{"msg": msg}] {
+  input.review.object.kind == "PersistentVolumeClaim"
+  sc := input.review.object.spec.storageClassName
+  allowed := {s | s := input.constraint.spec.parameters.allowedStorageClasses[_]}
+  not allowed[sc]
+  msg := sprintf("storageClassName <%v> is not allowed", [sc])
+}
+""")
+
+_t("K8sRequiredResources", {"limits": ["cpu", "memory"],
+                            "requests": ["cpu"]})("""package k8srequiredresources
+violation[{"msg": msg}] {
+  container := input.review.object.spec.containers[_]
+  field := input.constraint.spec.parameters.limits[_]
+  not container.resources.limits[field]
+  msg := sprintf("container <%v> has no resources.limits.%v", [container.name, field])
+}
+violation[{"msg": msg}] {
+  container := input.review.object.spec.containers[_]
+  field := input.constraint.spec.parameters.requests[_]
+  not container.resources.requests[field]
+  msg := sprintf("container <%v> has no resources.requests.%v", [container.name, field])
+}
+""")
+
+_t("K8sPriorityClass", {"allowed": ["system-cluster-critical",
+                                    "high", "default"]})("""package k8spriorityclass
+violation[{"msg": msg}] {
+  input.review.object.kind == "Pod"
+  pc := input.review.object.spec.priorityClassName
+  allowed := {p | p := input.constraint.spec.parameters.allowed[_]}
+  not allowed[pc]
+  msg := sprintf("priorityClassName <%v> is not allowed", [pc])
+}
+""")
+
+_t("K8sImagePullSecrets", {})("""package k8simagepullsecrets
+violation[{"msg": msg}] {
+  input.review.object.kind == "Pod"
+  not input.review.object.spec.imagePullSecrets
+  msg := "pod must specify imagePullSecrets"
+}
+violation[{"msg": msg}] {
+  input.review.object.kind == "Pod"
+  count(input.review.object.spec.imagePullSecrets) == 0
+  msg := "pod must specify at least one imagePullSecret"
+}
+""")
+
+
 def all_docs() -> list[tuple[dict, dict]]:
     """(template_doc, sample constraint_doc) for every library entry."""
     out = []

@@ -12,7 +12,8 @@ def make_mixed(rng, n):
     out = []
     for i in range(n):
         kind = rng.choice(["Pod", "Pod", "Pod", "Service", "Ingress",
-                           "Deployment", "RoleBinding"])
+                           "Deployment", "RoleBinding",
+                           "PersistentVolumeClaim", "PodDisruptionBudget"])
         ns = rng.choice(["default", "prod", "dev"])
         meta = {"name": f"{kind.lower()}{i}", "namespace": ns}
         if rng.random() < 0.7:
@@ -53,8 +54,18 @@ def make_mixed(rng, n):
                                    "hostPort": rng.choice([80, 8080, 30000])}]
                 if rng.random() < 0.5:
                     c["imagePullPolicy"] = rng.choice(["Always", "IfNotPresent"])
+                if rng.random() < 0.15:
+                    c["tty"] = True
+                if rng.random() < 0.15:
+                    c["stdin"] = True
                 containers.append(c)
             spec = {"containers": containers}
+            if rng.random() < 0.4:
+                spec["priorityClassName"] = rng.choice(
+                    ["default", "high", "low", "batch"])
+            if rng.random() < 0.5:
+                spec["imagePullSecrets"] = rng.choice(
+                    [[], [{"name": "regcred"}]])
             if rng.random() < 0.2:
                 spec["hostPID"] = True
             if rng.random() < 0.2:
@@ -99,6 +110,18 @@ def make_mixed(rng, n):
             out.append({"apiVersion": "apps/v1", "kind": "Deployment",
                         "metadata": meta,
                         "spec": {"replicas": rng.choice([0, 1, 3, 80])}})
+        elif kind == "PersistentVolumeClaim":
+            out.append({"apiVersion": "v1", "kind": "PersistentVolumeClaim",
+                        "metadata": meta,
+                        "spec": {"storageClassName": rng.choice(
+                            ["standard", "ssd", "scratch", "legacy-nfs"]),
+                            "resources": {"requests": {"storage": "10Gi"}}}})
+        elif kind == "PodDisruptionBudget":
+            spec = ({"maxUnavailable": rng.choice([0, 1, 2])}
+                    if rng.random() < 0.6 else
+                    {"minAvailable": rng.choice([1, "50%"])})
+            out.append({"apiVersion": "policy/v1", "kind": "PodDisruptionBudget",
+                        "metadata": meta, "spec": spec})
         else:
             out.append({"apiVersion": "rbac.authorization.k8s.io/v1",
                         "kind": "RoleBinding", "metadata": meta,

@@ -151,6 +151,17 @@ def _probe_locked(timeout_s: float) -> ProbeResult:
                        f"{n} {platform} device(s) in {took:.1f}s")
 
 
+def mark_cpu_pinned(n_devices: int, reason: str) -> None:
+    """Record an OK-on-cpu verdict after a caller repinned the process
+    to the cpu platform (entry()'s subprocess-probe fallback): jax
+    remains usable — later drivers keep the vectorized cpu path — and
+    children are pinned via the env rather than by a failed verdict."""
+    global _RESULT
+    with _LOCK:
+        _RESULT = ProbeResult(True, n_devices, "cpu", False, reason)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+
 def mark_unavailable(reason: str) -> None:
     """Downgrade the process-wide verdict after the fact: an execution
     (not the probe) discovered the backend hangs or died.  Every driver
