@@ -50,8 +50,15 @@ def test_library_driver_parity():
                           if t.vectorized is not None)
     assert res["local"] == res["jax"]
     assert len(res["local"]) > 50
-    # most of the library must ride the device path, not the fallback
-    assert lowered >= 38, f"only {lowered} lowered"
+    # the driver's lowered count must match the committed bucket table
+    # exactly (library entries classified device-lowered)
+    from gatekeeper_tpu.library.buckets import load_committed
+    expect = sum(1 for k, v in load_committed().items()
+                 if k in LIBRARY and v == "device-lowered")
+    assert lowered == expect, f"{lowered} lowered, bucket table says {expect}"
+    # absolute backstop: a regenerated table must never quietly bless a
+    # broad lowering regression — most of the library rides the device
+    assert expect >= 40, f"device-lowered floor broken: {expect}"
 
 
 def test_library_every_template_can_fire():
