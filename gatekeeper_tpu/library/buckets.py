@@ -1,6 +1,6 @@
 """Lowering-bucket inventory for the shipped template corpus.
 
-Every template the build ships (the 46-template library plus the demo
+Every template the build ships (the 49-template library plus the demo
 templates) is classified into exactly one evaluation bucket:
 
 - ``device-lowered``   — compiles to the tensor IR; audits run on the

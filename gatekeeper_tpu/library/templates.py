@@ -585,6 +585,54 @@ violation[{"msg": msg}] {
 """)
 
 
+_t("K8sProhibitRoleWildcardAccess", {})("""package k8sprohibitrolewildcardaccess
+violation[{"msg": msg}] {
+  rule := input.review.object.rules[_]
+  verb := rule.verbs[_]
+  verb == "*"
+  msg := sprintf("role <%v> grants wildcard verbs", [input.review.object.metadata.name])
+}
+violation[{"msg": msg}] {
+  rule := input.review.object.rules[_]
+  resource := rule.resources[_]
+  resource == "*"
+  msg := sprintf("role <%v> grants wildcard resources", [input.review.object.metadata.name])
+}
+violation[{"msg": msg}] {
+  rule := input.review.object.rules[_]
+  group := rule.apiGroups[_]
+  group == "*"
+  msg := sprintf("role <%v> grants wildcard apiGroups", [input.review.object.metadata.name])
+}
+""")
+
+_t("K8sMemoryRequestEqualsLimit", {})("""package k8smemoryrequestequalslimit
+canonify_mem(orig) = new { is_number(orig); new := orig }
+else = new { new := units.parse_bytes(orig) }
+violation[{"msg": msg}] {
+  container := input.review.object.spec.containers[_]
+  req := canonify_mem(container.resources.requests.memory)
+  lim := canonify_mem(container.resources.limits.memory)
+  req != lim
+  msg := sprintf("container <%v> memory request must equal its limit", [container.name])
+}
+violation[{"msg": msg}] {
+  container := input.review.object.spec.containers[_]
+  container.resources.limits.memory
+  not container.resources.requests.memory
+  msg := sprintf("container <%v> sets a memory limit but no memory request", [container.name])
+}
+""")
+
+_t("K8sContainerEnvMaxVars", {"max": 2})("""package k8scontainerenvmaxvars
+violation[{"msg": msg}] {
+  container := input.review.object.spec.containers[_]
+  count(container.env) > input.constraint.spec.parameters.max
+  msg := sprintf("container <%v> has more than %v env vars", [container.name, input.constraint.spec.parameters.max])
+}
+""")
+
+
 def all_docs() -> list[tuple[dict, dict]]:
     """(template_doc, sample constraint_doc) for every library entry."""
     out = []

@@ -1,7 +1,7 @@
 """Synthetic mixed K8s workloads exercising the whole template
 library (pods incl. security contexts/probes/env/ports, services,
-ingresses, deployments, rolebindings) — shared by the library tests
-and the full-library bench config (BASELINE.md).
+ingresses, deployments, roles, rolebindings, PVCs, PDBs) — shared by
+the library tests and the full-library bench config (BASELINE.md).
 """
 
 from __future__ import annotations
@@ -12,7 +12,7 @@ def make_mixed(rng, n):
     out = []
     for i in range(n):
         kind = rng.choice(["Pod", "Pod", "Pod", "Service", "Ingress",
-                           "Deployment", "RoleBinding",
+                           "Deployment", "RoleBinding", "Role",
                            "PersistentVolumeClaim", "PodDisruptionBudget"])
         ns = rng.choice(["default", "prod", "dev"])
         meta = {"name": f"{kind.lower()}{i}", "namespace": ns}
@@ -34,7 +34,10 @@ def make_mixed(rng, n):
                         "limits": {"cpu": rng.choice(["100m", "1", "4", 2]),
                                    "memory": rng.choice(["256Mi", "1Gi", "4Gi"])},
                         "requests": {"cpu": rng.choice(["50m", "1"]),
-                                     "memory": "128Mi"}}
+                                     "memory": rng.choice(
+                                         ["128Mi", "1Gi", "1024Mi"])}}
+                    if rng.random() < 0.2:
+                        del c["resources"]["requests"]
                 if rng.random() < 0.4:
                     c["securityContext"] = {
                         "privileged": rng.random() < 0.3,
@@ -47,8 +50,10 @@ def make_mixed(rng, n):
                 if rng.random() < 0.3:
                     c["readinessProbe"] = {"httpGet": {"path": "/", "port": 80}}
                 if rng.random() < 0.3:
-                    c["env"] = [{"name": rng.choice(["API_TOKEN", "HOME", "DB_PASSWORD"]),
-                                 "value": "x"}]
+                    c["env"] = [{"name": nm, "value": "x"} for nm in
+                                rng.sample(["API_TOKEN", "HOME",
+                                            "DB_PASSWORD", "MODE", "REGION"],
+                                           k=rng.randint(1, 4))]
                 if rng.random() < 0.2:
                     c["ports"] = [{"containerPort": 80,
                                    "hostPort": rng.choice([80, 8080, 30000])}]
@@ -110,6 +115,15 @@ def make_mixed(rng, n):
             out.append({"apiVersion": "apps/v1", "kind": "Deployment",
                         "metadata": meta,
                         "spec": {"replicas": rng.choice([0, 1, 3, 80])}})
+        elif kind == "Role":
+            out.append({"apiVersion": "rbac.authorization.k8s.io/v1",
+                        "kind": "Role", "metadata": meta,
+                        "rules": [{"apiGroups": rng.choice([[""], ["*"],
+                                                            ["apps"]]),
+                                   "resources": rng.choice(
+                                       [["pods"], ["*"], ["pods", "services"]]),
+                                   "verbs": rng.choice(
+                                       [["get", "list"], ["*"], ["watch"]])}]})
         elif kind == "PersistentVolumeClaim":
             out.append({"apiVersion": "v1", "kind": "PersistentVolumeClaim",
                         "metadata": meta,
