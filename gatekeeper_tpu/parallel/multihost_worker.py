@@ -46,7 +46,56 @@ def main(process_id: int, num_processes: int, coordinator: str) -> None:
     assert int(counts.sum()) > 0
     print(f"MULTIHOST OK rank={process_id} counts={counts.tolist()}",
           flush=True)
-    jax.distributed.shutdown()
+
+    # the DRIVER path over the process-spanning mesh: multiple kinds
+    # must launch their collective executables in the SAME order on
+    # every rank (sorted-kind serial dispatch — see the scope note on
+    # veval._COLLECTIVE_EXEC_LOCK); different orders would deadlock
+    # the cross-process rendezvous this block exists to exercise.
+    import random
+
+    from gatekeeper_tpu.client.client import Backend
+    from gatekeeper_tpu.client.interface import QueryOpts
+    from gatekeeper_tpu.engine import jax_driver as jd_mod
+    from gatekeeper_tpu.engine.jax_driver import JaxDriver
+    from gatekeeper_tpu.engine.veval import mesh_spans_processes
+    from gatekeeper_tpu.library import constraint_doc, template_doc
+    from gatekeeper_tpu.library.templates import LIBRARY
+    from gatekeeper_tpu.target.k8s import K8sValidationTarget, TARGET_NAME
+
+    small = jd_mod.SMALL_WORKLOAD_EVALS
+    jd_mod.SMALL_WORKLOAD_EVALS = 0     # tiny shapes must still shard
+    try:                                # shutdown in the finally: a rank
+        #                                 dying mid-block must not leave
+        #                                 its peer parked in a rendezvous
+        jd = JaxDriver()
+        assert jd.executor.mesh is not None
+        assert mesh_spans_processes(jd.executor.mesh)
+        client = Backend(jd).new_client([K8sValidationTarget()])
+        rng = random.Random(7)          # same seed => same data per rank
+        for kind in ("K8sRequiredLabels", "K8sAllowedRepos",
+                     "K8sDisallowLatestTag"):
+            client.add_template(template_doc(kind, LIBRARY[kind][0]))
+            client.add_constraint(
+                constraint_doc(kind, kind.lower(), LIBRARY[kind][1]))
+        for i in range(48):
+            client.add_data({
+                "apiVersion": "v1", "kind": "Pod",
+                "metadata": {"name": f"p{i:03d}", "namespace": "d",
+                             "labels": ({"owner": "x"}
+                                        if rng.random() < 0.5 else {})},
+                "spec": {"containers": [{
+                    "name": "c",
+                    "image": rng.choice(["gcr.io/a:latest",
+                                         "docker.io/b:1"])}]}})
+        res, _ = jd.query_audit(TARGET_NAME,
+                                QueryOpts(limit_per_constraint=20))
+        assert res, "driver audit over the spanning mesh returned nothing"
+        print(f"MULTIHOST DRIVER OK rank={process_id} results={len(res)}",
+              flush=True)
+    finally:
+        jd_mod.SMALL_WORKLOAD_EVALS = small
+        jax.distributed.shutdown()
 
 
 if __name__ == "__main__":

@@ -146,24 +146,42 @@ def make_sharded_audit_fn(program: Program, names: tuple[str, ...],
     return jax.jit(stepped)
 
 
+def _spans_processes(mesh: Mesh) -> bool:
+    # single source of truth for the spanning predicate (the documented
+    # anchor for cross-host collective-ordering discipline lives there)
+    from gatekeeper_tpu.engine.veval import mesh_spans_processes
+    return mesh_spans_processes(mesh)
+
+
 def make_sharded_topk_packed(program: Program, names: tuple[str, ...],
                              specs: dict[str, P], mesh: Mesh, k: int,
                              r_pad: int):
     """Unjitted shard-mapped capped audit packing (counts, rows, valid)
     into ONE [C, 1+2k] int32 array — the multi-chip twin of the
     executor's single-device topk raw fn (one fetch round-trip per
-    kind through a tunneled accelerator)."""
+    kind through a tunneled accelerator).
+
+    On a mesh spanning processes the packed result is additionally
+    all_gathered over `c` so the output is fully replicated: every
+    rank then fetches from its local replica (a c-sharded output spans
+    non-addressable devices, which jax.Arrays cannot materialize).
+    The gather is [C, 1+2k] int32 — trivial next to the eval."""
     local_step = _topk_local_step(program, names, k, r_pad,
                                   mesh.shape["r"])
+    spans = _spans_processes(mesh)
 
     def packed_step(*args):
         counts, rows, valid = local_step(*args)
-        return jnp.concatenate(
+        packed = jnp.concatenate(
             [counts[:, None], rows, valid.astype(jnp.int32)], axis=1)
+        if spans:
+            packed = jax.lax.all_gather(packed, "c", axis=0, tiled=True)
+        return packed
 
     in_specs = tuple(specs[nm] for nm in names)
     stepped = shard_map(packed_step, mesh=mesh, in_specs=in_specs,
-                        out_specs=P("c", None), check_vma=False)
+                        out_specs=P(None, None) if spans else P("c", None),
+                        check_vma=False)
 
     def raw(args: tuple):
         return stepped(*args)
@@ -174,15 +192,26 @@ def make_sharded_mask_fn(program: Program, names: tuple[str, ...],
                          specs: dict[str, P], mesh: Mesh):
     """Unjitted shard-mapped full violation mask [C, R] (sharded over
     both mesh axes) — the multi-chip twin of the executor's mask-mode
-    raw fn (the capped path's under-fill fallback)."""
+    raw fn (the capped path's under-fill fallback).
+
+    On a process-spanning mesh the mask is all_gathered to full
+    replication so every rank can fetch it locally — acceptable for
+    this fallback/debug path (the serving path is the packed top-k,
+    whose replicated output is [C, 1+2k], not [C, R])."""
     from gatekeeper_tpu.engine.veval import _eval_mask
+    spans = _spans_processes(mesh)
 
     def local_step(*args):
-        return _eval_mask(program, dict(zip(names, args)))
+        m = _eval_mask(program, dict(zip(names, args)))
+        if spans:
+            m = jax.lax.all_gather(m, "r", axis=1, tiled=True)
+            m = jax.lax.all_gather(m, "c", axis=0, tiled=True)
+        return m
 
     in_specs = tuple(specs[nm] for nm in names)
     stepped = shard_map(local_step, mesh=mesh, in_specs=in_specs,
-                        out_specs=P("c", "r"), check_vma=False)
+                        out_specs=P(None, None) if spans else P("c", "r"),
+                        check_vma=False)
 
     def raw(args: tuple):
         return stepped(*args)
