@@ -1,0 +1,243 @@
+"""Self-test probe: run the conformance scenario table against a live
+driver.
+
+Native port of the constraint framework's Probe
+(vendor/.../constraint/pkg/client/probe_client.go:10-50): wrap a
+Driver in a fresh Backend/Client over the built-in probe target and
+expose each e2e scenario (e2e_tests.go) as a runnable check.  The
+framework ships this so an embedding application can self-validate an
+engine at startup/readiness; a failure message carries the engine
+dump, exactly like the Go (`probe_client.go:42-46`).
+
+The scenario semantics are the same table
+tests/test_client_conformance.py pins in CI; the probe is the
+runtime-callable twin.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from gatekeeper_tpu.client.targets import TargetHandler, UnhandledData
+from gatekeeper_tpu.client.types import Result
+from gatekeeper_tpu.store.table import ResourceMeta
+
+
+class ProbeTarget(TargetHandler):
+    """The probe's target handler — a native transcription of the
+    framework's test handler (vendor/.../client/test_handler.go:14-119):
+    data keyed by Name, constraints match when their kind equals the
+    review's ForConstraint, autoreject when a constraint carries a
+    namespaceSelector while no v1/Namespace is cached."""
+
+    name = "probe.target"
+
+    def process_data(self, obj):
+        if isinstance(obj, dict) and "Name" in obj:
+            meta = ResourceMeta(api_version="v1", kind="ProbeData",
+                                name=obj["Name"], namespace=None)
+            return obj["Name"], meta, obj
+        raise UnhandledData(f"unhandled: {obj!r}")
+
+    def handle_review(self, obj):
+        if isinstance(obj, dict) and "Name" in obj:
+            return obj
+        raise UnhandledData(f"unhandled review: {obj!r}")
+
+    def handle_violation(self, result: Result):
+        result.resource = result.review
+
+    def match_schema(self):
+        return {"properties": {"label": {"type": "string"}}}
+
+    def validate_constraint(self, constraint):
+        return None
+
+    def make_review(self, meta, obj):
+        return obj
+
+    def matching_constraints(self, review, constraints, table):
+        for c in constraints:
+            if c.get("kind") == review.get("ForConstraint"):
+                yield c
+
+    def autoreject_review(self, review, constraints, table):
+        has_ns = any(
+            (m := table.meta_at(row)) is not None and m.kind == "Namespace"
+            and m.api_version == "v1"
+            for _, row in table.rows_items())
+        out = []
+        for c in constraints:
+            match = (c.get("spec") or {}).get("match") or {}
+            if "namespaceSelector" in match and not has_ns:
+                out.append((c, "REJECTION", {}))
+        return out
+
+
+_DENY_ALL = """package foo
+violation[{"msg": "DENIED", "details": {}}] {
+	"always" == "always"
+}"""
+
+
+def _template(kind: str = "Foo") -> dict:
+    return {"apiVersion": "templates.gatekeeper.sh/v1alpha1",
+            "kind": "ConstraintTemplate",
+            "metadata": {"name": kind.lower()},
+            "spec": {"crd": {"spec": {"names": {"kind": kind}}},
+                     "targets": [{"target": ProbeTarget.name,
+                                  "rego": _DENY_ALL}]}}
+
+
+def _constraint(kind: str = "Foo", name: str = "ph",
+                match: dict | None = None) -> dict:
+    spec: dict = {}
+    if match is not None:
+        spec["match"] = match
+    return {"apiVersion": "constraints.gatekeeper.sh/v1alpha1",
+            "kind": kind, "metadata": {"name": name}, "spec": spec}
+
+
+def _data(name: str) -> dict:
+    return {"Name": name, "ForConstraint": "Foo"}
+
+
+class ProbeError(Exception):
+    pass
+
+
+def _want(cond: bool, msg: str, rsps) -> None:
+    if not cond:
+        raise ProbeError(f"{msg}: {rsps!r}")
+
+
+# --- the scenario table (e2e_tests.go:65-540, same names) -------------
+
+def _add_template(c):
+    c.add_template(_template())
+
+
+def _deny_all(c):
+    c.add_template(_template())
+    c.add_constraint(_constraint())
+    rsps = c.review(_data("Sara"))
+    res = rsps.results()
+    _want(len(res) == 1 and res[0].msg == "DENIED", "deny all", rsps)
+
+
+def _deny_all_audit(c, n: int = 1):
+    c.add_template(_template())
+    c.add_constraint(_constraint())
+    for i in range(n):
+        c.add_data(_data(f"obj{i}"))
+    rsps = c.audit()
+    res = rsps.results()
+    _want(len(res) == n and all(r.msg == "DENIED" for r in res),
+          f"audit x{n}", rsps)
+
+
+def _autoreject_all(c):
+    # e2e_tests.go:183-246: the rejectable constraint yields BOTH the
+    # REJECTION and its normal evaluation result (2 results total)
+    c.add_template(_template())
+    c.add_constraint(_constraint(match={"namespaceSelector": {
+        "matchLabels": {"hi": "there"}}}))
+    rsps = c.review(_data("Sara"))
+    msgs = sorted(str(r.msg) for r in rsps.results())
+    _want(len(msgs) == 2 and "REJECTION" in msgs, "autoreject", rsps)
+
+
+def _remove_data(c):
+    c.add_template(_template())
+    c.add_constraint(_constraint())
+    c.add_data(_data("Sara"))
+    c.add_data(_data("Max"))
+    _want(len(c.audit().results()) == 2, "pre-remove audit", None)
+    c.remove_data(_data("Max"))
+    rsps = c.audit()
+    _want(len(rsps.results()) == 1, "post-remove audit", rsps)
+
+
+def _remove_constraint(c):
+    c.add_template(_template())
+    c.add_constraint(_constraint())
+    c.add_data(_data("Sara"))
+    _want(len(c.audit().results()) == 1, "pre-remove audit", None)
+    c.remove_constraint(_constraint())
+    rsps = c.audit()
+    _want(len(rsps.results()) == 0, "post-remove audit", rsps)
+
+
+def _remove_template(c):
+    c.add_template(_template())
+    c.add_constraint(_constraint())
+    c.add_data(_data("Sara"))
+    c.remove_template(_template())
+    rsps = c.audit()
+    _want(len(rsps.results()) == 0, "post-remove-template audit", rsps)
+
+
+def _tracing(c, on: bool):
+    c.add_template(_template())
+    c.add_constraint(_constraint())
+    rsps = c.review(_data("Sara"), tracing=on)
+    for resp in rsps.by_target.values():
+        if on:
+            _want(resp.trace is not None, "trace expected", rsps)
+        else:
+            _want(resp.trace is None, "no trace expected", rsps)
+
+
+def _audit_tracing(c, on: bool):
+    c.add_template(_template())
+    c.add_constraint(_constraint())
+    c.add_data(_data("Sara"))
+    rsps = c.audit(tracing=on)
+    for resp in rsps.by_target.values():
+        if on:
+            _want(resp.trace is not None, "audit trace expected", rsps)
+        else:
+            _want(resp.trace is None, "no audit trace expected", rsps)
+
+
+SCENARIOS: dict[str, Callable] = {
+    "Add Template": _add_template,
+    "Deny All": _deny_all,
+    "Deny All Audit": lambda c: _deny_all_audit(c, 1),
+    "Deny All Audit x2": lambda c: _deny_all_audit(c, 2),
+    "Autoreject All": _autoreject_all,
+    "Remove Data": _remove_data,
+    "Remove Constraint": _remove_constraint,
+    "Remove Template": _remove_template,
+    "Tracing Off": lambda c: _tracing(c, False),
+    "Tracing On": lambda c: _tracing(c, True),
+    "Audit Tracing Enabled": lambda c: _audit_tracing(c, True),
+    "Audit Tracing Disabled": lambda c: _audit_tracing(c, False),
+}
+
+
+class Probe:
+    """probe_client.go Probe: a client over the probe target, exposing
+    each scenario as a zero-arg callable returning None or raising
+    ProbeError with the engine dump appended."""
+
+    def __init__(self, driver):
+        from gatekeeper_tpu.client.client import Backend
+        self.client = Backend(driver).new_client([ProbeTarget()])
+
+    def test_funcs(self) -> dict[str, Callable[[], None]]:
+        return {name: self._run_test(name) for name in SCENARIOS}
+
+    def _run_test(self, name: str) -> Callable[[], None]:
+        def run() -> None:
+            self.client.reset()
+            try:
+                SCENARIOS[name](self.client)
+            except Exception as e:
+                try:
+                    dump = self.client.dump()
+                except Exception as e2:     # noqa: BLE001
+                    dump = str(e2)
+                raise ProbeError(
+                    f"Error: {e}\n\nEngine dump: {dump}") from e
+        return run

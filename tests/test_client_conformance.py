@@ -11,58 +11,23 @@ import pytest
 
 from gatekeeper_tpu.client.client import Backend, Client
 from gatekeeper_tpu.client.local_driver import LocalDriver
-from gatekeeper_tpu.client.targets import TargetHandler, UnhandledData, WipeData
+from gatekeeper_tpu.client.targets import UnhandledData, WipeData
 from gatekeeper_tpu.errors import ClientError, CompileError
 from gatekeeper_tpu.store.table import ResourceMeta
 
 
-class TestTarget(TargetHandler):
-    """Native port of test_handler.go: data keyed by Name, constraints match
-    when their kind equals review.ForConstraint, autoreject when a
-    constraint has match.namespaceSelector and no v1/Namespace is cached."""
+from gatekeeper_tpu.client.probe import ProbeTarget
+
+
+class TestTarget(ProbeTarget):
+    """The probe target (client/probe.py — the native port of
+    test_handler.go: data keyed by Name, constraints match when their
+    kind equals review.ForConstraint, autoreject when a constraint has
+    match.namespaceSelector and no v1/Namespace is cached) under the
+    historical test target name.  Single source of semantics: a fix to
+    the runtime probe propagates here and vice versa."""
 
     name = "test.target"
-
-    def process_data(self, obj):
-        if isinstance(obj, dict) and "Name" in obj:
-            meta = ResourceMeta(api_version="v1", kind="TestData",
-                                name=obj["Name"], namespace=None)
-            return obj["Name"], meta, obj
-        raise UnhandledData(f"unhandled: {obj!r}")
-
-    def handle_review(self, obj):
-        if isinstance(obj, dict) and "Name" in obj:
-            return obj
-        raise UnhandledData(f"unhandled review: {obj!r}")
-
-    def handle_violation(self, result):
-        result.resource = result.review
-
-    def match_schema(self):
-        return {"properties": {"label": {"type": "string"}}}
-
-    def validate_constraint(self, constraint):
-        return None
-
-    def make_review(self, meta, obj):
-        return obj
-
-    def matching_constraints(self, review, constraints, table):
-        for c in constraints:
-            if c.get("kind") == review.get("ForConstraint"):
-                yield c
-
-    def autoreject_review(self, review, constraints, table):
-        has_ns = any(
-            (m := table.meta_at(row)) is not None and m.kind == "Namespace"
-            and m.api_version == "v1"
-            for _, row in table.rows_items())
-        out = []
-        for c in constraints:
-            match = (c.get("spec") or {}).get("match") or {}
-            if "namespaceSelector" in match and not has_ns:
-                out.append((c, "REJECTION", {}))
-        return out
 
 
 DENY_ALL = """package foo

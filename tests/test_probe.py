@@ -1,0 +1,31 @@
+"""client.Probe — the framework's self-test surface
+(probe_client.go): every scenario passes against both drivers, and a
+failing scenario surfaces a ProbeError carrying the engine dump."""
+
+import pytest
+
+from gatekeeper_tpu.client.local_driver import LocalDriver
+from gatekeeper_tpu.client.probe import Probe, ProbeError, SCENARIOS
+from gatekeeper_tpu.engine.jax_driver import JaxDriver
+
+
+@pytest.mark.parametrize("driver_cls", [LocalDriver, JaxDriver])
+def test_all_probe_scenarios_pass(driver_cls):
+    probe = Probe(driver_cls())
+    funcs = probe.test_funcs()
+    assert set(funcs) == set(SCENARIOS) and len(funcs) == 12
+    for name, fn in funcs.items():
+        fn()    # raises ProbeError on failure
+
+
+def test_probe_failure_carries_engine_dump(monkeypatch):
+    probe = Probe(LocalDriver())
+
+    def broken(_client):
+        raise AssertionError("engine disagrees")
+
+    monkeypatch.setitem(SCENARIOS, "Deny All", broken)
+    with pytest.raises(ProbeError) as ei:
+        probe.test_funcs()["Deny All"]()
+    assert "engine disagrees" in str(ei.value)
+    assert "Engine dump:" in str(ei.value)
