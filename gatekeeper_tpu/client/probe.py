@@ -223,6 +223,16 @@ class Probe:
 
     def __init__(self, driver):
         from gatekeeper_tpu.client.client import Backend
+        # a FRESH driver only (the Go Probe likewise constructs its own
+        # Backend): registering the probe target on a driver that is
+        # already serving a client would clobber that client's target
+        # registry — the exact hazard the one-client-per-backend guard
+        # exists to prevent
+        if getattr(driver, "targets", None):
+            raise ValueError(
+                "Probe requires a fresh driver; this one already serves "
+                f"targets {sorted(driver.targets)} — construct a new "
+                "driver instance for the probe")
         self.client = Backend(driver).new_client([ProbeTarget()])
 
     def test_funcs(self) -> dict[str, Callable[[], None]]:

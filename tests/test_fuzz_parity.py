@@ -303,3 +303,23 @@ def test_fuzz_driver_parity(seed):
         lres = sorted(map(key, local.audit().results()))
         jres = sorted(map(key, jx.audit().results()))
         assert lres == jres, f"churn round {round_}"
+    # the admission path: per-review evaluation (incl. the per-review
+    # shared comprehension memo) must agree with the oracle on the
+    # same randomized templates/matches — the audit compare alone
+    # never exercises autoreject or the review-shaped input
+    reviews = [dict(p) for p in pods[:10]]
+    # one review lands in an UNCACHED namespace: autoreject (which only
+    # fires when a namespaceSelector constraint meets an unknown
+    # namespace, target/k8s.py) must agree across drivers too
+    uncached = dict(pods[10], metadata=dict(pods[10]["metadata"],
+                                            namespace="q"))
+    reviews.append(uncached)
+    for p in reviews:
+        req = {"kind": {"group": "", "version": "v1", "kind": "Pod"},
+               "name": p["metadata"]["name"],
+               "namespace": p["metadata"]["namespace"],
+               "operation": "CREATE", "object": p,
+               "userInfo": {"username": "fuzz"}}
+        lr = sorted(map(key, local.review(req).results()))
+        jr = sorted(map(key, jx.review(req).results()))
+        assert lr == jr, (p["metadata"]["name"], lr, jr)

@@ -18,6 +18,17 @@ def test_all_probe_scenarios_pass(driver_cls):
         fn()    # raises ProbeError on failure
 
 
+def test_probe_rejects_in_use_driver():
+    """Registering the probe target on a driver already serving a
+    client would clobber that client's target registry — refused."""
+    from gatekeeper_tpu.client.client import Backend
+    from gatekeeper_tpu.target.k8s import K8sValidationTarget
+    d = LocalDriver()
+    Backend(d).new_client([K8sValidationTarget()])
+    with pytest.raises(ValueError, match="fresh driver"):
+        Probe(d)
+
+
 def test_probe_failure_carries_engine_dump(monkeypatch):
     probe = Probe(LocalDriver())
 
