@@ -6,7 +6,7 @@
 IMG ?= gatekeeper-tpu:latest
 PY ?= python
 
-.PHONY: all native-test test soak bench bench-quick demo demo-basic demo-agilebank manager worker \
+.PHONY: all native-test test soak bench bench-quick probe demo demo-basic demo-agilebank manager worker \
         docker-build deploy undeploy lint ci
 
 all: test
@@ -30,6 +30,11 @@ bench:
 
 bench-quick:
 	GATEKEEPER_BENCH_QUICK=1 $(PY) bench.py
+
+# self-validate both engines via the framework's Probe
+# (client/probe.py — the reference's probe_client readiness surface)
+probe:
+	$(PY) -m gatekeeper_tpu.client.probe
 
 # demo/basic flow end-to-end (1k namespaces + required-labels template)
 demo:

@@ -251,3 +251,57 @@ class Probe:
                 raise ProbeError(
                     f"Error: {e}\n\nEngine dump: {dump}") from e
         return run
+
+
+def main() -> int:
+    """``python -m gatekeeper_tpu.client.probe``: self-validate both
+    engines (the readiness wiring the reference's Probe exists for).
+
+    The verdict line names the backend that actually served the [jax]
+    scenarios: with a dead/unreachable device the driver falls back to
+    the scalar oracle, which validates SEMANTICS but not the device —
+    a reader gating a deploy must see that distinction, and
+    GATEKEEPER_PROBE_REQUIRE_DEVICE=1 turns it into a failure."""
+    import os
+    import sys
+
+    from gatekeeper_tpu.client.local_driver import LocalDriver
+    from gatekeeper_tpu.engine.jax_driver import JaxDriver
+    failures = 0
+    jax_scalar_only = False
+    for label, cls in (("local", LocalDriver), ("jax", JaxDriver)):
+        try:
+            probe = Probe(cls())
+        except Exception as e:      # noqa: BLE001 — a readiness probe
+            failures += 1           # must render a verdict, not a trace
+            print(f"  FAIL [{label}] <driver construction>: "
+                  f"{type(e).__name__}: {e}", file=sys.stderr)
+            continue
+        if label == "jax" and getattr(probe.client.driver,
+                                      "scalar_only", False):
+            jax_scalar_only = True
+        for name, fn in probe.test_funcs().items():
+            try:
+                fn()
+                print(f"  ok   [{label}] {name}")
+            except Exception as e:  # noqa: BLE001 — incl. ProbeError
+                failures += 1
+                print(f"  FAIL [{label}] {name}: "
+                      f"{str(e).splitlines()[0]}", file=sys.stderr)
+    if jax_scalar_only:
+        from gatekeeper_tpu.utils.device_probe import probe_devices
+        print("WARNING: device backend unavailable "
+              f"({probe_devices().reason}) — the [jax] scenarios ran on "
+              "the scalar fallback; semantics validated, device NOT",
+              file=sys.stderr)
+        if os.environ.get("GATEKEEPER_PROBE_REQUIRE_DEVICE") == "1":
+            print("PROBE FAIL (device required but unavailable)")
+            return 2
+    backend = "scalar-fallback" if jax_scalar_only else "device"
+    print(("PROBE FAIL" if failures else "PROBE PASS")
+          + f" (jax engine served by: {backend})")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
