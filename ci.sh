@@ -11,6 +11,9 @@ python -m compileall -q gatekeeper_tpu
 echo "== tests (virtual 8-device CPU mesh) =="
 python -m pytest tests/ -q
 
+echo "== engine self-validation (client/probe.py) =="
+JAX_PLATFORMS=cpu python -m gatekeeper_tpu.client.probe | tail -1
+
 # Soak cadence: `make soak` (GATEKEEPER_SOAK=1 long fuzz/race sweeps)
 # runs nightly and before any release image — opt-in here via SOAK=1
 # so the per-commit path stays fast.
