@@ -12,6 +12,9 @@ Also measured (stderr, and embedded in the `detail` field):
 - demo/basic:    K8sRequiredLabels over 1k Namespaces (both engines)
 - allowed repos: K8sAllowedRepos allowlist over 10k Pods (both engines)
 - library:       full 40-template library x 100k mixed resources
+- full sweep:    forced full re-evaluation (QueryOpts.full) pipelined
+                 vs serial no-overlap vs memoized steady, with
+                 per-phase host_prep/h2d/device timings
 - regex-heavy:   image-digest / tag / wildcard-host templates x 100k
 - selector-heavy: namespaceSelector matching at 100k namespaces
 - admission:     AdmissionReview replay through the webhook handler with
@@ -150,12 +153,43 @@ def flush_partial() -> None:
         pass
 
 
+def _slim_headline() -> dict:
+    """The stdout headline WITHOUT the full detail tree: metric, value,
+    backend, and one-line north-star / full-sweep summaries.  Kept
+    ≤1,500 chars by contract — the capture windows that consume the
+    bench keep only a stdout tail (ci.sh parses the trailing 2,000
+    bytes; the round-5 number of record was erased by exactly such a
+    window).  Everything measured stays in BENCH_partial.json."""
+    slim = {k: v for k, v in HEADLINE.items() if k != "detail"}
+    slim["backend"] = DETAIL.get("backend")
+    slim["detail_file"] = "BENCH_partial.json"
+    ns = DETAIL.get("north_star")
+    if isinstance(ns, dict):
+        slim["north_star"] = {k: ns.get(k) for k in
+                              ("n_resources", "n_constraints",
+                               "steady_seconds", "cold_seconds")
+                              if ns.get(k) is not None}
+    fs = DETAIL.get("full_sweep")
+    if isinstance(fs, dict):
+        slim["full_sweep"] = {k: fs.get(k) for k in
+                              ("memoized_steady_seconds",
+                               "pipelined_full_seconds",
+                               "serial_full_seconds", "pipeline_speedup",
+                               "overlap_fraction")
+                              if fs.get(k) is not None}
+    if DETAIL.get("aborted"):
+        slim["aborted"] = DETAIL["aborted"]
+    return slim
+
+
 def emit_headline() -> None:
-    """Print THE one stdout JSON line (exactly once, from any thread).
-    The watchdog calls this while a phase thread may be mutating
-    DETAIL — serialization must survive the race (and _EMITTED only
-    latches after a successful print, so a failed attempt does not
-    suppress the headline forever)."""
+    """Print THE one stdout JSON line (exactly once, from any thread) —
+    the SLIM headline (≤1,500 chars; full detail goes to
+    BENCH_partial.json via flush_partial, never to stdout).  The
+    watchdog calls this while a phase thread may be mutating DETAIL —
+    serialization must survive the race (and _EMITTED only latches
+    after a successful print, so a failed attempt does not suppress
+    the headline forever)."""
     global _EMITTED
     with _EMIT_LOCK:
         if _EMITTED:
@@ -164,14 +198,16 @@ def emit_headline() -> None:
         line = None
         for _ in range(3):
             try:
-                line = json.dumps(HEADLINE)
+                line = json.dumps(_slim_headline())
                 break
             except RuntimeError:        # dict mutated mid-dump; retry
                 time.sleep(0.05)
-        if line is None:                # strip the racing detail
-            slim = {k: v for k, v in HEADLINE.items() if k != "detail"}
-            slim["detail"] = {"aborted": "detail serialization race"}
-            line = json.dumps(slim)
+        if line is None or len(line) > 1500:    # belt and braces: the
+            # headline must fit the 2,000-byte tail window whole
+            line = json.dumps({k: HEADLINE.get(k) for k in
+                               ("metric", "value", "unit", "vs_baseline",
+                                "provisional", "wall_seconds")
+                               if k in HEADLINE})
         print(line, flush=True)
         _EMITTED = True
         flush_partial()
@@ -427,7 +463,7 @@ def bench_north_star(detail):
         kind_bytes = {}
         b = None
         for kind, (_key, b) in st.bindings_cache.items():
-            kind_bytes[kind] = int(sum(a.nbytes for a in b.arrays.values()))
+            kind_bytes[kind] = b.nbytes()
         gates = sum(int(getattr(m, "nbytes", 0))
                     for m in st.installed_match.values())
         if st.rank_cache is not None:
@@ -639,6 +675,102 @@ def bench_library(detail):
         "restart_persistent_cache_hits": pc["hits"],
         "capped_results": n_res,
         "cpu_oracle_extrapolated_seconds": round(t_cpu, 2)}
+
+
+def bench_full_sweep(detail):
+    """Forced full re-evaluation (QueryOpts.full) vs the memoized steady
+    sweep, both backends — and pipelined vs the serial no-overlap
+    forced-full baseline (FULL_SWEEP_SERIAL).  VERDICT §weak #4: the
+    steady number is delta/memo replay, so a forced-full sweep is the
+    number a cache-cold audit actually costs; it is reported with the
+    driver's per-phase breakdown (host_prep_s / h2d_s / device_s /
+    overlap_fraction) so the overlap claim is measured, not asserted."""
+    n = 2_000   # the library_2000 scale, device path forced below — a
+    #             forced-full sweep is host-prep-bound, so bigger N only
+    #             stretches the wall without changing the overlap story
+    log(f"[full-sweep] building {n} mixed resources x {len(LIBRARY)} "
+        f"templates")
+    rng = random.Random(6)
+    resources = make_mixed(rng, n)
+    jd = JaxDriver()
+    c = Backend(jd).new_client([K8sValidationTarget()])
+    for tdoc, cdoc in all_docs():
+        c.add_template(tdoc)
+        c.add_constraint(cdoc)
+    c.add_data_batch(resources)
+    from gatekeeper_tpu.engine import jax_driver as jd_mod
+    saved = jd_mod.SMALL_WORKLOAD_EVALS
+    if not FALLBACK:
+        jd_mod.SMALL_WORKLOAD_EVALS = 0     # force the device path
+    full_opts = QueryOpts(limit_per_constraint=CAP, full=True)
+    try:
+        # warm once (compiles), then the two memoized-steady reps; the
+        # warm sweep kicks off background delta-prewarm compiles — drain
+        # them so the timed reps measure the pipeline, not compile theft
+        from gatekeeper_tpu.engine.veval import quiesce_upgrades
+        jd.query_audit(TARGET_NAME, full_opts)
+        quiesce_upgrades()
+        steady_best, _f, _nres = timed_audit(jd)
+        # pipelined forced-full
+        pipe_times = []
+        n_res_full = 0
+        for _ in range(5):
+            t0 = time.perf_counter()
+            results, _ = jd.query_audit(TARGET_NAME, full_opts)
+            pipe_times.append(time.perf_counter() - t0)
+            n_res_full = len(results)
+        pipe_best = min(pipe_times)
+        phases = dict(jd.last_sweep_phases)
+        # serial no-overlap forced-full baseline: same workload, each
+        # kind's prep -> upload -> execute completes before the next
+        saved_serial = jd_mod.FULL_SWEEP_SERIAL
+        jd_mod.FULL_SWEEP_SERIAL = True
+        try:
+            serial_times = []
+            for _ in range(5):
+                t0 = time.perf_counter()
+                jd.query_audit(TARGET_NAME, full_opts)
+                serial_times.append(time.perf_counter() - t0)
+        finally:
+            jd_mod.FULL_SWEEP_SERIAL = saved_serial
+        serial_best = min(serial_times)
+    finally:
+        jd_mod.SMALL_WORKLOAD_EVALS = saved
+    del c, jd
+    # the scalar oracle is full-by-construction: its plain audit IS the
+    # forced-full number for the other backend
+    ld = LocalDriver()
+    cl = Backend(ld).new_client([K8sValidationTarget()])
+    for tdoc, cdoc in all_docs():
+        cl.add_template(tdoc)
+        cl.add_constraint(cdoc)
+    cl.add_data_batch(resources)
+    t0 = time.perf_counter()
+    ld.query_audit(TARGET_NAME, QueryOpts(limit_per_constraint=CAP))
+    oracle_s = time.perf_counter() - t0
+    speedup = serial_best / pipe_best if pipe_best else 0.0
+    detail["full_sweep"] = {
+        "n_resources": n, "n_templates": len(LIBRARY),
+        "memoized_steady_seconds": round(steady_best, 4),
+        "pipelined_full_seconds": round(pipe_best, 4),
+        "serial_full_seconds": round(serial_best, 4),
+        "pipeline_speedup": round(speedup, 2),
+        "full_vs_steady": round(pipe_best / steady_best, 1)
+        if steady_best else None,
+        "cpu_oracle_full_seconds": round(oracle_s, 4),
+        "results": n_res_full,
+        **{k: phases.get(k) for k in
+           ("host_prep_s", "h2d_s", "device_s", "format_s", "h2d_bytes",
+            "pipeline_wall_s", "overlap_fraction")},
+    }
+    log(f"[full-sweep] memoized steady {steady_best*1e3:.0f}ms | "
+        f"forced-full pipelined {pipe_best*1e3:.0f}ms vs serial "
+        f"{serial_best*1e3:.0f}ms ({speedup:.2f}x) | overlap "
+        f"{phases.get('overlap_fraction', 0):.0%} (host_prep "
+        f"{phases.get('host_prep_s', 0)*1e3:.0f}ms, h2d "
+        f"{phases.get('h2d_s', 0)*1e3:.0f}ms, device "
+        f"{phases.get('device_s', 0)*1e3:.0f}ms) | cpu oracle full "
+        f"{oracle_s*1e3:.0f}ms")
 
 
 def bench_selector_heavy(detail):
@@ -1075,6 +1207,8 @@ def main():
     run_phase("allowed_repos", bench_allowed_repos, 240)
     quiesce_upgrades()
     run_phase("library", bench_library, 700)
+    quiesce_upgrades()
+    run_phase("full_sweep", bench_full_sweep, 400)
     quiesce_upgrades()
     run_phase("regex_heavy", bench_regex_heavy, 300)
     run_phase("selector_heavy", bench_selector_heavy, 300)

@@ -26,8 +26,25 @@ echo "== bench smoke (quick shapes) =="
 GATEKEEPER_BENCH_QUICK=1 GATEKEEPER_BENCH_N=20000 python bench.py > /tmp/bench.json
 python - <<'EOF'
 import json
-d = json.loads(open("/tmp/bench.json").read())
+# Parse ONLY the trailing 2,000 bytes — the capture window that erased
+# the round-5 number of record kept just a stdout tail, so the gate
+# must prove the headline survives one.  The slim headline contract
+# (bench.emit_headline) is ≤1,500 chars, so it fits the window whole.
+raw = open("/tmp/bench.json", "rb").read()[-2000:].decode("utf-8", "replace")
+d = line = None
+for ln in reversed(raw.splitlines()):
+    ln = ln.strip()
+    if not ln.startswith("{"):
+        continue
+    try:
+        d, line = json.loads(ln), ln
+        break
+    except ValueError:
+        continue
+assert d is not None, f"no JSON headline in the trailing 2000 bytes: {raw!r}"
+assert len(line) <= 1500, f"headline is {len(line)} chars (> 1500)"
 assert d["metric"] and d["value"] > 0, d
-print("bench ok:", d["metric"], round(d["value"], 1), d["unit"])
+print("bench ok:", d["metric"], round(d["value"], 1), d["unit"],
+      f"({len(line)} headline chars)")
 EOF
 echo "CI PASS"

@@ -78,11 +78,18 @@ class AuditManager:
     # ------------------------------------------------------------------
     # one sweep
 
-    def audit_once(self) -> dict:
+    def audit_once(self, full: bool = False) -> dict:
         """One audit() sweep (manager.go:84-119).  Returns the sweep
-        report (also stored as ``last_sweep``)."""
+        report (also stored as ``last_sweep``).
+
+        ``full=True`` forces a genuine full sweep: the driver's
+        mask/bindings/format memoization is dropped for this sweep, and
+        the report carries the driver's per-phase pipeline breakdown
+        (``host_prep_s``, ``h2d_s``, ``device_s``, ``overlap_fraction``)
+        so "full sweep" and "memoized steady" stay two separately
+        metered numbers."""
         t0 = self._now()
-        report = self._sweep(t0)
+        report = self._sweep(t0, full=full)
         if not report["skipped"]:
             report.setdefault("total_seconds", self._now() - t0)
             self.metrics.counter("audit_sweeps").inc()
@@ -99,7 +106,7 @@ class AuditManager:
                       seconds=round(report.get("total_seconds", 0.0), 3))
         return report
 
-    def _sweep(self, t0: float) -> dict:
+    def _sweep(self, t0: float, full: bool = False) -> dict:
         timestamp = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
         report = {"timestamp": timestamp, "skipped": False,
                   "violations": 0, "constraints_updated": 0}
@@ -116,10 +123,21 @@ class AuditManager:
             return report
 
         t_eval = self._now()
-        resp = self.client.audit(limit_per_constraint=self.violations_limit)
+        resp = self.client.audit(limit_per_constraint=self.violations_limit,
+                                 full=full)
         results = resp.results()
         report["eval_seconds"] = self._now() - t_eval
         report["violations"] = len(results)
+        report["full"] = full
+        # surface the driver's pipeline phase breakdown (the jax driver
+        # records host_prep_s / h2d_s / device_s / overlap_fraction per
+        # sweep; the scalar oracle has no pipeline and reports nothing)
+        phases = getattr(self.client.driver, "last_sweep_phases", None)
+        if phases:
+            for k in ("host_prep_s", "h2d_s", "device_s",
+                      "overlap_fraction"):
+                if k in phases:
+                    report[k] = phases[k]
 
         update_lists = self._update_lists(results)
 

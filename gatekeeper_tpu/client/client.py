@@ -305,21 +305,27 @@ class Client:
             return responses
 
     def audit(self, tracing: bool = False,
-              limit_per_constraint: int | None = None) -> Responses:
+              limit_per_constraint: int | None = None,
+              full: bool = False) -> Responses:
         """Full cross-product audit.  ``limit_per_constraint`` pushes the
         audit manager's violations cap (reference manager.go:35) down to
         the driver, where the jax engine turns it into a device top-k
-        instead of formatting everything and truncating on the host."""
+        instead of formatting everything and truncating on the host.
+        ``full=True`` defeats the driver's sweep memoization (mask /
+        bindings / format caches) so the sweep measures a genuine
+        re-preparation + re-upload + re-evaluation of every pair."""
         with self._lock.read():
-            return self._audit_locked(tracing, limit_per_constraint)
+            return self._audit_locked(tracing, limit_per_constraint, full)
 
     def _audit_locked(self, tracing: bool,
-                      limit_per_constraint: int | None = None) -> Responses:
+                      limit_per_constraint: int | None = None,
+                      full: bool = False) -> Responses:
         responses = Responses()
         for name, handler in self.targets.items():
             results, trace = self.driver.query_audit(
                 name, QueryOpts(tracing=tracing,
-                                limit_per_constraint=limit_per_constraint))
+                                limit_per_constraint=limit_per_constraint,
+                                full=full))
             for r in results:
                 handler.handle_violation(r)
             responses.by_target[name] = Response(target=name, results=results,

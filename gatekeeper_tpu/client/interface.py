@@ -43,6 +43,13 @@ class QueryOpts:
     # jax driver then only host-formats up to N violating pairs per
     # constraint while still counting the rest on device)
     limit_per_constraint: int | None = None
+    # audit: force a FULL sweep — the jax driver drops its mask /
+    # bindings / format memoization for this sweep so every
+    # constraint×resource pair is genuinely re-prepared, re-uploaded and
+    # re-evaluated ("full sweep" vs "memoized steady" are two separately
+    # metered numbers; the scalar oracle is always full, so it ignores
+    # this flag)
+    full: bool = False
 
 
 class Driver(abc.ABC):

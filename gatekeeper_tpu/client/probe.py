@@ -269,11 +269,13 @@ def main() -> int:
     from gatekeeper_tpu.engine.jax_driver import JaxDriver
     failures = 0
     jax_scalar_only = False
+    construct_failed: set[str] = set()
     for label, cls in (("local", LocalDriver), ("jax", JaxDriver)):
         try:
             probe = Probe(cls())
         except Exception as e:      # noqa: BLE001 — a readiness probe
             failures += 1           # must render a verdict, not a trace
+            construct_failed.add(label)
             print(f"  FAIL [{label}] <driver construction>: "
                   f"{type(e).__name__}: {e}", file=sys.stderr)
             continue
@@ -297,7 +299,13 @@ def main() -> int:
         if os.environ.get("GATEKEEPER_PROBE_REQUIRE_DEVICE") == "1":
             print("PROBE FAIL (device required but unavailable)")
             return 2
-    backend = "scalar-fallback" if jax_scalar_only else "device"
+    # A failed JaxDriver CONSTRUCTION means no jax scenario ran at all —
+    # the verdict line a deploy gate greps must not claim "device" (or
+    # even "scalar-fallback") for an engine that never existed.
+    if "jax" in construct_failed:
+        backend = "unavailable"
+    else:
+        backend = "scalar-fallback" if jax_scalar_only else "device"
     print(("PROBE FAIL" if failures else "PROBE PASS")
           + f" (jax engine served by: {backend})")
     return 1 if failures else 0

@@ -140,6 +140,13 @@ DEFAULT_PREWARM_CAP = 20
 """Cap assumed for prewarmed audit executables — the audit manager's
 per-constraint violation cap (reference pkg/audit/manager.go:35)."""
 
+FULL_SWEEP_SERIAL = os.environ.get("GATEKEEPER_FULL_SWEEP_SERIAL") == "1"
+"""Diagnostic baseline: run a forced-full sweep (QueryOpts.full) with
+NO pipelining — each kind's host prep, H2D upload, and device execution
+complete before the next kind's prep starts.  bench.py measures this
+no-overlap serial number against the pipelined full sweep; it is the
+measurement the pipeline exists to beat.  Never enable in production."""
+
 
 class JaxTargetState(TargetState):
     def __init__(self):
@@ -198,6 +205,11 @@ class JaxDriver(LocalDriver):
         # one-shot background churn-delta prewarm after the first sweep
         # (shape changes later recompile lazily on the sweep, as before)
         self._delta_warmed = False
+        # per-phase breakdown of the most recent audit sweep (the audit
+        # manager copies host_prep_s/h2d_s/device_s/overlap_fraction
+        # into its sweep report; phase timings are only measured on
+        # forced-full sweeps — {"full": False} otherwise)
+        self.last_sweep_phases: dict = {}
 
     # ------------------------------------------------------------------
 
@@ -554,7 +566,25 @@ class JaxDriver(LocalDriver):
         handler = self.targets[target]
         tracing = opts.tracing if opts is not None else self.default_tracing
         limit = opts.limit_per_constraint if opts is not None else None
+        full = opts.full if opts is not None else False
         trace: list | None = [] if tracing else None
+
+        if full:
+            # Forced full sweep: drop every layer of sweep memoization
+            # for this target.  Rebind (never .clear()) so concurrent
+            # readers keep the dicts they already hold.  Fresh Bindings
+            # built after this carry no per-executor device caches and
+            # no persistent violation masks, so host prep, H2D upload,
+            # and device evaluation all genuinely re-run; fmt_cache goes
+            # too, so every violating pair re-formats through the scalar
+            # oracle.  rank/order caches stay — they derive from the
+            # table (row keys), not from any evaluation.
+            with self._prep_lock:
+                st.mask_cache = {}
+                st.bindings_cache = {}
+                st.bindings_retired = {}
+                st.installed_match = {}
+                st.fmt_cache = {}
 
         # row ordering matches the scalar driver (sorted cache keys) so
         # both drivers return identical result lists; the 1M-row sort +
@@ -583,14 +613,46 @@ class JaxDriver(LocalDriver):
             # thread pool so first-time jit traces / XLA compiles of
             # different kinds overlap (a 30-template library would
             # otherwise pay its compiles serially on a cold start).
+            import threading as _threading
+            # full-sweep pipeline phase accumulators: host_prep on the
+            # sweep thread, h2d/device on whichever pool worker runs the
+            # kind (hence the lock).  Their SUM exceeding the pipeline
+            # wall is the overlap the pipeline buys.
+            ph = {"host_prep_s": 0.0, "h2d_s": 0.0, "device_s": 0.0,
+                  "h2d_bytes": 0}
+            ph_lock = _threading.Lock()
+            serial_full = full and FULL_SWEEP_SERIAL
+
+            def _launch(mode, prog, bindings):
+                if mode == "topk":
+                    return self.executor.run_topk_async(prog, bindings, limit)
+                return self.executor.run_async(prog, bindings)
+
             def dispatch(spec):
                 mode, _, _, _, prog, bindings, mask = spec
                 # match/rank gates ride bindings.arrays (_install_gates)
-                if mode == "topk":
-                    return self.executor.run_topk_async(prog, bindings, limit)
-                if mode == "mask":
-                    return self.executor.run_async(prog, bindings)
-                return None
+                if mode not in ("topk", "mask"):
+                    return None
+                if not full:
+                    return _launch(mode, prog, bindings)
+                # full sweep: meter the two device-side pipeline stages
+                # where they run (concurrently across kinds).
+                # stage_uploads enqueues this kind's H2D transfers as
+                # its own stage — the _arrays call inside run_*_async
+                # then hits the device cache — and block() rides until
+                # the result is device-resident, so device_s is
+                # per-kind device occupancy, not host-fetch wall (the
+                # D2H copy stays async and is collected in phase 2).
+                t0 = _time.perf_counter()
+                self.executor.stage_uploads(bindings)
+                t1 = _time.perf_counter()
+                h = _launch(mode, prog, bindings).block()
+                t2 = _time.perf_counter()
+                with ph_lock:
+                    ph["h2d_s"] += t1 - t0
+                    ph["device_s"] += t2 - t1
+                    ph["h2d_bytes"] += bindings.nbytes()
+                return h
 
             # prep + dispatch interleaved: each kind's device step is
             # submitted the moment its bindings are ready, so kind N's
@@ -629,10 +691,14 @@ class JaxDriver(LocalDriver):
                 for r_pad, c_pad in pads:
                     pool.submit(self.executor.prewarm_reduce, limit, c_pad,
                                 r_pad)
+            _t_pipe = _time.perf_counter()
             try:
                 with self._prep_lock:
+                    _tk = _time.perf_counter()
                     self._prefetch_axes(st)
+                    ph["host_prep_s"] += _time.perf_counter() - _tk
                     for kind in sorted(st.templates):
+                        _tk = _time.perf_counter()
                         compiled = st.templates[kind]
                         constraints = self._kind_constraints(st, kind)
                         if not constraints:
@@ -656,6 +722,8 @@ class JaxDriver(LocalDriver):
                                     "f32_unsafe_scalar_fallbacks").inc()
                                 spec = ("scalar", kind, compiled, constraints,
                                         None, None, mask)
+                                ph["host_prep_s"] += \
+                                    _time.perf_counter() - _tk
                                 futures.append(None)
                                 specs.append(spec)
                                 continue
@@ -665,7 +733,13 @@ class JaxDriver(LocalDriver):
                             mode = "topk" if limit is not None else "mask"
                             spec = (mode, kind, compiled, constraints, prog,
                                     bindings, mask)
-                            if ordered_dispatch:
+                            ph["host_prep_s"] += _time.perf_counter() - _tk
+                            # serial_full: the no-overlap diagnostic
+                            # baseline — dispatch inline and (because
+                            # dispatch blocks on full sweeps) finish
+                            # this kind end-to-end before the next
+                            # kind's prep
+                            if ordered_dispatch or serial_full:
                                 f = concurrent.futures.Future()
                                 try:
                                     f.set_result(dispatch(spec))
@@ -679,34 +753,71 @@ class JaxDriver(LocalDriver):
                             # to amortize a device dispatch round-trip
                             spec = ("scalar", kind, compiled, constraints, None,
                                     None, mask)
+                            ph["host_prep_s"] += _time.perf_counter() - _tk
                             futures.append(None)
                         specs.append(spec)
                 _phase("audit_prep_submit")
-                handles = [f.result() if f is not None else None for f in futures]
-                _phase("audit_dispatch_wait")
+
+                # phase 2: resolve handles and host-format per kind.  The
+                # tag key (row rank, kind, constraint name) is a total
+                # order, so the tagged sort below restores output order no
+                # matter which kind formats first — which lets a pipelined
+                # sweep format each kind the moment its handle completes,
+                # overlapping host formatting of finished kinds with
+                # device compute of kinds still in flight.  Tracing is
+                # append-order-sensitive, so it keeps sorted-kind order.
+                # One (review, frozen) per violating row for the whole
+                # sweep — rows recur across kinds/constraints, and
+                # freeze() is a deep walk.
+                rcache: dict[int, tuple] = {}
+                tagged: list[tuple[tuple, Result]] = []
+                fmt_s = 0.0
+
+                def _format_kind(spec, handle):
+                    nonlocal fmt_s
+                    mode, kind, compiled, constraints, prog, bindings, \
+                        mask = spec
+                    _tf = _time.perf_counter()
+                    if mode == "topk":
+                        self._format_topk(st, target, handler, compiled,
+                                          constraints, prog, bindings, mask,
+                                          rank, row_order, kind, limit, trace,
+                                          tagged, handle, rcache)
+                    elif mode == "mask":
+                        self._format_pairs(st, target, handler, compiled,
+                                           constraints, handle.get(),
+                                           row_order, kind, limit, trace,
+                                           tagged, rcache)
+                    else:
+                        self._scalar_kind(st, target, handler, compiled,
+                                          constraints, mask, ordered_rows,
+                                          row_order, kind, limit, trace,
+                                          tagged, rcache)
+                    fmt_s += _time.perf_counter() - _tf
+
+                if trace is None:
+                    fut_idx = {f: i for i, f in enumerate(futures)
+                               if f is not None}
+                    for i, f in enumerate(futures):
+                        if f is None:   # scalar kinds: nothing to wait on
+                            _format_kind(specs[i], None)
+                    for f in concurrent.futures.as_completed(fut_idx):
+                        _format_kind(specs[fut_idx[f]], f.result())
+                else:
+                    for sp, f in zip(specs, futures):
+                        _format_kind(sp,
+                                     f.result() if f is not None else None)
+                # the resolve+format interleave is one wall region; split
+                # the timers so dispatch-wait stays device-side only
+                _now = _time.perf_counter()
+                m.timer("audit_dispatch_wait").observe(
+                    max(0.0, _now - _tphase - fmt_s))
+                m.timer("audit_format").observe(fmt_s)
+                _tphase = _now
+                ph["format_s"] = fmt_s
+                pipeline_wall = _time.perf_counter() - _t_pipe
             finally:
                 pool.shutdown(wait=False)
-            plans = [sp + (h,) for sp, h in zip(specs, handles)]
-
-            # phase 2: host formatting per kind.  One (review, frozen)
-            # per violating row for the whole sweep — rows recur across
-            # kinds/constraints, and freeze() is a deep walk
-            rcache: dict[int, tuple] = {}
-            tagged: list[tuple[tuple, Result]] = []
-            for mode, kind, compiled, constraints, prog, bindings, mask, handle in plans:
-                if mode == "topk":
-                    self._format_topk(st, target, handler, compiled, constraints,
-                                      prog, bindings, mask, rank, row_order,
-                                      kind, limit, trace, tagged, handle, rcache)
-                elif mode == "mask":
-                    self._format_pairs(st, target, handler, compiled, constraints,
-                                       handle.get(), row_order, kind, limit, trace,
-                                       tagged, rcache)
-                else:
-                    self._scalar_kind(st, target, handler, compiled, constraints,
-                                      mask, ordered_rows, row_order, kind, limit,
-                                      trace, tagged, rcache)
-            _phase("audit_format")
             tagged.sort(key=lambda kv: kv[0])
             # warm the churn-delta executables in the background: the first
             # sweep after data churn otherwise pays one serialized XLA
@@ -733,6 +844,36 @@ class JaxDriver(LocalDriver):
             m.counter("audit_results").inc(len(tagged))
             m.timer("audit_sweep_wall").observe(_time.perf_counter() - _t0)
             m.gauge("audit_resources").set(len(ordered_rows))
+            if full:
+                # overlap_fraction: how much of the summed stage time
+                # the pipeline hid — 0 means strictly serial stages,
+                # (sum - wall)/sum > 0 means uploads/compute of some
+                # kinds ran under other kinds' host prep.  Honest by
+                # construction: every term is measured where the work
+                # actually ran, and a serial run shows ~0.
+                sum_ph = ph["host_prep_s"] + ph["h2d_s"] + \
+                    ph["device_s"] + ph.get("format_s", 0.0)
+                overlap = max(0.0, (sum_ph - pipeline_wall) / sum_ph) \
+                    if sum_ph > 0 else 0.0
+                self.last_sweep_phases = {
+                    "full": True, "serial": serial_full,
+                    "host_prep_s": round(ph["host_prep_s"], 6),
+                    "h2d_s": round(ph["h2d_s"], 6),
+                    "device_s": round(ph["device_s"], 6),
+                    "format_s": round(ph.get("format_s", 0.0), 6),
+                    "h2d_bytes": int(ph["h2d_bytes"]),
+                    "pipeline_wall_s": round(pipeline_wall, 6),
+                    "overlap_fraction": round(overlap, 4),
+                }
+                m.counter("full_sweeps").inc()
+                m.timer("full_sweep_host_prep").observe(ph["host_prep_s"])
+                m.timer("full_sweep_h2d").observe(ph["h2d_s"])
+                m.timer("full_sweep_device").observe(ph["device_s"])
+                m.timer("full_sweep_format").observe(ph.get("format_s", 0.0))
+                m.gauge("full_sweep_h2d_bytes").set(float(ph["h2d_bytes"]))
+                m.gauge("full_sweep_overlap_fraction").set(overlap)
+            else:
+                self.last_sweep_phases = {"full": False}
             return [r for _, r in tagged], ("\n".join(trace) if trace is not None else None)
         finally:
             # ALWAYS cleared — a dispatch error leaving this set
@@ -891,7 +1032,7 @@ class JaxDriver(LocalDriver):
             f"\n    msg={r.msg!r}" for r in oracle)
 
     def _pair_results(self, st, target, kind, compiled, c, row, review,
-                      frozen, trace) -> list:
+                      frozen, trace, shared=None) -> list:
         """Memoized per-pair formatting.  Steady-state sweeps re-visit
         the same capped (constraint, row) pairs against unchanged rows —
         the oracle re-evaluation is skipped when neither the row (its
@@ -916,7 +1057,7 @@ class JaxDriver(LocalDriver):
         if ent is None or ent[0] != ver:
             self.metrics.counter("format_memo_misses").inc()
             results = list(self._eval_pair(st, target, compiled, review,
-                                           frozen, c, trace))
+                                           frozen, c, trace, shared))
             if len(entries) > 65536:     # bound growth across churn
                 entries.clear()
             entries[key] = ent = (ver, results)
@@ -935,15 +1076,22 @@ class JaxDriver(LocalDriver):
         return st.con_version.get(kind, 0)
 
     def _row_review(self, st, handler, row, rcache):
-        """(review, frozen_review) for a table row, cached per sweep;
-        None if the row is dead."""
+        """(review, frozen_review, shared_memo) for a table row, cached
+        per sweep; None if the row is dead.  The third element is the
+        per-review shared memo (rego/closures._memoize_review_pure):
+        a violating row is formatted against every constraint that
+        flagged it, and its review-pure comprehensions evaluate once per
+        row instead of once per (row, constraint) — the memo entries are
+        keyed by closure id and verify the frozen review's identity, so
+        one dict is safe across kinds (the scalar driver's audit shares
+        it the same way)."""
         hit = rcache.get(row)
         if hit is None:
             meta = st.table.meta_at(row)
             if meta is None:
                 return None
             review = handler.make_review(meta, st.table.object_at(row))
-            hit = (review, freeze(review))
+            hit = (review, freeze(review), {})
             rcache[row] = hit
         return hit
 
@@ -964,9 +1112,10 @@ class JaxDriver(LocalDriver):
                 pair = self._row_review(st, handler, row, rcache)
                 if pair is None:
                     continue
-                review, frozen = pair
+                review, frozen, shared = pair
                 results = self._pair_results(st, target, kind, compiled, c,
-                                             row, review, frozen, trace)
+                                             row, review, frozen, trace,
+                                             shared)
                 for r in results:
                     tagged.append(((row_order[row], kind,
                                     (c.get("metadata") or {}).get("name", "")), r))
@@ -1041,9 +1190,9 @@ class JaxDriver(LocalDriver):
             pair = self._row_review(st, handler, row, rcache)
             if pair is None:
                 continue
-            review, frozen = pair
+            review, frozen, shared = pair
             results = self._pair_results(st, target, kind, compiled, c, row,
-                                         review, frozen, trace)
+                                         review, frozen, trace, shared)
             for r in results:
                 tagged.append(((row_order[row], kind,
                                 (c.get("metadata") or {}).get("name", "")), r))
@@ -1076,9 +1225,10 @@ class JaxDriver(LocalDriver):
                         continue
                 if pair is None:
                     pair = self._row_review(st, handler, row, rcache)
-                review, frozen = pair
+                review, frozen, shared = pair
                 results = self._pair_results(st, target, kind, compiled, c,
-                                             row, review, frozen, trace)
+                                             row, review, frozen, trace,
+                                             shared)
                 for r in results:
                     tagged.append(((row_order[row], kind,
                                     (c.get("metadata") or {}).get("name", "")), r))

@@ -636,6 +636,14 @@ class PendingMask:
         self._nc = n_constraints
         self._nr = n_resources
 
+    def block(self) -> "PendingMask":
+        """Wait until the device result exists (NOT until it is on the
+        host — the D2H copy stays async).  The full-sweep pipeline uses
+        this to meter per-kind device occupancy without forcing the
+        host fetch into the measured stage."""
+        jax.block_until_ready(self._mask)
+        return self
+
     def get(self) -> np.ndarray:
         return np.asarray(self._mask)[: self._nc, : self._nr]
 
@@ -647,6 +655,11 @@ class PendingTopK:
         self._packed = packed
         self._nc = n_constraints
         self._k = k
+
+    def block(self) -> "PendingTopK":
+        """See PendingMask.block."""
+        jax.block_until_ready(self._packed)
+        return self
 
     def get(self):
         p = np.asarray(self._packed)[: self._nc]
@@ -975,6 +988,21 @@ class ProgramExecutor:
                                     sharded))
             arrays["__rank__"] = hit[1]
         return arrays
+
+    def stage_uploads(self, bindings: Bindings) -> None:
+        """H2D staging as its own pipeline stage: enqueue every binding
+        array upload now (device_put is asynchronous — the transfers for
+        kind N+1 then overlap kind N's device compute), so the later
+        dispatch's _arrays call hits the per-bindings device cache and
+        launches against already-resident buffers.  Fresh full-sweep
+        bindings double-buffer naturally: each kind owns its own device
+        arrays, so staging the next kind never touches the buffers the
+        current kind is computing on.  Donation is deliberately NOT used
+        even where shapes repeat across kinds: the identity-keyed device
+        cache keeps buffers alive across sweeps (the memoized steady
+        path depends on that), and a donated buffer would be invalidated
+        under the cache's feet."""
+        self._arrays(bindings, None, None)
 
     def _compiled(self, program: Program, arrays: dict, topk: int | None,
                   sharded: bool = False):

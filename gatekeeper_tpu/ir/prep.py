@@ -410,6 +410,13 @@ class Bindings:
     def shapes_key(self) -> tuple:
         return tuple(sorted((k, v.shape, str(v.dtype)) for k, v in self.arrays.items()))
 
+    def nbytes(self) -> int:
+        """Host-side bytes of every device-bound array — the H2D upload
+        footprint of a cold or forced-full sweep (a memoized steady
+        sweep re-uploads none of it; a churn sweep scatters only dirty
+        rows)."""
+        return int(sum(a.nbytes for a in self.arrays.values()))
+
 
 def _f32_exact(a) -> bool:
     """Every finite value in `a` survives a float32 round-trip exactly.

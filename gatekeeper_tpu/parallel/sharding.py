@@ -29,7 +29,19 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
+try:
+    from jax import shard_map as _shard_map
+except ImportError:  # jax < 0.6 keeps shard_map under experimental
+    from jax.experimental.shard_map import shard_map as _shard_map
+import inspect as _inspect
+
+if "check_vma" in _inspect.signature(_shard_map).parameters:
+    shard_map = _shard_map
+else:
+    def shard_map(f, **kw):
+        # older jax calls the replication-check knob check_rep
+        kw["check_rep"] = kw.pop("check_vma", False)
+        return _shard_map(f, **kw)
 from jax.sharding import Mesh, PartitionSpec as P
 
 from gatekeeper_tpu.engine.veval import _eval_topk, pad_rank

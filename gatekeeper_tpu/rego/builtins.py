@@ -716,28 +716,30 @@ def _time_now_ns():
 def _time_parse_rfc3339_ns(s):
     from datetime import datetime
     raw = _need_string(s, "time.parse_rfc3339_ns")
+    iso = raw.replace("Z", "+00:00")
+    # integer arithmetic: datetime holds microseconds, and
+    # fromisoformat on Python < 3.11 rejects fractions longer than 6
+    # digits outright — so split the fraction off the string and carry
+    # it as integer nanoseconds ourselves
+    ns_frac = 0
+    if "." in iso:
+        head, rest = iso.split(".", 1)
+        i = 0
+        while i < len(rest) and rest[i].isdigit():
+            i += 1
+        if i == 0:
+            raise BuiltinError(
+                f"time.parse_rfc3339_ns: empty fractional second in {raw!r}")
+        ns_frac = int((rest[:i] + "000000000")[:9])
+        iso = head + rest[i:]
     try:
-        dt = datetime.fromisoformat(raw.replace("Z", "+00:00"))
+        dt = datetime.fromisoformat(iso)
     except ValueError as e:
         raise BuiltinError(f"time.parse_rfc3339_ns: {e}")
     if dt.tzinfo is None:
         raise BuiltinError(
             f"time.parse_rfc3339_ns: missing timezone offset in {raw!r}")
-    # integer arithmetic: datetime holds microseconds; preserve the
-    # sub-microsecond digits from the raw string
-    ns_frac = 0
-    if "." in raw:
-        frac = raw.split(".", 1)[1]
-        digits = ""
-        for ch in frac:
-            if ch.isdigit():
-                digits += ch
-            else:
-                break
-        digits = (digits + "000000000")[:9]
-        ns_frac = int(digits)
-    whole = dt.replace(microsecond=0)
-    return int(whole.timestamp()) * 1_000_000_000 + ns_frac
+    return int(dt.timestamp()) * 1_000_000_000 + ns_frac
 
 
 def _ns_to_utc(ns, op):
@@ -1223,3 +1225,16 @@ REGISTRY: dict[tuple[str, ...], Callable] = {
         "set" if isinstance(x, frozenset) else
         "object"),
 }
+
+
+# Builtins whose result can change between two calls with identical
+# arguments (clocks, tracing side effects, signature verification that
+# consults the clock for exp/nbf).  Any cross-constraint or cross-review
+# memoization layer (rego/closures._review_shareable, and whatever comes
+# next) must refuse to cache a computation that calls one of these.
+# New clock/random/IO builtins belong here the day they are registered.
+IMPURE_BUILTINS: frozenset[tuple[str, ...]] = frozenset({
+    ("trace",),                         # tracer side effect per call
+    ("time", "now_ns"),                 # per-query clock
+    ("io", "jwt", "decode_verify"),     # checks exp/nbf against the clock
+})

@@ -671,10 +671,12 @@ class ClosureCompiler:
                 return
             if cls is Call:
                 nm = t.name
-                if nm in (("trace",), ("time", "now_ns")) or \
+                if nm in bi.IMPURE_BUILTINS or \
                         (len(nm) == 1 and nm[0] in interp.rules):
-                    impure = True       # side effects / per-query clock /
-                    return              # user functions (may read constraint)
+                    impure = True       # impure builtin (clock/trace/jwt
+                    return              # verify) or user function (may
+                    #                     read constraint) — see
+                    #                     builtins.IMPURE_BUILTINS
                 for a in t.args:
                     visit(a)
                 return

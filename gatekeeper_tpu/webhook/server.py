@@ -150,10 +150,16 @@ class WebhookServer:
                     rbody = _DeadlineBody(
                         self.rfile, self.connection,
                         time.monotonic() + request_timeout)
-                    payload = rbody.read(length)
-                    # restore the idle timeout the deadline reads shrank
-                    # (keep-alive: the next request starts fresh)
-                    self.connection.settimeout(request_timeout)
+                    try:
+                        payload = rbody.read(length)
+                    finally:
+                        # restore the idle timeout the deadline reads
+                        # shrank — in a finally, because when the read
+                        # itself times out the 400 below would otherwise
+                        # be written against a near-zero socket timeout
+                        # and die mid-send (keep-alive: the next request
+                        # starts fresh either way)
+                        self.connection.settimeout(request_timeout)
                     body = json.loads(payload or b"{}")
                     request = body.get("request") or {}
                     response = outer.handler.handle(request)

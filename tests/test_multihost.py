@@ -13,6 +13,8 @@ import socket
 import subprocess
 import sys
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
@@ -48,6 +50,9 @@ def test_two_process_distributed_audit():
             if p.poll() is None:
                 p.kill()
     for rc, out, err in outs:
+        if rc != 0 and "Multiprocess computations aren't implemented" in err:
+            pytest.skip("this jaxlib's CPU backend has no multiprocess "
+                        "collectives (needs jax >= 0.5)")
         assert rc == 0, f"worker failed rc={rc}\n{out}\n{err[-3000:]}"
         assert "MULTIHOST OK" in out, out
     # both ranks computed identical (replicated) counts

@@ -29,6 +29,24 @@ def test_probe_rejects_in_use_driver():
         Probe(d)
 
 
+def test_probe_verdict_unavailable_on_construct_failure(monkeypatch, capsys):
+    """A JaxDriver that fails to CONSTRUCT never ran a single [jax]
+    scenario — the verdict line a deploy gate greps must say
+    'unavailable', not 'device' (or even 'scalar-fallback')."""
+    from gatekeeper_tpu.client import probe as probe_mod
+
+    class Broken:
+        def __init__(self):
+            raise RuntimeError("no backend")
+
+    monkeypatch.setattr(
+        "gatekeeper_tpu.engine.jax_driver.JaxDriver", Broken)
+    rc = probe_mod.main()
+    out = capsys.readouterr().out.strip().splitlines()
+    assert rc == 1
+    assert out[-1] == "PROBE FAIL (jax engine served by: unavailable)"
+
+
 def test_probe_failure_carries_engine_dump(monkeypatch):
     probe = Probe(LocalDriver())
 

@@ -88,6 +88,34 @@ violation[{"msg": m}] {
     assert q(["b"]) == ['vals: {"b"}']
 
 
+def test_impure_builtins_never_shared():
+    """A comprehension that LOOKS review-pure but calls an impure
+    builtin (clock, trace, signature verification that consults the
+    clock for exp/nbf) must never be memoized across a review — the
+    central builtins.IMPURE_BUILTINS set is the single gate."""
+    from gatekeeper_tpu.rego import builtins as bi
+    from gatekeeper_tpu.rego.ast_nodes import Comprehension
+
+    assert ("trace",) in bi.IMPURE_BUILTINS
+    assert ("time", "now_ns") in bi.IMPURE_BUILTINS
+    assert ("io", "jwt", "decode_verify") in bi.IMPURE_BUILTINS
+
+    mod = """package t
+violation[{"msg": "x"}] {
+	toks := {t | raw := input.review.object.toks[_];
+	             t := io.jwt.decode_verify(raw, {"secret": "s"})}
+	count(toks) > 0
+}
+"""
+    interp = Interpreter(parse_module(mod))
+    comp = [t for r in interp.module.rules for lit in r.body
+            for t in [lit.expr.rhs] if isinstance(t, Comprehension)]
+    assert comp, "expected a comprehension in the test rule"
+    # every one is refused: the jwt verification consults the clock
+    assert all(interp._closures._review_shareable(c) is None
+               for c in comp)
+
+
 def test_with_override_bypasses_shared():
     mod = """package t
 p := {l | input.review.object.metadata.labels[l]}

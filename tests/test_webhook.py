@@ -305,6 +305,38 @@ class TestHardening:
         finally:
             server.stop()
 
+    def test_body_deadline_writes_400(self, handler):
+        """When the wall-clock body deadline expires, the client gets a
+        real 400 response — the near-zero socket timeout the deadline
+        reads shrank is restored (in a finally) before the error is
+        written, so the 400 doesn't die mid-send."""
+        import socket
+        server = WebhookServer(handler, port=0, request_timeout=1.0)
+        server.start()
+        try:
+            s = socket.create_connection(("127.0.0.1", server.port),
+                                         timeout=5)
+            s.sendall(b"POST /v1/admit HTTP/1.1\r\nHost: x\r\n"
+                      b"Content-Length: 10000\r\n\r\n")
+            s.sendall(b"x" * 10)       # partial body, then stall
+            s.settimeout(8)
+            data = b""
+            try:
+                while True:
+                    chunk = s.recv(4096)
+                    if not chunk:
+                        break
+                    data += chunk
+            except socket.timeout:
+                pass
+            # stdlib BaseHTTPRequestHandler speaks HTTP/1.0 by default —
+            # assert the status token, not the version prefix
+            first = data.split(b"\r\n", 1)[0]
+            assert b" 400" in first, data or b"<connection cut, no 400>"
+            s.close()
+        finally:
+            server.stop()
+
     def test_stop_drains_inflight(self, handler):
         """stop() must let an in-flight admission finish (graceful
         drain), not kill it mid-response."""
