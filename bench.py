@@ -177,6 +177,12 @@ def _slim_headline() -> dict:
                                "serial_full_seconds", "pipeline_speedup",
                                "overlap_fraction")
                               if fs.get(k) is not None}
+    xd = DETAIL.get("external_data")
+    if isinstance(xd, dict):
+        slim["external_data"] = {k: xd.get(k) for k in
+                                 ("baseline_seconds", "cold_seconds",
+                                  "warm_seconds", "warm_overhead_fraction")
+                                 if xd.get(k) is not None}
     if DETAIL.get("aborted"):
         slim["aborted"] = DETAIL["aborted"]
     return slim
@@ -773,6 +779,124 @@ def bench_full_sweep(detail):
         f"{oracle_s*1e3:.0f}ms")
 
 
+EXT_SIG_REGO = """package k8sextsig
+violation[{"msg": msg}] {
+  image := input.review.object.spec.image
+  verdict := object.get(external_data({"provider": "bench-sig", "keys": [image]}), ["responses", image], "missing")
+  verdict == "invalid"
+  msg := sprintf("image %v rejected: %v", [image, verdict])
+}
+"""
+
+
+def bench_external_data(detail):
+    """The external_data two-phase path at the library_2000 scale:
+    cold fetch (empty cache — the sweep pays one batched provider round)
+    vs warm cache (every key fresh) vs the no-provider baseline (same
+    workload and library, no external template).  The acceptance metric
+    is warm-cache overhead vs baseline; the two-phase design's claim is
+    that a warm sweep adds only the per-unique-key host gather, so the
+    overhead must stay under 10%."""
+    from gatekeeper_tpu.api.externaldata import Provider
+    from gatekeeper_tpu.externaldata.fake import (FakeProvider, clear_fakes,
+                                                  register_fake)
+    from gatekeeper_tpu.externaldata.runtime import (ExternalDataRuntime,
+                                                     set_runtime)
+    from gatekeeper_tpu.engine.veval import quiesce_upgrades
+
+    n = sized(2_000, 600, 600)
+    n_keys = 256
+    latency_s = 0.02    # simulated provider round-trip (paid once, cold)
+    rng = random.Random(11)
+    resources = make_mixed(rng, n)
+    images = [f"registry.example/app{i}:v1" for i in range(n_keys)]
+    n_pods = 0
+    for r in resources:
+        if r.get("kind") == "Pod":
+            r["spec"]["image"] = rng.choice(images)
+            n_pods += 1
+    log(f"[external-data] {n} resources ({n_pods} pods, {n_keys} distinct "
+        f"keys), provider latency {latency_s*1e3:.0f}ms")
+    from gatekeeper_tpu.engine import jax_driver as jd_mod
+    saved = jd_mod.SMALL_WORKLOAD_EVALS
+    if not FALLBACK:
+        jd_mod.SMALL_WORKLOAD_EVALS = 0
+    full_opts = QueryOpts(limit_per_constraint=CAP, full=True)
+
+    def build(with_ext):
+        jd = JaxDriver()
+        c = Backend(jd).new_client([K8sValidationTarget()])
+        for tdoc, cdoc in all_docs():
+            c.add_template(tdoc)
+            c.add_constraint(cdoc)
+        if with_ext:
+            c.add_template(template_doc("K8sExtSig", EXT_SIG_REGO))
+            c.add_constraint(constraint_doc(
+                "K8sExtSig", "bench-sig-check",
+                match={"kinds": [{"apiGroups": [""], "kinds": ["Pod"]}]}))
+        c.add_data_batch(resources)
+        return jd, c
+
+    def best_full(jd, reps=3):
+        times = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            results, _ = jd.query_audit(TARGET_NAME, full_opts)
+            times.append(time.perf_counter() - t0)
+        return min(times), len(results)
+
+    prev_rt = None
+    rt = ExternalDataRuntime()
+    try:
+        # no-provider baseline
+        jd, c = build(with_ext=False)
+        jd.query_audit(TARGET_NAME, full_opts)      # compile warm
+        quiesce_upgrades()
+        baseline_s, _nb = best_full(jd)
+        del c, jd
+
+        prev_rt = set_runtime(rt)
+        data = {img: ("invalid" if i % 10 == 0 else "valid")
+                for i, img in enumerate(images)}
+        fake = register_fake("bench-sig", FakeProvider(data,
+                                                       latency_s=latency_s))
+        provider = Provider(name="bench-sig", url="fake://bench-sig",
+                            failure_policy="Ignore", retries=0,
+                            cache_ttl_s=600.0)
+        rt.register(provider)
+        jd, c = build(with_ext=True)
+        jd.query_audit(TARGET_NAME, full_opts)      # compile warm (+fetch)
+        quiesce_upgrades()
+        rt.register(provider)       # re-register: drops cache -> cold
+        calls_before = fake.calls
+        t0 = time.perf_counter()
+        results, _ = jd.query_audit(TARGET_NAME, full_opts)
+        cold_s = time.perf_counter() - t0
+        cold_batches = fake.calls - calls_before
+        warm_s, n_ext = best_full(jd)
+        del c, jd
+    finally:
+        jd_mod.SMALL_WORKLOAD_EVALS = saved
+        set_runtime(prev_rt)
+        clear_fakes()
+
+    overhead = (warm_s / baseline_s - 1.0) if baseline_s else 0.0
+    detail["external_data"] = {
+        "n_resources": n, "n_pods": n_pods, "n_keys": n_keys,
+        "provider_latency_s": latency_s,
+        "baseline_seconds": round(baseline_s, 4),
+        "cold_seconds": round(cold_s, 4),
+        "warm_seconds": round(warm_s, 4),
+        "warm_overhead_fraction": round(overhead, 4),
+        "cold_fetch_batches": cold_batches,
+        "results_with_ext": n_ext,
+        "provider_stats": rt.stats().get("bench-sig"),
+    }
+    log(f"[external-data] baseline {baseline_s*1e3:.0f}ms | cold "
+        f"{cold_s*1e3:.0f}ms ({cold_batches} batched round(s)) | warm "
+        f"{warm_s*1e3:.0f}ms ({overhead:+.1%} vs baseline)")
+
+
 def bench_selector_heavy(detail):
     """namespaceSelector-heavy matching at 100k namespaces: the
     namespace-axis selector evaluation is the cost center (VERDICT r2
@@ -1209,6 +1333,8 @@ def main():
     run_phase("library", bench_library, 700)
     quiesce_upgrades()
     run_phase("full_sweep", bench_full_sweep, 400)
+    quiesce_upgrades()
+    run_phase("external_data", bench_external_data, 300)
     quiesce_upgrades()
     run_phase("regex_heavy", bench_regex_heavy, 300)
     run_phase("selector_heavy", bench_selector_heavy, 300)

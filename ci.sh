@@ -44,7 +44,14 @@ for ln in reversed(raw.splitlines()):
 assert d is not None, f"no JSON headline in the trailing 2000 bytes: {raw!r}"
 assert len(line) <= 1500, f"headline is {len(line)} chars (> 1500)"
 assert d["metric"] and d["value"] > 0, d
+# the external_data row must survive the same tail window: the
+# cold/warm/baseline numbers are the PR's acceptance record
+xd = d.get("external_data")
+assert isinstance(xd, dict) and "warm_seconds" in xd \
+    and "baseline_seconds" in xd, \
+    f"no external_data row in the trailing headline: {d}"
 print("bench ok:", d["metric"], round(d["value"], 1), d["unit"],
-      f"({len(line)} headline chars)")
+      f"({len(line)} headline chars; external_data warm "
+      f"{xd['warm_seconds']}s vs baseline {xd['baseline_seconds']}s)")
 EOF
 echo "CI PASS"

@@ -304,6 +304,26 @@ class Client:
                     responses[i].handled[name] = True
             return responses
 
+    def prefetch_external(self, objs: list) -> None:
+        """Warm the external-data provider caches for a micro-batch
+        ahead of evaluation (the webhook batcher wires this in): one
+        batched fetch round per provider covering every key any review
+        in the batch will look up.  Best-effort and a no-op on drivers
+        without the prefetch surface."""
+        fn = getattr(self.driver, "prefetch_external_for_reviews", None)
+        if fn is None:
+            return
+        with self._lock.read():
+            for name, handler in self.targets.items():
+                reviews: list = []
+                for obj in objs:
+                    try:
+                        reviews.append(handler.handle_review(obj))
+                    except UnhandledData:
+                        continue
+                if reviews:
+                    fn(name, reviews)
+
     def audit(self, tracing: bool = False,
               limit_per_constraint: int | None = None,
               full: bool = False) -> Responses:

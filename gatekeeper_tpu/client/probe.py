@@ -253,9 +253,32 @@ class Probe:
         return run
 
 
-def main() -> int:
+def list_builtins() -> list[str]:
+    """``--builtins``: one line per registered Rego builtin, dotted
+    name sorted, with unsupported stubs marked and their recorded
+    reason shown (the `_unsupported` factory tags its stubs).  The
+    sanctioned-egress pointer lives here too: readers checking why
+    http.send is refused find external_data in the same listing."""
+    from gatekeeper_tpu.rego import builtins as bi
+    lines = []
+    for name in sorted(bi.REGISTRY):
+        dotted = ".".join(name)
+        fn = bi.REGISTRY[name]
+        reason = getattr(fn, "unsupported_reason", None)
+        if reason is not None:
+            lines.append(f"  {dotted:36s} UNSUPPORTED: {reason}")
+        elif name == ("external_data",):
+            lines.append(f"  {dotted:36s} provider lookups (batched, "
+                         "TTL-cached, circuit-broken; see Provider CRs)")
+        else:
+            lines.append(f"  {dotted}")
+    return lines
+
+
+def main(argv=None) -> int:
     """``python -m gatekeeper_tpu.client.probe``: self-validate both
     engines (the readiness wiring the reference's Probe exists for).
+    ``--builtins`` lists the builtin registry instead of probing.
 
     The verdict line names the backend that actually served the [jax]
     scenarios: with a dead/unreachable device the driver falls back to
@@ -264,6 +287,11 @@ def main() -> int:
     GATEKEEPER_PROBE_REQUIRE_DEVICE=1 turns it into a failure."""
     import os
     import sys
+
+    argv = sys.argv[1:] if argv is None else argv
+    if "--builtins" in argv:
+        print("\n".join(list_builtins()))
+        return 0
 
     from gatekeeper_tpu.client.local_driver import LocalDriver
     from gatekeeper_tpu.engine.jax_driver import JaxDriver

@@ -25,6 +25,7 @@ import threading
 
 from gatekeeper_tpu.utils.log import logger
 from gatekeeper_tpu.api.config import GVK, empty_config_object
+from gatekeeper_tpu.api.externaldata import PROVIDER_GVK
 from gatekeeper_tpu.audit.manager import (CRD_NAME, AuditManager,
                                           DEFAULT_AUDIT_INTERVAL,
                                           DEFAULT_VIOLATIONS_LIMIT)
@@ -54,6 +55,7 @@ def bootstrap_cluster(cluster) -> None:
     if hasattr(cluster, "register_kind"):
         cluster.register_kind(TEMPLATE_GVK, "constrainttemplates")
         cluster.register_kind(CONFIG_GVK, "configs")
+        cluster.register_kind(PROVIDER_GVK, "providers")
         # core kinds every conformant apiserver serves (sync configs
         # routinely watch these; the fake's discovery must agree)
         for kind, plural in (("Namespace", "namespaces"), ("Pod", "pods"),
@@ -70,6 +72,9 @@ def bootstrap_cluster(cluster) -> None:
               "ConstraintTemplate", "constrainttemplates")
     apply_crd(cluster, "configs.config.gatekeeper.sh", "config.gatekeeper.sh",
               "v1alpha1", "Config", "configs")
+    apply_crd(cluster, "providers.externaldata.gatekeeper.sh",
+              "externaldata.gatekeeper.sh", "v1beta1", "Provider",
+              "providers", namespaced=False)
 
 
 class Manager:
@@ -100,11 +105,25 @@ class Manager:
         else:
             driver = JaxDriver(tracing=False)
         self.client = Backend(driver).new_client([K8sValidationTarget()])
-        self.plane: ControlPlane = add_to_manager(self.cluster, self.client)
+        # external-data runtime: installed process-globally (the
+        # `external_data` builtin resolves it there) and instrumented
+        # through the manager's metrics registry
+        from gatekeeper_tpu.externaldata.runtime import (ExternalDataRuntime,
+                                                         set_runtime)
+        self.external_data = ExternalDataRuntime(metrics=self.metrics)
+        set_runtime(self.external_data)
+        self.plane: ControlPlane = add_to_manager(
+            self.cluster, self.client, external_data=self.external_data)
+        from gatekeeper_tpu.webhook.server import REQUEST_TIMEOUT_S
         self.batcher = MicroBatcher(
             lambda reqs: self.client.review_batch(reqs),
             max_batch=args.max_batch, max_wait=args.batch_window_ms / 1000.0,
-            metrics=self.metrics)
+            metrics=self.metrics,
+            # a submit must give up before the server's own request
+            # deadline so the caller still gets a clean 500, not a
+            # severed connection
+            submit_timeout=REQUEST_TIMEOUT_S * 0.9,
+            prefetch=self.client.prefetch_external)
         self.handler = ValidationHandler(self.client, cluster=self.cluster,
                                          batcher=self.batcher,
                                          metrics=self.metrics,

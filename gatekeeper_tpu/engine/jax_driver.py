@@ -47,6 +47,7 @@ from gatekeeper_tpu.client.local_driver import (LocalDriver, TargetState,
                                                 locked, locked_read)
 from gatekeeper_tpu.client.types import Result
 from gatekeeper_tpu.engine.veval import ProgramExecutor
+from gatekeeper_tpu.errors import ExternalDataError
 from gatekeeper_tpu.ir.lower import CannotLower, lower_template
 from gatekeeper_tpu.ir.prep import build_bindings
 from gatekeeper_tpu.rego.values import freeze
@@ -417,6 +418,114 @@ class JaxDriver(LocalDriver):
             if base not in st.table._elem_cache:
                 st.table.prefetch_elem_arrays(base, sorted(rels))
 
+    def _ext_specs(self, st) -> list[tuple[str, tuple[str, ...]]]:
+        """(provider, review-column path) pairs across the target's
+        lowered kinds — the sweep-level key-collection scan of the
+        two-phase external-data design."""
+        specs: list[tuple[str, tuple[str, ...]]] = []
+        for kind in sorted(st.templates):
+            lowered = st.templates[kind].vectorized
+            if lowered is None or not self._kind_constraints(st, kind):
+                continue
+            for tr in lowered.spec.tables:
+                if not tr.ext_providers or not tr.src.startswith("r:val:"):
+                    # e-col-keyed lookups are rare; the build-time
+                    # prefetch hook still batches them per table build
+                    continue
+                path = tuple(tr.src[len("r:val:"):].split("."))
+                for provider in tr.ext_providers:
+                    specs.append((provider, path))
+        return specs
+
+    def _prefetch_external(self, st) -> dict | None:
+        """Bulk-warm every (provider, distinct key) pair the sweep's
+        external-data tables will gather — one batched round per
+        provider, overlapped with host prep (the caller submits this to
+        the sweep pool; single-flight in the provider cache dedupes
+        against the build-time hook racing it).  Returns stats for the
+        audit report, or None when there is nothing to do."""
+        from gatekeeper_tpu.externaldata.runtime import get_runtime
+        rt = get_runtime()
+        if rt is None:
+            return None
+        specs = self._ext_specs(st)
+        if not specs:
+            return None
+        import time as _time
+        from gatekeeper_tpu.ir.encode import decode_value
+        from gatekeeper_tpu.store.columns import ColSpec
+        t0 = _time.perf_counter()
+        interner = st.table.interner
+        by_provider: dict[str, dict] = {}
+        for provider, path in specs:
+            want = by_provider.setdefault(provider, {})
+            ids = np.unique(st.table.column(ColSpec(path, "val")).ids)
+            for uid in ids[ids >= 0].tolist():
+                v = decode_value(interner.string(uid))
+                if isinstance(v, str):
+                    want[v] = True
+        n_keys = 0
+        for provider, want in by_provider.items():
+            n_keys += len(want)
+            if want:
+                rt.prefetch(provider, list(want))
+        return {"providers": len(by_provider), "keys": n_keys,
+                "prefetch_s": round(_time.perf_counter() - t0, 6)}
+
+    @staticmethod
+    def _external_sweep_stats(ext_fut) -> dict | None:
+        """Sweep-report payload: the overlapped bulk-warm's numbers plus
+        every provider's breaker state / cache hit ratio / fetch
+        timings.  None when no runtime or no provider is configured."""
+        from gatekeeper_tpu.externaldata.runtime import get_runtime
+        rt = get_runtime()
+        if rt is None or not rt.provider_names():
+            return None
+        bulk = None
+        if ext_fut is not None and ext_fut.done():
+            try:
+                bulk = ext_fut.result()
+            except Exception:   # noqa: BLE001 — report-only path
+                bulk = None
+        out: dict = {"providers": rt.stats()}
+        if bulk is not None:
+            out["bulk_prefetch"] = bulk
+        return out
+
+    @locked_read
+    def prefetch_external_for_reviews(self, target: str,
+                                      reviews: list[dict]) -> None:
+        """Batched external-data warm for an admission micro-batch: one
+        fetch round per provider covering every key any review in the
+        batch will look up.  Wired ahead of MicroBatcher evaluation so
+        fetch latency is paid once per batch — including batches small
+        enough to fall back to per-review scalar queries, which would
+        otherwise fetch key-by-key."""
+        from gatekeeper_tpu.externaldata.runtime import get_runtime
+        rt = get_runtime()
+        if rt is None:
+            return
+        st = self._state(target)
+        if not isinstance(st, JaxTargetState):
+            return
+        specs = self._ext_specs(st)
+        if not specs:
+            return
+        from gatekeeper_tpu.store.columns import iter_path
+        by_provider: dict[str, dict] = {}
+        for provider, path in specs:
+            want = by_provider.setdefault(provider, {})
+            for rv in reviews:
+                obj = rv.get("object") if isinstance(rv, dict) else None
+                if not isinstance(obj, dict):
+                    continue
+                for v in iter_path(obj, path):
+                    if isinstance(v, str):
+                        want[v] = True
+        for provider, want in by_provider.items():
+            if want:
+                rt.prefetch(provider, list(want))
+
     @locked
     def put_data_batch(self, target: str, entries) -> None:
         # the parent method is itself @locked and the RW lock is not
@@ -663,6 +772,10 @@ class JaxDriver(LocalDriver):
             pool = concurrent.futures.ThreadPoolExecutor(max_workers=8)
             specs: list[tuple] = []
             futures: list = []
+            # bulk external-data warm, overlapped with host prep: by the
+            # time a kind's build loop asks for a key it is a cache hit
+            # (or a single-flight wait on this very fetch)
+            ext_fut = pool.submit(self._prefetch_external, st)
             # cross-host collective ordering: on a mesh spanning
             # processes, collective launches must happen in the SAME
             # order on every process (see veval._COLLECTIVE_EXEC_LOCK
@@ -710,8 +823,19 @@ class JaxDriver(LocalDriver):
                             < SMALL_WORKLOAD_EVALS
                         if compiled.vectorized is not None and mask is not None \
                                 and not small:
-                            bindings = self._kind_bindings(st, kind, compiled,
-                                                           constraints)
+                            try:
+                                bindings = self._kind_bindings(
+                                    st, kind, compiled, constraints)
+                            except ExternalDataError:
+                                # failurePolicy Fail during this kind's
+                                # table build: contained per kind — its
+                                # violations are unknown this sweep, every
+                                # other template is unaffected
+                                self.metrics.counter(
+                                    "external_data_kind_failures").inc()
+                                ph["host_prep_s"] += \
+                                    _time.perf_counter() - _tk
+                                continue
                             if bindings.f32_unsafe:
                                 # some bound numeric value does not survive a
                                 # float32 round-trip (|v| past 2^24): device
@@ -778,21 +902,28 @@ class JaxDriver(LocalDriver):
                     mode, kind, compiled, constraints, prog, bindings, \
                         mask = spec
                     _tf = _time.perf_counter()
-                    if mode == "topk":
-                        self._format_topk(st, target, handler, compiled,
-                                          constraints, prog, bindings, mask,
-                                          rank, row_order, kind, limit, trace,
-                                          tagged, handle, rcache)
-                    elif mode == "mask":
-                        self._format_pairs(st, target, handler, compiled,
-                                           constraints, handle.get(),
-                                           row_order, kind, limit, trace,
-                                           tagged, rcache)
-                    else:
-                        self._scalar_kind(st, target, handler, compiled,
-                                          constraints, mask, ordered_rows,
-                                          row_order, kind, limit, trace,
-                                          tagged, rcache)
+                    try:
+                        if mode == "topk":
+                            self._format_topk(st, target, handler, compiled,
+                                              constraints, prog, bindings,
+                                              mask, rank, row_order, kind,
+                                              limit, trace, tagged, handle,
+                                              rcache)
+                        elif mode == "mask":
+                            self._format_pairs(st, target, handler, compiled,
+                                               constraints, handle.get(),
+                                               row_order, kind, limit, trace,
+                                               tagged, rcache)
+                        else:
+                            self._scalar_kind(st, target, handler, compiled,
+                                              constraints, mask, ordered_rows,
+                                              row_order, kind, limit, trace,
+                                              tagged, rcache)
+                    except ExternalDataError:
+                        # scalar-oracle re-check hit a Fail-policy
+                        # provider failure: same per-kind containment as
+                        # the prep loop
+                        m.counter("external_data_kind_failures").inc()
                     fmt_s += _time.perf_counter() - _tf
 
                 if trace is None:
@@ -865,6 +996,9 @@ class JaxDriver(LocalDriver):
                     "pipeline_wall_s": round(pipeline_wall, 6),
                     "overlap_fraction": round(overlap, 4),
                 }
+                ext = self._external_sweep_stats(ext_fut)
+                if ext is not None:
+                    self.last_sweep_phases["external"] = ext
                 m.counter("full_sweeps").inc()
                 m.timer("full_sweep_host_prep").observe(ph["host_prep_s"])
                 m.timer("full_sweep_h2d").observe(ph["h2d_s"])
@@ -874,6 +1008,9 @@ class JaxDriver(LocalDriver):
                 m.gauge("full_sweep_overlap_fraction").set(overlap)
             else:
                 self.last_sweep_phases = {"full": False}
+                ext = self._external_sweep_stats(ext_fut)
+                if ext is not None:
+                    self.last_sweep_phases["external"] = ext
             return [r for _, r in tagged], ("\n".join(trace) if trace is not None else None)
         finally:
             # ALWAYS cleared — a dispatch error leaving this set

@@ -65,6 +65,16 @@ class ConflictError(EvalError):
     """Complete rule / function produced two different values."""
 
 
+class ExternalDataError(GatekeeperError):
+    """External-data provider failure surfaced under failurePolicy Fail.
+
+    Deliberately NOT a BuiltinError subclass: builtin errors route to
+    undefined (rule silently doesn't fire -> request admitted), which is
+    exactly the wrong outcome for a fail-closed provider.  This type
+    propagates out of evaluation so the webhook denies with 500 and the
+    audit sweep can contain the failure per template kind."""
+
+
 class StorageError(GatekeeperError):
     """Path-addressed data store errors (conflicts, missing parents)."""
 

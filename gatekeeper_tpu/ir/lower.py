@@ -809,6 +809,51 @@ class Lowerer:
             return "r:meta:" + ".".join(leaf.path)
         return f"e:{mode}:{leaf.root}:" + ".".join(leaf.path)
 
+    @staticmethod
+    def _collect_ext_providers(term: Term) -> tuple[str, ...]:
+        """Providers consulted by external_data calls keyed on the table's
+        leaf — the key-collection pass.  Only the canonical shape
+        ``external_data({"provider": <const>, "keys": [.. __leaf0__ ..]})``
+        is recognized (the regex-detection precedent: exact shape or
+        nothing): for it, the table's distinct source-column values ARE
+        the provider's key set, so prep can warm them in one batched
+        round before the per-value host loop runs."""
+        found: list[str] = []
+
+        def walk(t):
+            if isinstance(t, Call) and t.name == ("external_data",) \
+                    and len(t.args) == 1 \
+                    and isinstance(t.args[0], ObjectTerm):
+                provider = None
+                keyed_on_leaf = False
+                for k, v in t.args[0].pairs:
+                    if isinstance(k, Scalar) and k.value == "provider" \
+                            and isinstance(v, Scalar) \
+                            and isinstance(v.value, str):
+                        provider = v.value
+                    if isinstance(k, Scalar) and k.value == "keys" \
+                            and isinstance(v, ArrayTerm) \
+                            and any(isinstance(it, Var)
+                                    and it.name == "__leaf0__"
+                                    for it in v.items):
+                        keyed_on_leaf = True
+                if provider and keyed_on_leaf:
+                    found.append(provider)
+            for f in getattr(t, "__dataclass_fields__", ()):
+                v = getattr(t, f)
+                if isinstance(v, Term):
+                    walk(v)
+                elif isinstance(v, tuple):
+                    for it in v:
+                        if isinstance(it, Term):
+                            walk(it)
+                        elif isinstance(it, tuple):
+                            for sub in it:
+                                if isinstance(sub, Term):
+                                    walk(sub)
+        walk(term)
+        return tuple(dict.fromkeys(found))
+
     def _table_node(self, sym: SLeafExpr, out: str) -> int:
         """out: 'bool' | 'num' | 'id_val' | 'id_str'."""
         src = self._leaf_col_name(sym.leaf, "val")
@@ -845,7 +890,9 @@ class Lowerer:
                 and term.args[1].name == "__leaf0__":
             regex = term.args[0].value
         self.tables.append(TableReq(tname, src, fn, out=out, src_val=True,
-                                    regex=regex))
+                                    regex=regex,
+                                    ext_providers=self._collect_ext_providers(
+                                        term)))
         idx = self._emit_leaf(sym.leaf, "val")
         return self._emit("table", (idx,), (tname,))
 

@@ -153,6 +153,13 @@ class TableReq:
     at high unique-value cardinality the build routes through the
     batched DFA engine (ops/regex_dfa) instead of one Python
     re.search per distinct string.
+
+    ext_providers: external-data providers consulted by fn with the
+    column value as the lookup key.  The build warms every (provider,
+    distinct value) pair through the runtime in ONE batched round per
+    provider before running the per-value fn loop, so fn's
+    external_data call is a cache hit — the "key-collection pass" of
+    the two-phase prefetch/gather design.
     """
 
     name: str
@@ -161,6 +168,7 @@ class TableReq:
     out: str = "bool"
     src_val: bool = False
     regex: str | None = None
+    ext_providers: tuple[str, ...] = ()
 
 
 @dataclasses.dataclass(frozen=True)
@@ -478,6 +486,32 @@ def _eval_host(fn, *args):
     return v
 
 
+def _ext_prefetch(tr, uids, interner) -> None:
+    """Key-collection prefetch for external-data tables: warm every
+    (provider, distinct column value) pair in ONE batched round per
+    provider before the per-value fn loop, so each fn call's
+    external_data lookup is a cache hit.  Single-flight in the cache
+    dedupes against any concurrently running bulk warm (the audit
+    sweep's overlapped prefetch).  Never raises: fetch failures are
+    cached outcomes; failurePolicy is applied when fn calls the
+    builtin."""
+    if not tr.ext_providers:
+        return
+    from gatekeeper_tpu.externaldata.runtime import get_runtime
+    rt = get_runtime()
+    if rt is None:
+        return
+    keys = []
+    for uid in uids:
+        key = interner.string(uid)
+        arg = decode_value(key) if tr.src_val else key
+        if isinstance(arg, str):
+            keys.append(arg)
+    if keys:
+        for provider in tr.ext_providers:
+            rt.prefetch(provider, keys)
+
+
 def build_inv_join(req: InvJoinReq, table: ResourceTable,
                    r_pad: int) -> np.ndarray:
     """[r_pad] bool: the review row has a same-valued other object.
@@ -684,6 +718,7 @@ def build_bindings(spec: PrepSpec, table: ResourceTable,
             out[tr.name + ".v"] = vals
             state["tables"][tr.name] = set(uniq.tolist())
             continue
+        _ext_prefetch(tr, uniq.tolist(), interner)
         for uid in uniq.tolist():
             key = interner.string(uid)
             arg = decode_value(key) if tr.src_val else key
@@ -1113,6 +1148,7 @@ def update_bindings(spec: PrepSpec, table: ResourceTable,
             if _regex_table_batch(tr, list(new_ids), interner, ok, vals):
                 state["tables"][tr.name] = evaluated | set(new_ids)
                 continue
+            _ext_prefetch(tr, new_ids, interner)
             for uid in new_ids:
                 key = interner.string(uid)
                 arg = decode_value(key) if tr.src_val else key

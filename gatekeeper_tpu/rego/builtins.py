@@ -430,6 +430,20 @@ def _union(sets):
 def _object_get(obj, key, default):
     if not isinstance(obj, Obj):
         raise BuiltinError("object.get: operand must be object")
+    if isinstance(key, tuple):
+        # OPA >= 0.34 (topdown/object.go builtinObjectGet): an array key
+        # is a path walked element-by-element, with `default` on any miss
+        cur = obj
+        for k in key:
+            if isinstance(cur, Obj) and k in cur:
+                cur = cur[k]
+            elif isinstance(cur, tuple) and isinstance(k, (int, float)) \
+                    and not isinstance(k, bool) and int(k) == k \
+                    and 0 <= int(k) < len(cur):
+                cur = cur[int(k)]
+            else:
+                return default
+        return cur
     return obj[key] if key in obj else default
 
 
@@ -1038,7 +1052,48 @@ def _io_jwt_decode_verify(token, constraints):
 def _unsupported(name: str, why: str):
     def fn(*_a, **_k):
         raise BuiltinError(f"{name}: {why}")
+    # the probe CLI's --builtins listing reads these to mark stubs
+    fn.builtin_name = name
+    fn.unsupported_reason = why
     return fn
+
+
+def _external_data(req):
+    """external_data({"provider": p, "keys": [...]}) — the sanctioned
+    egress path (reference: frameworks' externaldata builtin).  Resolves
+    through the process-global ExternalDataRuntime: batched, TTL-cached,
+    circuit-broken, with the provider's failurePolicy applied.  Returns
+    {"responses": {key: value}, "errors": {key: reason},
+    "system_error": ""} — a keyed map rather than the reference's
+    [key, value] pair list so `object.get(.., ["responses", k], ..)`
+    stays a pure lookup (documented deviation).
+
+    On the vectorized path this builtin never runs per-review: lowering
+    collects (provider, key) pairs host-side, prefetches them in one
+    batched round per provider, and the kernel gathers from the interned
+    device table.  This body is the scalar oracle + host-prep evaluator,
+    which by then serves from the same warmed cache."""
+    if not isinstance(req, Obj):
+        raise BuiltinError("external_data: request must be an object")
+    provider = req["provider"] if "provider" in req else None
+    keys = req["keys"] if "keys" in req else None
+    if not isinstance(provider, str) or not provider:
+        raise BuiltinError("external_data: \"provider\" must be a "
+                           "non-empty string")
+    if not isinstance(keys, (tuple, frozenset)):
+        raise BuiltinError("external_data: \"keys\" must be an array")
+    key_list = sorted_values(keys) if isinstance(keys, frozenset) else \
+        list(keys)
+    for k in key_list:
+        if not isinstance(k, str):
+            raise BuiltinError("external_data: keys must be strings")
+    from gatekeeper_tpu.externaldata.runtime import get_runtime
+    rt = get_runtime()
+    if rt is None:
+        raise BuiltinError(
+            "external_data: no provider runtime configured (register "
+            "Provider objects with the manager, or set_runtime in tests)")
+    return freeze(rt.builtin_call(provider, key_list))
 
 
 def _arith_check(x):
@@ -1109,8 +1164,13 @@ REGISTRY: dict[tuple[str, ...], Callable] = {
     # recorded reason instead of crashing template loads (OPA would
     # halt; routing to undefined keeps audits alive — documented
     # deviation).  http.send is OPA's "unsafe" posture (no egress).
-    ("http", "send"): _unsupported("http.send", "no egress from the "
-                                   "policy engine"),
+    ("http", "send"): _unsupported(
+        "http.send", "ad-hoc egress from the policy engine is not "
+        "allowed; declare a Provider and use "
+        'external_data({"provider": ..., "keys": [...]}) — the '
+        "batched, cached, circuit-broken egress path"),
+    # the sanctioned egress path (see externaldata/)
+    ("external_data",): _external_data,
     ("opa", "runtime"): lambda: Obj({}),
     ("rego", "parse_module"): _unsupported("rego.parse_module",
                                            "OPA-AST output not vendored"),
@@ -1237,4 +1297,5 @@ IMPURE_BUILTINS: frozenset[tuple[str, ...]] = frozenset({
     ("trace",),                         # tracer side effect per call
     ("time", "now_ns"),                 # per-query clock
     ("io", "jwt", "decode_verify"),     # checks exp/nbf against the clock
+    ("external_data",),                 # remote data varies between calls
 })

@@ -31,6 +31,10 @@ class WatchManager:
         self.cluster = cluster
         self.mgr = mgr
         self._lock = threading.RLock()
+        # serializes delta APPLICATION (subscribe re-lists the GVK —
+        # slow against a real API server) without holding _lock, so
+        # roster reads/mutations never block behind in-flight listings
+        self._poll_lock = threading.Lock()
         # registrar name -> intended GVK set (recordKeeper)
         self._intent: dict[str, set[GVK]] = {}
         self._add_fns: dict[str, Callable[[GVK], Reconciler]] = {}
@@ -107,29 +111,51 @@ class WatchManager:
         """Reconcile running watches against intent (updateManagerLoop,
         :165-178, minus the 5 s sleep — callers own the cadence).  GVKs
         not yet served by discovery stay pending and are retried on the
-        next poll."""
-        with self._lock:
-            if self._paused:
-                return
-            desired: set[tuple[str, GVK]] = set()
-            for registrar, gvks in self._intent.items():
-                for gvk in gvks:
-                    if self.cluster.kind_served(gvk):
-                        desired.add((registrar, gvk))
-            current = set(self._active)
-            to_stop = current - desired
-            to_start = desired - current
-            if not to_stop and not to_start:
-                return
-            for key in to_stop:
-                _, unsub = self._active.pop(key)
+        next poll.
+
+        The delta is COMPUTED under ``_lock`` but APPLIED outside it:
+        ``mgr.watch`` re-lists the GVK, which against a real API server
+        is orders of magnitude slower than any roster mutation, and
+        holding the roster lock across it would block every registrar
+        (and ``pause``) behind the listing.  ``_poll_lock`` keeps
+        appliers single-file; a started watch is only installed if its
+        intent still stands when the lock is retaken — otherwise it is
+        unsubscribed on the spot (pause or intent churn mid-listing)."""
+        with self._poll_lock:
+            unsubs: list = []
+            with self._lock:
+                if self._paused:
+                    return
+                desired: set[tuple[str, GVK]] = set()
+                for registrar, gvks in self._intent.items():
+                    for gvk in gvks:
+                        if self.cluster.kind_served(gvk):
+                            desired.add((registrar, gvk))
+                current = set(self._active)
+                to_stop = current - desired
+                to_start = sorted(desired - current,
+                                  key=lambda k: (k[0], k[1]))
+                if not to_stop and not to_start:
+                    return
+                for key in to_stop:
+                    _, unsub = self._active.pop(key)
+                    unsubs.append(unsub)
+                add_fns = dict(self._add_fns)
+            for unsub in unsubs:
                 unsub()
-            for registrar, gvk in sorted(to_start,
-                                         key=lambda k: (k[0], k[1])):
-                reconciler = self._add_fns[registrar](gvk)
+            started: list[tuple[tuple[str, GVK], tuple]] = []
+            for registrar, gvk in to_start:
+                reconciler = add_fns[registrar](gvk)
                 unsub = self.mgr.watch(gvk, reconciler)
-                self._active[(registrar, gvk)] = (reconciler, unsub)
-            self.generation += 1
+                started.append(((registrar, gvk), (reconciler, unsub)))
+            with self._lock:
+                for (registrar, gvk), entry in started:
+                    if self._paused or \
+                            gvk not in self._intent.get(registrar, ()):
+                        entry[1]()      # stale: intent moved on mid-listing
+                    else:
+                        self._active[(registrar, gvk)] = entry
+                self.generation += 1
 
 
 class Registrar:

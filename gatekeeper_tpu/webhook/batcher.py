@@ -18,6 +18,7 @@ from __future__ import annotations
 import threading
 from typing import Callable
 
+from gatekeeper_tpu.errors import GatekeeperError
 from gatekeeper_tpu.utils.metrics import Metrics
 
 
@@ -31,14 +32,31 @@ class _Pending:
         self.error: Exception | None = None
 
 
+class SubmitTimeout(GatekeeperError):
+    """submit() waited past its deadline for batch evaluation.  A
+    GatekeeperError so the webhook handler's existing catch turns it
+    into a clean deny-500 instead of a severed connection."""
+
+
 class MicroBatcher:
     def __init__(self, evaluate_batch: Callable[[list[dict]], list],
                  max_batch: int = 64, max_wait: float = 0.002,
-                 metrics: Metrics | None = None):
+                 metrics: Metrics | None = None,
+                 submit_timeout: float = 30.0,
+                 prefetch: Callable[[list[dict]], None] | None = None):
         self.evaluate_batch = evaluate_batch
         self.max_batch = max_batch
         self.max_wait = max_wait
         self.metrics = metrics if metrics is not None else Metrics()
+        # deadline on the caller's wait: a wedged evaluation (hung
+        # device dispatch, stuck external fetch) must not pin webhook
+        # handler threads forever — the server derives this from its
+        # own request deadline
+        self.submit_timeout = submit_timeout
+        # best-effort per-batch warm hook (external-data prefetch): runs
+        # once per formed batch before evaluation so provider fetch
+        # latency is paid once for the whole batch
+        self.prefetch = prefetch
         self._queue: list[_Pending] = []
         self._lock = threading.Lock()
         self._wake = threading.Condition(self._lock)
@@ -62,8 +80,13 @@ class MicroBatcher:
 
     # ------------------------------------------------------------------
 
-    def submit(self, request: dict):
-        """Block until the batch containing this request is evaluated."""
+    def submit(self, request: dict, timeout: float | None = None):
+        """Block until the batch containing this request is evaluated,
+        or until ``timeout`` (default: the batcher's submit_timeout)
+        expires — then raise SubmitTimeout.  A timed-out request still
+        queued is withdrawn so the worker never evaluates for a caller
+        that already gave up; one already taken into a batch keeps
+        evaluating (the result is discarded, the thread is freed)."""
         if self._thread is None:
             # no worker: degrade to a single-request batch inline
             return self.evaluate_batch([request])[0]
@@ -71,7 +94,16 @@ class MicroBatcher:
         with self._wake:
             self._queue.append(p)
             self._wake.notify()
-        p.event.wait()
+        deadline = self.submit_timeout if timeout is None else timeout
+        if not p.event.wait(deadline):
+            with self._wake:
+                try:
+                    self._queue.remove(p)
+                except ValueError:
+                    pass    # already taken into a batch
+            self.metrics.counter("admission_submit_timeouts").inc()
+            raise SubmitTimeout(
+                f"admission batch evaluation exceeded {deadline:.3f}s")
         if p.error is not None:
             raise p.error
         return p.response
@@ -103,6 +135,11 @@ class MicroBatcher:
                 continue
             self.metrics.counter("admission_batches").inc()
             self.metrics.timer("admission_batch_size").observe(len(batch))
+            if self.prefetch is not None:
+                try:
+                    self.prefetch([p.request for p in batch])
+                except Exception:   # noqa: BLE001 — warm-up only;
+                    pass            # evaluation applies real policy
             try:
                 responses = self.evaluate_batch([p.request for p in batch])
                 for p, r in zip(batch, responses):
