@@ -7,6 +7,12 @@ cd "$(dirname "$0")"
 
 echo "== lint =="
 python -m compileall -q gatekeeper_tpu
+# Stage-1/Stage-2 static analysis over every library template: any
+# error-severity finding fails the build (warnings admit)
+JAX_PLATFORMS=cpu python -m gatekeeper_tpu.client.probe --lint --library | tail -1
+# host-sync self-lint: no block_until_ready / np.asarray / time.time
+# inside kernel-side (jitted) functions of the engine or the IR layer
+python -m gatekeeper_tpu.analysis.selflint gatekeeper_tpu/engine gatekeeper_tpu/ir
 
 echo "== tests (virtual 8-device CPU mesh) =="
 python -m pytest tests/ -q

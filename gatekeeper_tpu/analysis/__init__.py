@@ -1,0 +1,19 @@
+"""Install-time static analysis: the two-stage template vetter.
+
+Stage 1 (:mod:`.vetter`) walks the parsed Rego AST; Stage 2
+(:mod:`.ir_verifier`) validates lowered device programs against their
+PrepSpec.  Both emit :class:`.diagnostics.Diagnostic` records whose
+codes follow the reference gatekeeper's ``status.byPod[].errors``
+shape.  :mod:`.purity` is the single impure-builtin gate shared with
+the shareable-review escape analysis; :mod:`.selflint` is the CI
+host-sync lint over kernel-side code.
+"""
+
+from gatekeeper_tpu.analysis.diagnostics import (   # noqa: F401
+    ERROR, WARNING, Diagnostic, errors, format_all, has_errors,
+)
+from gatekeeper_tpu.analysis.purity import (        # noqa: F401
+    is_impure_builtin, is_impure_call,
+)
+from gatekeeper_tpu.analysis.vetter import vet_module        # noqa: F401
+from gatekeeper_tpu.analysis.ir_verifier import verify_program  # noqa: F401

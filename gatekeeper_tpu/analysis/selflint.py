@@ -1,0 +1,211 @@
+"""Host-sync self-lint for kernel-side code.
+
+``python -m gatekeeper_tpu.analysis.selflint <dir>...`` parses every
+``.py`` file under the given directories and flags host-synchronizing
+calls — ``.block_until_ready(...)``, ``np.asarray(...)`` /
+``numpy.asarray(...)``, ``time.time()`` — that appear INSIDE
+kernel-side functions.  Any of these inside a traced/jitted function
+either forces a device round-trip per dispatch or bakes a host value
+into the compiled artifact; outside kernel code they are legitimate
+(explain paths, host prep, timing harnesses), so the lint must scope
+itself to the jit closure rather than grepping whole files.
+
+Kernel-side functions are discovered statically:
+
+* functions decorated with ``jax.jit`` / ``jit`` (bare or via
+  ``partial(jax.jit, ...)``);
+* functions passed by name to a ``jax.jit(...)`` call, including local
+  defs (``raw`` in engine/veval.py);
+* the transitive closure over plain-name calls from those roots: a
+  module-level function (or every method of a module-level class) a
+  kernel function calls is itself kernel-side.
+
+Attribute calls (``self._raw``) cannot be resolved statically and are
+skipped — the closure rule above covers the real call graph of the
+engine, where jitted entry points reach helpers by name.
+
+Exit status: number of findings (0 = clean).  Wired as the ci.sh lint
+stage over ``gatekeeper_tpu/engine`` and ``gatekeeper_tpu/ir``.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+_FORBIDDEN_ATTRS = {"block_until_ready"}
+# (module alias, attr) pairs resolved from `alias.attr(...)` calls
+_FORBIDDEN_QUALIFIED = {
+    ("np", "asarray"), ("numpy", "asarray"),
+    ("onp", "asarray"),
+    ("time", "time"),
+}
+
+
+def _dotted(node: ast.AST) -> tuple[str, ...] | None:
+    """`a.b.c` -> ('a','b','c'); None for anything non-trivial."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return tuple(reversed(parts))
+    return None
+
+
+def _is_jit_expr(node: ast.AST) -> bool:
+    """jax.jit / jit, or partial(jax.jit, ...)."""
+    d = _dotted(node)
+    if d in (("jax", "jit"), ("jit",)):
+        return True
+    if isinstance(node, ast.Call):
+        fd = _dotted(node.func)
+        if fd in (("partial",), ("functools", "partial")) and node.args:
+            return _is_jit_expr(node.args[0])
+    return False
+
+
+class _Scopes(ast.NodeVisitor):
+    """Collect every function/class definition and every jax.jit call
+    whose first argument is a plain name."""
+
+    def __init__(self) -> None:
+        self.funcs: dict[str, list[ast.AST]] = {}
+        self.classes: dict[str, ast.ClassDef] = {}
+        self.jit_named: set[str] = set()
+        self.decorated_roots: list[ast.AST] = []
+
+    def _visit_func(self, node) -> None:
+        self.funcs.setdefault(node.name, []).append(node)
+        if any(_is_jit_expr(d) for d in node.decorator_list):
+            self.decorated_roots.append(node)
+        self.generic_visit(node)
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self.classes[node.name] = node
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if _is_jit_expr(node.func) and node.args \
+                and isinstance(node.args[0], ast.Name):
+            self.jit_named.add(node.args[0].id)
+        self.generic_visit(node)
+
+
+def _called_names(fn: ast.AST) -> set[str]:
+    out: set[str] = set()
+    for sub in ast.walk(fn):
+        if isinstance(sub, ast.Call) and isinstance(sub.func, ast.Name):
+            out.add(sub.func.id)
+    return out
+
+
+def _kernel_roots(tree: ast.Module) -> list[ast.AST]:
+    sc = _Scopes()
+    sc.visit(tree)
+    roots: list[ast.AST] = list(sc.decorated_roots)
+    seen: set[int] = {id(r) for r in roots}
+    frontier: list[str] = sorted(sc.jit_named)
+    resolved: set[str] = set()
+    while frontier:
+        name = frontier.pop()
+        if name in resolved:
+            continue
+        resolved.add(name)
+        members: list[ast.AST] = list(sc.funcs.get(name, ()))
+        cls = sc.classes.get(name)
+        if cls is not None:
+            members.extend(n for n in cls.body
+                           if isinstance(n, (ast.FunctionDef,
+                                             ast.AsyncFunctionDef)))
+        for fn in members:
+            if id(fn) in seen:
+                continue
+            seen.add(id(fn))
+            roots.append(fn)
+            frontier.extend(_called_names(fn) - resolved)
+    # transitive closure over the decorated roots too
+    for fn in list(roots):
+        for name in sorted(_called_names(fn) - resolved):
+            frontier.append(name)
+    while frontier:
+        name = frontier.pop()
+        if name in resolved:
+            continue
+        resolved.add(name)
+        for fn in sc.funcs.get(name, ()):
+            if id(fn) in seen:
+                continue
+            seen.add(id(fn))
+            roots.append(fn)
+            frontier.extend(_called_names(fn) - resolved)
+    return roots
+
+
+def _lint_tree(tree: ast.Module, path: str) -> list[str]:
+    findings: list[str] = []
+    for root in _kernel_roots(tree):
+        for sub in ast.walk(root):
+            if not isinstance(sub, ast.Call):
+                continue
+            if isinstance(sub.func, ast.Attribute) \
+                    and sub.func.attr in _FORBIDDEN_ATTRS:
+                findings.append(
+                    f"{path}:{sub.lineno}: .{sub.func.attr}() inside "
+                    f"kernel-side function {root.name!r}")
+                continue
+            d = _dotted(sub.func)
+            if d is not None and len(d) == 2 \
+                    and (d[0], d[1]) in _FORBIDDEN_QUALIFIED:
+                findings.append(
+                    f"{path}:{sub.lineno}: {d[0]}.{d[1]}() inside "
+                    f"kernel-side function {root.name!r}")
+    return findings
+
+
+def lint_paths(paths: list[str]) -> list[str]:
+    findings: list[str] = []
+    files: list[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for dirpath, _dirs, names in os.walk(p):
+                files.extend(os.path.join(dirpath, f)
+                             for f in sorted(names) if f.endswith(".py"))
+        elif p.endswith(".py"):
+            files.append(p)
+    for f in sorted(files):
+        with open(f, encoding="utf-8") as fh:
+            src = fh.read()
+        try:
+            tree = ast.parse(src, filename=f)
+        except SyntaxError as e:
+            findings.append(f"{f}:{e.lineno}: syntax error: {e.msg}")
+            continue
+        findings.extend(_lint_tree(tree, f))
+    return findings
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if not argv:
+        print("usage: python -m gatekeeper_tpu.analysis.selflint "
+              "<dir-or-file>...", file=sys.stderr)
+        return 2
+    findings = lint_paths(argv)
+    for line in findings:
+        print(line)
+    if findings:
+        print(f"selflint: {len(findings)} host-sync call(s) in "
+              "kernel-side code", file=sys.stderr)
+    else:
+        print("selflint: clean")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -67,8 +67,20 @@ class Client:
                 raise ClientError(f"unknown target {tt.target!r}")
             if first_handler is None:
                 first_handler = handler
-            compiled_by_target[tt.target] = compile_target_rego(
-                tmpl.kind, tt.target, tt.rego)
+            compiled = compile_target_rego(tmpl.kind, tt.target, tt.rego)
+            # Stage-1 static vet (analysis/vetter.py): error findings
+            # reject the template at ingestion, before anything is
+            # registered.  providers=None here — the client has no
+            # provider registry in scope (providers may legitimately be
+            # registered after the template); the reconciler enforces
+            # provider existence with the live set.
+            from gatekeeper_tpu.analysis import has_errors, vet_module
+            diags = vet_module(compiled.module, providers=None,
+                               file=tmpl.kind)
+            if has_errors(diags):
+                from gatekeeper_tpu.errors import VetError
+                raise VetError(diags)
+            compiled_by_target[tt.target] = compiled
         return compiled_by_target, build_crd(tmpl, first_handler.match_schema())
 
     def create_crd(self, template_doc: dict) -> dict:

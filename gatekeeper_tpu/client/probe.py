@@ -275,10 +275,89 @@ def list_builtins() -> list[str]:
     return lines
 
 
+def lint_template_doc(doc: dict, file: str = "") -> list:
+    """Run both static-analysis stages over one ConstraintTemplate doc
+    (gatekeeper_tpu/analysis): the Stage-1 AST vet, then an attempted
+    lowering with Stage-2 IR verification.  A template the vectorizer
+    cannot lower is a warning (``rego_not_vectorizable``): it still
+    evaluates on the scalar oracle, just not on the device path.
+    Providers come from the live ExternalDataRuntime when one exists;
+    otherwise provider references are not checked (same contract as
+    Client ingestion)."""
+    from gatekeeper_tpu.analysis import vet_module, verify_program
+    from gatekeeper_tpu.analysis.diagnostics import WARNING, Diagnostic
+    from gatekeeper_tpu.api.templates import compile_target_rego
+    from gatekeeper_tpu.errors import Location, RegoError
+    from gatekeeper_tpu.externaldata.runtime import get_runtime
+    from gatekeeper_tpu.ir.lower import CannotLower, lower_template
+
+    rt = get_runtime()
+    providers = set(rt.provider_names()) if rt is not None else None
+    kind = ((((doc.get("spec") or {}).get("crd") or {}).get("spec") or {})
+            .get("names") or {}).get("kind") or \
+        (doc.get("metadata") or {}).get("name") or "<template>"
+    label = file or kind
+    diags = []
+    for tt in ((doc.get("spec") or {}).get("targets") or ()):
+        try:
+            compiled = compile_target_rego(kind, tt.get("target") or "",
+                                           tt.get("rego") or "")
+        except RegoError as err:
+            loc = err.location
+            diags.append(Diagnostic(err.code, "error", err.message,
+                                    Location(loc.row, loc.col, label)))
+            continue
+        diags.extend(vet_module(compiled.module, providers=providers,
+                                file=label))
+        try:
+            lowered = lower_template(compiled.module, compiled.interp)
+        except CannotLower as e:
+            diags.append(Diagnostic(
+                "rego_not_vectorizable", WARNING,
+                f"template does not lower to a device program ({e}); "
+                "it will evaluate on the scalar oracle",
+                Location(file=label)))
+            continue
+        diags.extend(verify_program(lowered, providers=providers,
+                                    file=label))
+    return diags
+
+
+def run_lint(paths: list[str], use_library: bool = False) -> int:
+    """``--lint``: print diagnostics with locations; exit 1 iff any
+    error-severity finding, 2 on unreadable input."""
+    import yaml
+    docs: list[tuple[str, dict]] = []
+    for p in paths:
+        try:
+            with open(p, encoding="utf-8") as fh:
+                loaded = list(yaml.safe_load_all(fh))
+        except (OSError, yaml.YAMLError) as e:
+            import sys
+            print(f"{p}: cannot load: {e}", file=sys.stderr)
+            return 2
+        docs.extend((p, d) for d in loaded
+                    if isinstance(d, dict)
+                    and d.get("kind") == "ConstraintTemplate")
+    if use_library:
+        from gatekeeper_tpu.library import all_docs
+        docs.extend(("<library>", tdoc) for tdoc, _c in all_docs())
+    n_err = 0
+    for label, doc in docs:
+        for d in lint_template_doc(doc, file=label):
+            print(d.format())
+            if d.severity == "error":
+                n_err += 1
+    print(f"lint: {len(docs)} template(s), {n_err} error(s)")
+    return 1 if n_err else 0
+
+
 def main(argv=None) -> int:
     """``python -m gatekeeper_tpu.client.probe``: self-validate both
     engines (the readiness wiring the reference's Probe exists for).
-    ``--builtins`` lists the builtin registry instead of probing.
+    ``--builtins`` lists the builtin registry instead of probing;
+    ``--lint <template.yaml>... [--library]`` runs the static-analysis
+    pass instead, exiting non-zero iff any error-severity finding.
 
     The verdict line names the backend that actually served the [jax]
     scenarios: with a dead/unreachable device the driver falls back to
@@ -292,6 +371,9 @@ def main(argv=None) -> int:
     if "--builtins" in argv:
         print("\n".join(list_builtins()))
         return 0
+    if "--lint" in argv:
+        rest = [a for a in argv if a not in ("--lint", "--library")]
+        return run_lint(rest, use_library="--library" in argv)
 
     from gatekeeper_tpu.client.local_driver import LocalDriver
     from gatekeeper_tpu.engine.jax_driver import JaxDriver

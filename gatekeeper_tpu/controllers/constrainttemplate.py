@@ -103,6 +103,24 @@ def _crd_up_to_date(crd: dict, found: dict) -> bool:
     return anns.get(SPEC_HASH_ANNOTATION) == _spec_hash(crd.get("spec"))
 
 
+def _error_entries(err: Exception) -> list[dict]:
+    """status.byPod[].errors entries for a rejected template.  A
+    VetError carries the whole diagnostic list — every error-severity
+    finding gets its own entry, matching the reference's per-error
+    rows; other errors keep the single-entry shape."""
+    from gatekeeper_tpu.errors import VetError
+    if isinstance(err, VetError):
+        return [{"code": d.code, "message": d.message,
+                 "location": str(d.location)}
+                for d in err.diagnostics if d.severity == "error"]
+    entry = {"code": getattr(err, "code", "create_error"),
+             "message": getattr(err, "message", str(err))}
+    loc = getattr(err, "location", None)
+    if loc is not None:
+        entry["location"] = str(loc)
+    return [entry]
+
+
 def _template_kind(instance: dict) -> str:
     spec = instance.get("spec") or {}
     names = (((spec.get("crd") or {}).get("spec") or {}).get("names") or {})
@@ -127,8 +145,14 @@ class ReconcileConstraintTemplate(Reconciler):
 
         status = get_ha_status(instance)
         status.pop("errors", None)
+        status.pop("warnings", None)
         try:
             crd = self.client.create_crd(instance)
+            # full static vet with the LIVE provider set: create_crd
+            # already ran the structural vet (providers unknown at the
+            # client); here dangling external_data references become
+            # install-time rejections.  Warnings are recorded but admit.
+            self._vet_instance(instance, status)
         except (RegoError, ClientError) as err:
             if terminating:
                 # tear down anyway: CRD identity from the kind alone
@@ -137,13 +161,9 @@ class ReconcileConstraintTemplate(Reconciler):
                     "name": f"{kind.lower()}.{CONSTRAINT_GROUP}"}}
                 return self._handle_delete(instance, crd)
             # parse/validation errors land in status.byPod[].errors
-            # (:143-158) and the template is otherwise left alone
-            entry = {"code": getattr(err, "code", "create_error"),
-                     "message": getattr(err, "message", str(err))}
-            loc = getattr(err, "location", None)
-            if loc is not None:
-                entry["location"] = str(loc)
-            status.setdefault("errors", []).append(entry)
+            # (:143-158) and the template is otherwise left alone; a
+            # VetError expands to one entry per error-severity finding
+            status.setdefault("errors", []).extend(_error_entries(err))
             set_ha_status(instance, status)
             _, result = self._update(instance)
             return result
@@ -223,6 +243,33 @@ class ReconcileConstraintTemplate(Reconciler):
         return result
 
     # ------------------------------------------------------------------
+
+    def _vet_instance(self, instance: dict, status: dict) -> None:
+        """Run the Stage-1 vetter over every target's Rego with the
+        live external-data provider set.  Error findings raise VetError
+        (rejecting the template before it reaches the engine); warning
+        findings land in ``status.byPod[].warnings``.  When no
+        ExternalDataRuntime exists the provider-existence check is
+        skipped — the subsystem is disabled, not misconfigured."""
+        from gatekeeper_tpu.analysis import has_errors, vet_module
+        from gatekeeper_tpu.errors import VetError
+        from gatekeeper_tpu.externaldata.runtime import get_runtime
+        from gatekeeper_tpu.rego.parser import parse_module
+
+        rt = get_runtime()
+        providers = set(rt.provider_names()) if rt is not None else None
+        kind = _template_kind(instance)
+        diags = []
+        for tt in ((instance.get("spec") or {}).get("targets") or ()):
+            rego = tt.get("rego") or ""
+            diags.extend(vet_module(parse_module(rego),
+                                    providers=providers, file=kind))
+        if has_errors(diags):
+            raise VetError(diags)
+        for d in diags:
+            status.setdefault("warnings", []).append(
+                {"code": d.code, "message": d.message,
+                 "location": str(d.location)})
 
     def _add_template(self, instance: dict) -> bool:
         """AddTemplate with update_error status reporting (:198-205)."""

@@ -226,9 +226,38 @@ class JaxDriver(LocalDriver):
                 compiled.vectorized = lower_template(compiled.module, compiled.interp)
             except CannotLower:
                 compiled.vectorized = None  # scalar fallback
+            if compiled.vectorized is not None:
+                compiled.vectorized = self._verify_lowered(
+                    kind, compiled.vectorized)
         st = self._state(target)
         st.templates[kind] = compiled
         st.bump(kind)
+
+    @staticmethod
+    def _verify_lowered(kind: str, lowered):
+        """Stage-2 IR verification (analysis/ir_verifier.py) on every
+        program before it can reach jit.  Structural checks only — the
+        engine has no provider registry in scope.  A malformed program
+        falls back to the scalar oracle (identical semantics, no device
+        path) unless GATEKEEPER_IR_VERIFY=strict, which raises instead;
+        GATEKEEPER_IR_VERIFY=off skips the pass."""
+        import os
+        mode = os.environ.get("GATEKEEPER_IR_VERIFY", "fallback")
+        if mode == "off":
+            return lowered
+        from gatekeeper_tpu.analysis import verify_program
+        from gatekeeper_tpu.analysis.diagnostics import format_all
+        diags = verify_program(lowered, providers=None, file=kind)
+        if not diags:
+            return lowered
+        if mode == "strict":
+            from gatekeeper_tpu.errors import VetError
+            raise VetError(diags)
+        import logging
+        logging.getLogger(__name__).warning(
+            "IR verification failed for %s; falling back to the scalar "
+            "oracle:\n%s", kind, format_all(diags))
+        return None
 
     @locked
     def delete_template(self, target: str, kind: str) -> None:

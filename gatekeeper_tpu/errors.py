@@ -57,6 +57,21 @@ class TypeError_(RegoError):
         super().__init__("rego_type_error", message, location)
 
 
+class VetError(RegoError):
+    """Static-analysis rejection (gatekeeper_tpu/analysis): the template
+    carries at least one error-severity finding.  ``code``/``message``/
+    ``location`` describe the FIRST error finding (so existing RegoError
+    status plumbing works unchanged); the full list — warnings included —
+    rides in ``diagnostics`` for callers that can record more than one
+    ``status.byPod[].errors`` entry."""
+
+    def __init__(self, diagnostics):
+        errs = [d for d in diagnostics if d.severity == "error"]
+        first = errs[0] if errs else diagnostics[0]
+        self.diagnostics = list(diagnostics)
+        super().__init__(first.code, first.message, first.location)
+
+
 class EvalError(GatekeeperError):
     """Runtime evaluation error (conflict, builtin failure with strictness)."""
 

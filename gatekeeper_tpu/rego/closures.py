@@ -29,6 +29,7 @@ from __future__ import annotations
 
 from typing import Any, Callable, Iterator
 
+from gatekeeper_tpu.analysis.purity import is_impure_call
 from gatekeeper_tpu.errors import EvalError
 from gatekeeper_tpu.rego import builtins as bi
 from gatekeeper_tpu.rego.ast_nodes import (
@@ -671,12 +672,12 @@ class ClosureCompiler:
                 return
             if cls is Call:
                 nm = t.name
-                if nm in bi.IMPURE_BUILTINS or \
-                        (len(nm) == 1 and nm[0] in interp.rules):
+                if is_impure_call(nm, interp.rules):
                     impure = True       # impure builtin (clock/trace/jwt
                     return              # verify) or user function (may
-                    #                     read constraint) — see
-                    #                     builtins.IMPURE_BUILTINS
+                    #                     read constraint) — one gate
+                    #                     shared with the template vetter
+                    #                     (analysis/purity.py)
                 for a in t.args:
                     visit(a)
                 return

@@ -1,0 +1,563 @@
+"""Static-analysis pass (gatekeeper_tpu/analysis): Stage-1 Rego vetter,
+Stage-2 IR verifier, install-time wiring, probe --lint, and the CI
+host-sync self-lint."""
+
+from __future__ import annotations
+
+import dataclasses
+
+import pytest
+
+from gatekeeper_tpu.analysis import (has_errors, is_impure_builtin,
+                                     is_impure_call, verify_program,
+                                     vet_module)
+from gatekeeper_tpu.analysis import ir_verifier
+from gatekeeper_tpu.analysis.diagnostics import Diagnostic
+from gatekeeper_tpu.analysis.selflint import lint_paths
+from gatekeeper_tpu.api.templates import compile_target_rego
+from gatekeeper_tpu.client.client import Backend
+from gatekeeper_tpu.client.local_driver import LocalDriver
+from gatekeeper_tpu.engine.jax_driver import JaxDriver
+from gatekeeper_tpu.errors import VetError
+from gatekeeper_tpu.ir.lower import CannotLower, lower_template
+from gatekeeper_tpu.ir.program import Node, Program
+from gatekeeper_tpu.library import LIBRARY, TARGET, all_docs
+from gatekeeper_tpu.rego.parser import parse_module
+from gatekeeper_tpu.target.k8s import K8sValidationTarget
+
+
+def _vet(src: str, providers=None, file: str = "t") -> list[Diagnostic]:
+    return vet_module(parse_module(src), providers=providers, file=file)
+
+
+def _codes(diags) -> list[str]:
+    return [d.code for d in diags]
+
+
+# ---------------------------------------------------------------------------
+# Stage 1: the library must vet clean
+
+
+class TestLibraryVetsClean:
+    def test_every_library_template_is_error_free(self):
+        assert len(LIBRARY) >= 39
+        for kind in sorted(LIBRARY):
+            rego, _params = LIBRARY[kind]
+            diags = _vet(rego, file=kind)
+            assert not has_errors(diags), \
+                f"{kind}: " + "; ".join(d.format() for d in diags)
+
+    def test_every_library_template_has_zero_findings(self):
+        # stronger than error-free: the canonical corpus carries no
+        # warnings either, so CI lint output stays readable
+        for kind in sorted(LIBRARY):
+            rego, _params = LIBRARY[kind]
+            assert _vet(rego, file=kind) == []
+
+
+# ---------------------------------------------------------------------------
+# Stage 1: bad-template corpus, golden code + location assertions
+
+
+class TestVetterFindings:
+    def test_unknown_builtin(self):
+        diags = _vet("""package p
+violation[{"msg": msg}] {
+  msg := frobnicate("x")
+}
+""")
+        [d] = [d for d in diags if d.code == "rego_unknown_builtin"]
+        assert d.severity == "error"
+        assert "frobnicate" in d.message
+        assert (d.location.row, d.location.col) == (3, 3)
+        assert d.location.file == "t"
+
+    def test_impure_builtin_warns(self):
+        diags = _vet("""package p
+violation[{"msg": msg}] {
+  t := time.now_ns()
+  t > 0
+  msg := "late"
+}
+""")
+        [d] = [d for d in diags if d.code == "rego_impure_builtin"]
+        assert d.severity == "warning"
+        assert not has_errors(diags)
+        assert d.location.row == 3
+
+    def test_unsupported_builtin_warns(self):
+        diags = _vet("""package p
+violation[{"msg": msg}] {
+  http.send({"url": "http://x"})
+  msg := "egress"
+}
+""")
+        [d] = [d for d in diags if d.code == "rego_unsupported_builtin"]
+        assert d.severity == "warning"
+        assert "http.send" in d.message
+        assert d.location.row == 3
+
+    def test_unsafe_var_in_body(self):
+        diags = _vet("""package p
+violation[{"msg": msg}] {
+  msg := concat("", [unbound_thing])
+}
+""")
+        [d] = [d for d in diags if d.code == "rego_unsafe_var"]
+        assert d.severity == "error"
+        assert "unbound_thing" in d.message
+        assert d.location.row == 3
+
+    def test_unsafe_var_in_head(self):
+        diags = _vet("""package p
+violation[{"msg": msg}] {
+  1 == 1
+}
+""")
+        [d] = [d for d in diags if d.code == "rego_unsafe_var"]
+        assert "'msg'" in d.message and "head" in d.message
+        assert d.location.row == 2
+
+    def test_recursion(self):
+        diags = _vet("""package p
+violation[{"msg": msg}] {
+  msg := loop("x")
+}
+loop(x) = out {
+  out := loop(x)
+}
+""")
+        [d] = [d for d in diags if d.code == "rego_recursion"]
+        assert d.severity == "error"
+        assert "'loop'" in d.message
+        assert d.location.row == 5
+
+    def test_mutual_recursion(self):
+        diags = _vet("""package p
+violation[{"msg": msg}] {
+  msg := a("x")
+}
+a(x) = out { out := b(x) }
+b(x) = out { out := a(x) }
+""")
+        assert _codes(diags).count("rego_recursion") == 2
+
+    def test_dead_rule(self):
+        diags = _vet("""package p
+violation[{"msg": msg}] {
+  msg := "hi"
+}
+helper {
+  input.review.object.kind == "Pod"
+}
+""")
+        [d] = [d for d in diags if d.code == "rego_dead_rule"]
+        assert d.severity == "warning"
+        assert "'helper'" in d.message
+        assert d.location.row == 5
+
+    def test_unbounded_comprehension(self):
+        diags = _vet("""package p
+violation[{"msg": msg}] {
+  xs := {x | 1 == 1}
+  count(xs) > 0
+  msg := "x"
+}
+""")
+        [d] = [d for d in diags if d.code == "rego_unbounded_comprehension"]
+        assert d.severity == "error"
+        assert "'x'" in d.message
+        assert d.location.row == 3
+        # the dedicated code replaces the generic unsafe-var finding
+        assert "rego_unsafe_var" not in _codes(diags)
+
+    def test_bad_provider_ref_only_with_declared_set(self):
+        src = """package p
+violation[{"msg": msg}] {
+  resp := external_data({"provider": "ghost", "keys": ["k"]})
+  count(resp) > 0
+  msg := "x"
+}
+"""
+        # no provider registry in scope: not checked
+        assert "rego_bad_provider_ref" not in _codes(_vet(src))
+        diags = _vet(src, providers=set())
+        [d] = [d for d in diags if d.code == "rego_bad_provider_ref"]
+        assert d.severity == "error"
+        assert "'ghost'" in d.message
+        assert d.location.row == 3
+        # a declared provider admits
+        assert "rego_bad_provider_ref" not in _codes(
+            _vet(src, providers={"ghost"}))
+
+    def test_dynamic_provider_ref_warns(self):
+        diags = _vet("""package p
+violation[{"msg": msg}] {
+  p := input.constraint.spec.parameters.provider
+  resp := external_data({"provider": p, "keys": ["k"]})
+  count(resp) > 0
+  msg := "x"
+}
+""", providers=set())
+        [d] = [d for d in diags if d.code == "rego_dynamic_provider_ref"]
+        assert d.severity == "warning"
+        assert d.location.row == 4
+
+    def test_walk_statement_form_binds_its_pattern(self):
+        # interp.py's 2-arg walk unifies [path, value]; the vetter must
+        # treat them as binds, not unsafe vars
+        diags = _vet("""package p
+violation[{"msg": msg}] {
+  walk(input.review.object, [path, value])
+  value == "forbidden"
+  msg := sprintf("at %v", [path])
+}
+""")
+        assert diags == []
+
+    def test_negated_walk_still_requires_bound_vars(self):
+        diags = _vet("""package p
+violation[{"msg": msg}] {
+  not walk(input.review.object, [path, value])
+  msg := "x"
+}
+""")
+        assert "rego_unsafe_var" in {d.code for d in diags}
+
+
+# ---------------------------------------------------------------------------
+# shared impurity gate (satellite: closures.py dedupe)
+
+
+class TestPurityHelper:
+    def test_matches_registry(self):
+        from gatekeeper_tpu.rego import builtins as bi
+        for name in bi.IMPURE_BUILTINS:
+            assert is_impure_builtin(name)
+        assert not is_impure_builtin(("count",))
+
+    def test_user_function_taints(self):
+        assert is_impure_call(("myfn",), {"myfn": object()})
+        assert not is_impure_call(("myfn",), {})
+
+    def test_closures_routes_through_helper(self):
+        import inspect
+        from gatekeeper_tpu.rego import closures
+        src = inspect.getsource(closures)
+        assert "is_impure_call" in src
+        assert "in bi.IMPURE_BUILTINS" not in src
+
+
+# ---------------------------------------------------------------------------
+# Stage 2: IR verifier
+
+
+def _lower(kind: str):
+    rego, _params = LIBRARY[kind]
+    ct = compile_target_rego(kind, TARGET, rego)
+    return lower_template(ct.module, ct.interp)
+
+
+class TestIRVerifier:
+    def test_library_programs_verify_clean(self):
+        lowered_n = 0
+        for kind in sorted(LIBRARY):
+            try:
+                lp = _lower(kind)
+            except CannotLower:
+                continue
+            lowered_n += 1
+            assert verify_program(lp, file=kind) == []
+        assert lowered_n >= 40
+
+    def _tamper(self, lp, nodes=None, rules=None):
+        prog = Program(nodes=tuple(nodes) if nodes is not None
+                       else lp.program.nodes,
+                       rules=tuple(rules) if rules is not None
+                       else lp.program.rules)
+        return dataclasses.replace(lp, program=prog)
+
+    def test_dangling_table_ref(self):
+        lp = _lower("K8sDisallowLatestTag")
+        nodes = [Node("table", n.args, ("t_nope",))
+                 if n.op == "table" else n for n in lp.program.nodes]
+        assert nodes != list(lp.program.nodes)
+        diags = verify_program(self._tamper(lp, nodes=nodes))
+        assert "ir_dangling_ref" in _codes(diags)
+
+    def test_unknown_op(self):
+        lp = _lower("K8sAllowedRepos")
+        nodes = list(lp.program.nodes) + [Node("frob", (), ())]
+        diags = verify_program(self._tamper(lp, nodes=nodes))
+        assert "ir_unknown_op" in _codes(diags)
+
+    def test_arity_shape_mismatch(self):
+        lp = _lower("K8sAllowedRepos")
+        nodes = [Node("and", (n.args[0],), n.meta)
+                 if n.op == "not" else n for n in lp.program.nodes]
+        assert nodes != list(lp.program.nodes)
+        diags = verify_program(self._tamper(lp, nodes=nodes))
+        assert "ir_shape_mismatch" in _codes(diags)
+
+    def test_forward_reference_breaks_ssa(self):
+        lp = _lower("K8sAllowedRepos")
+        nodes = list(lp.program.nodes)
+        i = next(i for i, n in enumerate(nodes) if n.args)
+        nodes[i] = Node(nodes[i].op, (len(nodes) + 3,) + nodes[i].args[1:],
+                        nodes[i].meta)
+        diags = verify_program(self._tamper(lp, nodes=nodes))
+        assert "ir_dangling_ref" in _codes(diags)
+
+    def test_cmp_type_mismatch(self):
+        lp = _lower("K8sAllowedRepos")
+        # compare a bool-producing node with itself under an ordering op
+        nodes = list(lp.program.nodes)
+        bi_ = next(i for i, n in enumerate(nodes)
+                   if n.op in ("table", "not", "in_cset"))
+        nodes.append(Node("cmp", (bi_, bi_), ("<",)))
+        diags = verify_program(self._tamper(lp, nodes=nodes))
+        assert "ir_type_mismatch" in _codes(diags)
+
+    def test_gather_src_mismatch(self):
+        lp = _lower("K8sDisallowLatestTag")
+        nodes = list(lp.program.nodes)
+        ti = next(i for i, n in enumerate(nodes) if n.op == "table")
+        ci = next(i for i, n in enumerate(nodes) if n.op != "input")
+        if ci < ti:
+            nodes[ti] = Node("table", (ci,), nodes[ti].meta)
+            diags = verify_program(self._tamper(lp, nodes=nodes))
+            assert "ir_shape_mismatch" in _codes(diags) \
+                or "ir_type_mismatch" in _codes(diags)
+
+    def test_provider_tags_checked_when_declared(self):
+        src = """package extprov
+violation[{"msg": msg}] {
+  img := input.review.object.spec.image
+  verdict := object.get(external_data({"provider": "sig-prov", "keys": [img]}), ["responses", img], "missing")
+  verdict == "invalid"
+  msg := "bad signature"
+}
+"""
+        ct = compile_target_rego("ExtProv", TARGET, src)
+        lp = lower_template(ct.module, ct.interp)
+        tagged = [t for t in lp.spec.tables if t.ext_providers]
+        assert tagged, "expected an external-data-tagged table"
+        assert verify_program(lp) == []                      # structural
+        assert verify_program(lp, providers={"sig-prov"}) == []
+        diags = verify_program(lp, providers=set())
+        assert "ir_bad_provider_ref" in _codes(diags)
+
+    def test_counters_cover_engine_lowering(self):
+        ir_verifier.reset_counters()
+        client = Backend(JaxDriver()).new_client([K8sValidationTarget()])
+        for tdoc, cdoc in all_docs():
+            client.add_template(tdoc)
+        assert ir_verifier.VERIFY_RUNS >= 40
+        assert ir_verifier.VERIFY_VIOLATIONS == 0
+
+
+# ---------------------------------------------------------------------------
+# install-time wiring
+
+
+BAD_BUILTIN = """package badkind
+violation[{"msg": msg}] {
+  msg := frobnicate("x")
+}
+"""
+
+DANGLING_PROVIDER = """package provkind
+violation[{"msg": msg}] {
+  resp := external_data({"provider": "ghost", "keys": ["k"]})
+  count(resp.responses) > 0
+  msg := "x"
+}
+"""
+
+
+def _template_doc(kind: str, rego: str) -> dict:
+    return {"apiVersion": "templates.gatekeeper.sh/v1alpha1",
+            "kind": "ConstraintTemplate",
+            "metadata": {"name": kind.lower()},
+            "spec": {"crd": {"spec": {"names": {"kind": kind}}},
+                     "targets": [{"target": TARGET, "rego": rego}]}}
+
+
+class TestClientIngestion:
+    def test_add_template_rejects_error_findings(self):
+        client = Backend(LocalDriver()).new_client([K8sValidationTarget()])
+        with pytest.raises(VetError) as ei:
+            client.add_template(_template_doc("BadKind", BAD_BUILTIN))
+        assert ei.value.code == "rego_unknown_builtin"
+        assert any(d.code == "rego_unknown_builtin"
+                   for d in ei.value.diagnostics)
+        assert "BadKind" not in client.templates
+
+    def test_create_crd_rejects_too(self):
+        client = Backend(LocalDriver()).new_client([K8sValidationTarget()])
+        with pytest.raises(VetError):
+            client.create_crd(_template_doc("BadKind", BAD_BUILTIN))
+
+    def test_dangling_provider_admits_at_client(self):
+        # the client has no provider registry in scope: providers may
+        # be registered after the template (test_externaldata pins the
+        # eval-time policy error for this case)
+        client = Backend(LocalDriver()).new_client([K8sValidationTarget()])
+        client.add_template(_template_doc("ProvKind", DANGLING_PROVIDER))
+        assert "ProvKind" in client.templates
+
+
+class TestReconcileRejection:
+    def _plane(self):
+        from gatekeeper_tpu.cluster.fake import FakeCluster
+        from gatekeeper_tpu.controllers.constrainttemplate import \
+            TEMPLATE_GVK
+        from gatekeeper_tpu.controllers.registry import add_to_manager
+        cluster = FakeCluster()
+        cluster.register_kind(TEMPLATE_GVK, "constrainttemplates")
+        client = Backend(LocalDriver()).new_client([K8sValidationTarget()])
+        return cluster, add_to_manager(cluster, client), TEMPLATE_GVK
+
+    def _tmpl_obj(self, kind: str, rego: str) -> dict:
+        doc = _template_doc(kind, rego)
+        doc["metadata"]["name"] = kind.lower()
+        return doc
+
+    def test_unknown_builtin_rejected_in_status(self):
+        from gatekeeper_tpu.utils.ha_status import get_ha_status
+        cluster, plane, gvk = self._plane()
+        cluster.create(self._tmpl_obj("BadKind", BAD_BUILTIN))
+        plane.run_until_idle()
+        tmpl = cluster.get(gvk, "badkind")
+        errors = get_ha_status(tmpl).get("errors")
+        assert errors and errors[0]["code"] == "rego_unknown_builtin"
+        assert "location" in errors[0]
+        # never reached the engine
+        assert "BadKind" not in plane.client.templates
+        assert not tmpl.get("status", {}).get("created")
+
+    def test_dangling_provider_rejected_in_status(self):
+        from gatekeeper_tpu.utils.ha_status import get_ha_status
+        cluster, plane, gvk = self._plane()
+        # add_to_manager installed an ExternalDataRuntime with no
+        # providers: the reconciler enforces existence against it
+        assert plane.external_data.provider_names() == []
+        cluster.create(self._tmpl_obj("ProvKind", DANGLING_PROVIDER))
+        plane.run_until_idle()
+        tmpl = cluster.get(gvk, "provkind")
+        errors = get_ha_status(tmpl).get("errors")
+        assert errors and any(e["code"] == "rego_bad_provider_ref"
+                              for e in errors)
+        assert "ProvKind" not in plane.client.templates
+
+    def test_warnings_recorded_but_admit(self):
+        from gatekeeper_tpu.utils.ha_status import get_ha_status
+        cluster, plane, gvk = self._plane()
+        rego = """package warnkind
+violation[{"msg": msg}] {
+  t := time.now_ns()
+  t > 0
+  msg := "late"
+}
+"""
+        cluster.create(self._tmpl_obj("WarnKind", rego))
+        plane.run_until_idle()
+        tmpl = cluster.get(gvk, "warnkind")
+        st = get_ha_status(tmpl)
+        assert not st.get("errors")
+        assert any(w["code"] == "rego_impure_builtin"
+                   for w in st.get("warnings", []))
+        assert "WarnKind" in plane.client.templates
+        assert tmpl["status"]["created"] is True
+
+
+# ---------------------------------------------------------------------------
+# probe --lint
+
+
+class TestProbeLint:
+    def _write(self, tmp_path, name: str, kind: str, rego: str) -> str:
+        import yaml
+        p = tmp_path / name
+        p.write_text(yaml.safe_dump(_template_doc(kind, rego)))
+        return str(p)
+
+    def test_clean_template_exits_zero(self, tmp_path, capsys):
+        from gatekeeper_tpu.client.probe import main
+        rego, _params = LIBRARY["K8sAllowedRepos"]
+        path = self._write(tmp_path, "ok.yaml", "K8sAllowedRepos", rego)
+        assert main(["--lint", path]) == 0
+        out = capsys.readouterr().out
+        assert "1 template(s), 0 error(s)" in out
+
+    def test_error_finding_exits_nonzero(self, tmp_path, capsys):
+        from gatekeeper_tpu.client.probe import main
+        path = self._write(tmp_path, "bad.yaml", "BadKind", BAD_BUILTIN)
+        assert main(["--lint", path]) == 1
+        out = capsys.readouterr().out
+        assert "rego_unknown_builtin" in out
+        assert f"{path}:3:3" in out
+
+    def test_parse_error_reported_with_code(self, tmp_path, capsys):
+        from gatekeeper_tpu.client.probe import main
+        path = self._write(tmp_path, "parse.yaml", "ParseKind",
+                           "package p\nviolation[ {")
+        assert main(["--lint", path]) == 1
+        assert "rego_parse_error" in capsys.readouterr().out
+
+    def test_unreadable_input_exits_two(self, tmp_path):
+        from gatekeeper_tpu.client.probe import main
+        assert main(["--lint", str(tmp_path / "missing.yaml")]) == 2
+
+    def test_library_mode_is_error_free(self, capsys):
+        from gatekeeper_tpu.client.probe import main
+        assert main(["--lint", "--library"]) == 0
+        assert ", 0 error(s)" in capsys.readouterr().out
+
+
+# ---------------------------------------------------------------------------
+# CI self-lint
+
+
+class TestSelfLint:
+    def test_engine_and_ir_are_clean(self):
+        import os
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        findings = lint_paths([os.path.join(root, "gatekeeper_tpu", "engine"),
+                               os.path.join(root, "gatekeeper_tpu", "ir")])
+        assert findings == []
+
+    def test_flags_host_sync_in_jit_closure(self, tmp_path):
+        bad = tmp_path / "kern.py"
+        bad.write_text("""import jax, time
+import numpy as np
+
+def kern(x):
+    helper(x)
+    return x.block_until_ready()
+
+def helper(x):
+    time.time()
+    np.asarray(x)
+
+def host_only(x):
+    return np.asarray(x)
+
+f = jax.jit(kern)
+""")
+        findings = lint_paths([str(bad)])
+        assert len(findings) == 3
+        assert all("kern.py" in f for f in findings)
+        assert not any("host_only" in f for f in findings)
+
+    def test_decorated_root_detected(self, tmp_path):
+        bad = tmp_path / "dec.py"
+        bad.write_text("""import jax
+from functools import partial
+
+@partial(jax.jit, static_argnums=0)
+def kern(n, x):
+    return x.block_until_ready()
+""")
+        findings = lint_paths([str(bad)])
+        assert len(findings) == 1 and "block_until_ready" in findings[0]
