@@ -183,6 +183,13 @@ def _slim_headline() -> dict:
                                  ("baseline_seconds", "cold_seconds",
                                   "warm_seconds", "warm_overhead_fraction")
                                  if xd.get(k) is not None}
+    an = DETAIL.get("analysis")
+    if isinstance(an, dict):
+        slim["analysis"] = {k: an.get(k) for k in
+                            ("policyset_wall_seconds",
+                             "subprograms_shared", "evaluations_saved",
+                             "dedup_parity")
+                            if an.get(k) is not None}
     if DETAIL.get("aborted"):
         slim["aborted"] = DETAIL["aborted"]
     return slim
@@ -897,6 +904,93 @@ def bench_external_data(detail):
         f"{warm_s*1e3:.0f}ms ({overhead:+.1%} vs baseline)")
 
 
+def bench_analysis(detail):
+    """Stage-3 whole-policy-set analysis: (a) the static pass — lower +
+    IR-verify + cost/shadowing/dedup analysis over the full built-in
+    library — must stay milliseconds-cheap (it runs at install time,
+    inside reconcile); (b) the cross-template predicate dedup at the
+    library_2000 scale, with the deduped sweep's verdicts checked
+    bit-for-bit against a GATEKEEPER_DEDUP=off oracle sweep."""
+    from gatekeeper_tpu.analysis.ir_verifier import verify_program
+    from gatekeeper_tpu.analysis.policyset import analyze_policy_set
+    from gatekeeper_tpu.client.probe import _library_entries
+
+    # (a) static-pass wall over the library
+    t0 = time.perf_counter()
+    entries = _library_entries()
+    for _kind, lowered, _cons in entries:
+        if lowered is not None:
+            verify_program(lowered)
+    report = analyze_policy_set(entries)
+    static_wall = time.perf_counter() - t0
+    shared = report["shared_subprograms"]
+
+    # (b) dedup parity + savings at library_2000
+    n = sized(BASELINE_N, 500, 2_000)
+    log(f"[analysis] static pass {static_wall*1e3:.0f}ms "
+        f"({len(shared)} shared group(s)); dedup parity at n={n}")
+    rng = random.Random(6)
+    resources = make_mixed(rng, n)
+    from gatekeeper_tpu.engine import jax_driver as jd_mod
+    full_opts = QueryOpts(limit_per_constraint=CAP, full=True)
+
+    def sweep(dedup: str):
+        prev = os.environ.get("GATEKEEPER_DEDUP")
+        os.environ["GATEKEEPER_DEDUP"] = dedup
+        saved = jd_mod.SMALL_WORKLOAD_EVALS
+        try:
+            if not FALLBACK:
+                jd_mod.SMALL_WORKLOAD_EVALS = 0
+            jd = JaxDriver()
+            c = Backend(jd).new_client([K8sValidationTarget()])
+            for tdoc, cdoc in all_docs():
+                c.add_template(tdoc)
+                c.add_constraint(cdoc)
+            c.add_data_batch(resources)
+            jd.query_audit(TARGET_NAME, full_opts)    # warm/compile
+            t0 = time.perf_counter()
+            results, _ = jd.query_audit(TARGET_NAME, full_opts)
+            wall = time.perf_counter() - t0
+            verdicts = sorted(
+                ((r.constraint or {}).get("kind", ""),
+                 ((r.constraint or {}).get("metadata") or {}).get("name", ""),
+                 ((r.resource or {}).get("metadata") or {}).get("name", ""),
+                 r.msg)
+                for r in results)
+            return verdicts, wall, dict(jd.last_sweep_phases.get("dedup")
+                                        or {})
+        finally:
+            jd_mod.SMALL_WORKLOAD_EVALS = saved
+            if prev is None:
+                os.environ.pop("GATEKEEPER_DEDUP", None)
+            else:
+                os.environ["GATEKEEPER_DEDUP"] = prev
+
+    v_oracle, oracle_s, _ = sweep("off")
+    v_dedup, dedup_s, stanza = sweep("on")
+    parity = v_oracle == v_dedup
+    detail["analysis"] = {
+        "n_resources": n,
+        "policyset_wall_seconds": round(static_wall, 4),
+        "shared_groups": len(shared),
+        "subprograms_shared": stanza.get("subprograms_shared", 0),
+        "evaluations_saved": stanza.get("evaluations_saved", 0),
+        "dedup_parity": parity,
+        "dedup_full_seconds": round(dedup_s, 4),
+        "nodedup_full_seconds": round(oracle_s, 4),
+        "dedup_host_eval_s": stanza.get("host_eval_s"),
+        "findings": len(report["findings"]),
+    }
+    if not parity:
+        raise AssertionError(
+            f"dedup verdict mismatch: oracle={len(v_oracle)} "
+            f"dedup={len(v_dedup)}")
+    log(f"[analysis] dedup sweep {dedup_s*1e3:.0f}ms vs no-dedup "
+        f"{oracle_s*1e3:.0f}ms | {stanza.get('subprograms_shared', 0)} "
+        f"shared subprogram(s), {stanza.get('evaluations_saved', 0)} "
+        f"evaluations saved | parity={parity}")
+
+
 def bench_selector_heavy(detail):
     """namespaceSelector-heavy matching at 100k namespaces: the
     namespace-axis selector evaluation is the cost center (VERDICT r2
@@ -1335,6 +1429,8 @@ def main():
     run_phase("full_sweep", bench_full_sweep, 400)
     quiesce_upgrades()
     run_phase("external_data", bench_external_data, 300)
+    quiesce_upgrades()
+    run_phase("analysis", bench_analysis, 300)
     quiesce_upgrades()
     run_phase("regex_heavy", bench_regex_heavy, 300)
     run_phase("selector_heavy", bench_selector_heavy, 300)

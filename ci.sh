@@ -7,12 +7,18 @@ cd "$(dirname "$0")"
 
 echo "== lint =="
 python -m compileall -q gatekeeper_tpu
-# Stage-1/Stage-2 static analysis over every library template: any
-# error-severity finding fails the build (warnings admit)
-JAX_PLATFORMS=cpu python -m gatekeeper_tpu.client.probe --lint --library | tail -1
+# Stage-1/2/3 static analysis over every library template: any
+# error-severity finding fails the build, and with --strict any warning
+# not pinned as a known scalar-fallback (library/lowering_buckets.json)
+# fails it too — the library must stay warning-clean
+JAX_PLATFORMS=cpu python -m gatekeeper_tpu.client.probe --lint --strict --library | tail -1
 # host-sync self-lint: no block_until_ready / np.asarray / time.time
 # inside kernel-side (jitted) functions of the engine or the IR layer
 python -m gatekeeper_tpu.analysis.selflint gatekeeper_tpu/engine gatekeeper_tpu/ir
+# lock-discipline self-lint: no blocking calls (provider fetch,
+# time.sleep, future .result()) while holding a *_lock in host
+# control-plane code
+python -m gatekeeper_tpu.analysis.selflint --locks gatekeeper_tpu/watch gatekeeper_tpu/controllers gatekeeper_tpu/externaldata
 
 echo "== tests (virtual 8-device CPU mesh) =="
 python -m pytest tests/ -q
@@ -56,8 +62,15 @@ xd = d.get("external_data")
 assert isinstance(xd, dict) and "warm_seconds" in xd \
     and "baseline_seconds" in xd, \
     f"no external_data row in the trailing headline: {d}"
+# the analysis row must survive the same window: dedup parity and the
+# evaluations-saved count are this PR's acceptance record
+an = d.get("analysis")
+assert isinstance(an, dict) and "evaluations_saved" in an \
+    and an.get("dedup_parity") is True, \
+    f"no analysis row (with dedup parity) in the trailing headline: {d}"
 print("bench ok:", d["metric"], round(d["value"], 1), d["unit"],
       f"({len(line)} headline chars; external_data warm "
-      f"{xd['warm_seconds']}s vs baseline {xd['baseline_seconds']}s)")
+      f"{xd['warm_seconds']}s vs baseline {xd['baseline_seconds']}s; "
+      f"dedup saved {an['evaluations_saved']} evals)")
 EOF
 echo "CI PASS"

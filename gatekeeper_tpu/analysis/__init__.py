@@ -6,7 +6,12 @@ PrepSpec.  Both emit :class:`.diagnostics.Diagnostic` records whose
 codes follow the reference gatekeeper's ``status.byPod[].errors``
 shape.  :mod:`.purity` is the single impure-builtin gate shared with
 the shareable-review escape analysis; :mod:`.selflint` is the CI
-host-sync lint over kernel-side code.
+host-sync + lock-discipline lint over host/kernel code.
+
+Stage 3 (:mod:`.costmodel` + :mod:`.policyset`) analyzes the *set* of
+installed policies: static per-program cost vectors with budget
+admission, cross-template predicate dedup feeding the audit sweep, and
+match shadowing/unreachability — ``cost_*`` / ``set_*`` findings.
 """
 
 from gatekeeper_tpu.analysis.diagnostics import (   # noqa: F401
@@ -17,3 +22,10 @@ from gatekeeper_tpu.analysis.purity import (        # noqa: F401
 )
 from gatekeeper_tpu.analysis.vetter import vet_module        # noqa: F401
 from gatekeeper_tpu.analysis.ir_verifier import verify_program  # noqa: F401
+from gatekeeper_tpu.analysis.costmodel import (   # noqa: F401
+    CostVector, calibrate, estimate,
+)
+from gatekeeper_tpu.analysis.policyset import (   # noqa: F401
+    analyze_policy_set, build_dedup_plan, constraint_set_warnings,
+    duplicate_predicate_warnings, eval_shared_host, vet_template_cost,
+)

@@ -1,0 +1,287 @@
+"""Stage 3, part 1: the static IR cost model.
+
+An abstract interpreter over the lowered device IR (ir/program.py) that
+prices a program BEFORE it ever jits: each node is classified by the
+axes it varies over (constraints / resources / elements), the padded
+cell count it materializes follows from the same shape buckets the
+device uses (ir/prep.audit_pads), and op classes accumulate into a
+:class:`CostVector` — gathers, compares, logical ops, arithmetic,
+masked reductions, MXU matmul flops, gather volume, host-table and
+provider-table bytes, H2D footprint, and bucket/padding waste.
+
+The idea follows "A Learned Performance Model for Tensor Processing
+Units" (PAPERS.md): static graph features predict TPU kernel cost well
+enough to gate scheduling decisions.  Here the decision gated is
+*admission of a policy template*: the reconciler prices every template
+at install time against ``GATEKEEPER_COST_BUDGET_UNITS`` and either
+warns or rejects (``GATEKEEPER_COST_BUDGET=warn|strict|off``) —
+upstream Gatekeeper has no analogue; its audit cost is unbounded.
+
+``units()`` collapses the vector through fixed op-class weights into a
+scalar abstract cost; :func:`calibrate` fits the single seconds-per-
+unit scale against measured ``device_s`` samples from the bench (least
+squares through the origin), which is what lets ``probe --cost`` report
+predicted-vs-measured.
+
+Static unknowns are priced as documented upper bounds: element-axis
+width and per-constraint set length default to the minimum shape
+bucket (8), host-table cardinality to the padded row count (every row
+distinct).  The model prices *work*, not constants: ``const``/``input``
+nodes are free compute-wise and contribute only H2D bytes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+from gatekeeper_tpu.ir.prep import audit_pads
+
+DEFAULT_E_PAD = 8
+"""Assumed element-axis bucket when the real element width is unknown
+at install time (the minimum bucket ir/prep.bucket hands out)."""
+
+DEFAULT_SET_LEN = 8
+"""Assumed per-constraint id-set / key-set padded length."""
+
+REF_ROWS = 100_000
+"""Reference inventory scale for install-time pricing: templates are
+budgeted at the cost they would add to a 100k-resource sweep."""
+
+# op-class weights for the scalar abstract cost.  Relative magnitudes
+# reflect the device: a gather costs several vector lanes' worth of
+# work, fused elementwise logic is nearly free, matmul flops ride the
+# MXU at high throughput.
+WEIGHTS = {
+    "gathers": 4.0,
+    "compares": 1.0,
+    "logicals": 0.25,
+    "arith": 1.0,
+    "reductions": 1.0,
+    "matmul_flops": 0.05,
+}
+
+
+@dataclasses.dataclass
+class CostVector:
+    """Per-program static cost, in padded-cell op counts by class."""
+
+    gathers: int = 0            # table/ptable/in_cset/keyed_val cells
+    compares: int = 0           # cmp cells
+    logicals: int = 0           # and/or/not + rule-conjunct AND cells
+    arith: int = 0              # arith cells
+    reductions: int = 0         # cells consumed by any_e/all_e/count_e
+    matmul_flops: int = 0       # cset_*_memb / elem_keys_missing MXU flops
+    gather_volume_bytes: int = 0  # bytes moved by gathers (4B lanes)
+    table_bytes: int = 0        # host lookup-table bytes shipped
+    provider_tables: int = 0    # tables backed by external-data providers
+    provider_table_bytes: int = 0
+    h2d_bytes: int = 0          # estimated cold upload footprint
+    live_cells: int = 0         # n_constraints * n_rows
+    padded_cells: int = 0       # c_pad * r_pad
+
+    def units(self) -> float:
+        """Weighted scalar abstract cost (calibrate() maps it to
+        seconds)."""
+        return (WEIGHTS["gathers"] * self.gathers
+                + WEIGHTS["compares"] * self.compares
+                + WEIGHTS["logicals"] * self.logicals
+                + WEIGHTS["arith"] * self.arith
+                + WEIGHTS["reductions"] * self.reductions
+                + WEIGHTS["matmul_flops"] * self.matmul_flops)
+
+    def padding_waste(self) -> float:
+        """Fraction of the padded [C, R] matrix that is bucket slack."""
+        if not self.padded_cells:
+            return 0.0
+        return (self.padded_cells - self.live_cells) / self.padded_cells
+
+    def __add__(self, other: "CostVector") -> "CostVector":
+        return CostVector(**{
+            f.name: getattr(self, f.name) + getattr(other, f.name)
+            for f in dataclasses.fields(CostVector)})
+
+    def as_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["units"] = round(self.units(), 1)
+        d["padding_waste"] = round(self.padding_waste(), 4)
+        return d
+
+
+def node_axes(program) -> list[tuple[bool, bool, bool]]:
+    """Per-node (c, r, e) axis dependence — which axes the node's value
+    varies over.  Mirrors the broadcast semantics of engine/veval._to3:
+    a node's lattice cell count is the product of the padded axes it
+    depends on."""
+    out: list[tuple[bool, bool, bool]] = []
+    for n in program.nodes:
+        arg = [out[a] for a in n.args]
+        c = any(a[0] for a in arg)
+        r = any(a[1] for a in arg)
+        e = any(a[2] for a in arg)
+        op = n.op
+        if op == "const":
+            ax = (False, False, False)
+        elif op == "input":
+            kind = n.meta[1]
+            ax = {"c": (True, False, False),
+                  "r": (False, True, False),
+                  "e": (False, True, True)}[kind[0]]
+        elif op in ("ptable_any", "ptable_all", "in_cset"):
+            ax = (True, r, e)
+        elif op == "keyed_val":
+            ax = (True, True, False)
+        elif op in ("cset_not_subset_memb", "cset_subset_memb"):
+            ax = (True, True, False)
+        elif op == "elem_keys_missing":
+            ax = (True, True, True)
+        elif op in ("any_e", "all_e", "count_e"):
+            ax = (c, r, False)          # the element axis is reduced
+        else:   # table / cmp / and / or / not / arith: broadcast of args
+            ax = (c, r, e)
+        out.append(ax)
+    return out
+
+
+def reachable_nodes(program) -> set[int]:
+    """Node indices actually evaluated: the evaluator caches lazily, so
+    only nodes reachable from rule conjuncts ever run (dead subtrees —
+    e.g. those orphaned by a dedup rewrite — are free)."""
+    seen: set[int] = set()
+    stack = [ci for rule in program.rules for ci in rule.conjuncts]
+    while stack:
+        i = stack.pop()
+        if i in seen or not (0 <= i < len(program.nodes)):
+            continue
+        seen.add(i)
+        stack.extend(program.nodes[i].args)
+    return seen
+
+
+def _spec_h2d_bytes(spec, r_pad: int, c_pad: int, e_pad: int,
+                    set_len: int) -> tuple[int, int, int, int]:
+    """(h2d, table_bytes, provider_tables, provider_bytes) estimated
+    from the PrepSpec request families.  Upper bounds: unary tables
+    priced at one row per distinct value = r_pad."""
+    h2d = r_pad * 1 + c_pad * 1            # __alive__ + __cvalid__
+    h2d += c_pad * r_pad                   # __match__ gate (worst case)
+    for ax, _base in spec.axes:
+        h2d += r_pad * e_pad               # __elem__ presence
+    for rc in spec.r_cols:
+        h2d += r_pad * (5 if rc.mode in ("num", "len") else
+                        4 if rc.mode in ("str", "val") else 1)
+    for ec in spec.e_cols:
+        h2d += r_pad * e_pad * (5 if ec.mode in ("num", "len") else
+                                4 if ec.mode in ("str", "val") else 1)
+    table_bytes = 0
+    provider_tables = 0
+    provider_bytes = 0
+    for t in spec.tables:
+        tb = r_pad * 5                     # .ok [T] + .v [T] at T <= r_pad
+        table_bytes += tb
+        if t.ext_providers:
+            provider_tables += 1
+            provider_bytes += tb
+    h2d += table_bytes
+    for _pt in spec.ptables:
+        h2d += r_pad * 4 + c_pad * (set_len + 1)
+    for _cs in spec.csets:
+        h2d += r_pad * 4 + c_pad * set_len
+    for _cv in spec.cvals:
+        h2d += c_pad * 5
+    for _mb in spec.membs:
+        h2d += set_len * r_pad + c_pad * set_len
+    for _ek in spec.elem_keys:
+        h2d += set_len * r_pad * e_pad + c_pad * set_len
+    for _kv in spec.keyed_vals:
+        h2d += set_len * r_pad * 4 + c_pad * 4
+    for _ij in spec.inv_joins:
+        h2d += r_pad
+    return h2d, table_bytes, provider_tables, provider_bytes
+
+
+def estimate(lowered, n_rows: int, n_constraints: int,
+             e_pad: int = DEFAULT_E_PAD,
+             set_len: int = DEFAULT_SET_LEN) -> CostVector:
+    """Abstractly interpret one LoweredProgram at the given workload
+    scale.  Shapes follow the device's own padding (audit_pads), so the
+    vector prices the padded work the kernels actually do."""
+    program = lowered.program
+    r_pad, c_pad = audit_pads(n_rows, n_constraints)
+    axes = node_axes(program)
+    live = reachable_nodes(program)
+
+    def cells(ax: tuple[bool, bool, bool]) -> int:
+        c, r, e = ax
+        return ((c_pad if c else 1) * (r_pad if r else 1)
+                * (e_pad if e else 1))
+
+    cv = CostVector(live_cells=n_rows * n_constraints,
+                    padded_cells=r_pad * c_pad)
+    for i in sorted(live):
+        n = program.nodes[i]
+        op = n.op
+        sz = cells(axes[i])
+        if op in ("table", "ptable_any", "ptable_all", "in_cset",
+                  "keyed_val"):
+            cv.gathers += sz
+            cv.gather_volume_bytes += 4 * sz
+        elif op == "cmp":
+            cv.compares += sz
+        elif op in ("and", "or", "not"):
+            cv.logicals += sz
+        elif op == "arith":
+            cv.arith += sz
+        elif op in ("any_e", "all_e", "count_e"):
+            cv.reductions += cells(axes[n.args[0]]) if n.args else sz
+        elif op in ("cset_not_subset_memb", "cset_subset_memb"):
+            cv.matmul_flops += 2 * c_pad * set_len * r_pad
+        elif op == "elem_keys_missing":
+            cv.matmul_flops += 2 * c_pad * set_len * r_pad * e_pad
+    for rule in program.rules:
+        row = c_pad * r_pad * (e_pad if rule.elem_axis is not None else 1)
+        cv.logicals += len(rule.conjuncts) * row   # conjunct AND chain
+        cv.reductions += row                       # rule any-reduce
+    (cv.h2d_bytes, cv.table_bytes, cv.provider_tables,
+     cv.provider_table_bytes) = _spec_h2d_bytes(
+        lowered.spec, r_pad, c_pad, e_pad, set_len)
+    return cv
+
+
+def calibrate(samples) -> float:
+    """Least-squares-through-origin seconds-per-unit scale from
+    (units, measured_seconds) samples — the one free parameter the
+    learned-cost-model idea needs per deployment/transport."""
+    num = 0.0
+    den = 0.0
+    for units, seconds in samples:
+        num += units * seconds
+        den += units * units
+    return num / den if den else 0.0
+
+
+def predict_seconds(units: float, scale: float) -> float:
+    return units * scale
+
+
+# ---------------------------------------------------------------------------
+# install-time budget gate
+
+
+def budget_mode() -> str:
+    """GATEKEEPER_COST_BUDGET: 'warn' (default) records a warning,
+    'strict' rejects the template, 'off' disables the gate."""
+    mode = os.environ.get("GATEKEEPER_COST_BUDGET", "warn")
+    return mode if mode in ("warn", "strict", "off") else "warn"
+
+
+def budget_units() -> float:
+    """Per-template abstract-cost budget at REF_ROWS scale
+    (GATEKEEPER_COST_BUDGET_UNITS).  The default admits every library
+    template with ample headroom while still catching pathological
+    blowups (quadratic element-axis products, runaway table fan-out)."""
+    try:
+        return float(os.environ.get("GATEKEEPER_COST_BUDGET_UNITS",
+                                    "2e9"))
+    except ValueError:
+        return 2e9

@@ -91,6 +91,10 @@ def verify_program(lowered, providers: "set[str] | None" = None,
     global VERIFY_RUNS, VERIFY_VIOLATIONS
     program: Program = lowered.program
     spec = lowered.spec
+    # `loc` is rebound per node / per rule below so every diagnostic
+    # carries the offending position (Location.row = node or rule index
+    # in the lowered program — the IR has no source text, so the index
+    # IS the address a debugger needs)
     loc = Location(file=file)
     diags: list[Diagnostic] = []
 
@@ -113,6 +117,7 @@ def verify_program(lowered, providers: "set[str] | None" = None,
         return n.op == "input" and n.meta and n.meta[0] == want_src
 
     for i, n in enumerate(program.nodes):
+        loc = Location(row=i, file=file)
         cls = "?"
         if not isinstance(n, Node) or _ARITY.get(n.op) is None:
             err("ir_unknown_op", f"node {i}: unknown op {n.op!r}")
@@ -290,6 +295,7 @@ def verify_program(lowered, providers: "set[str] | None" = None,
 
     nn = len(program.nodes)
     for ri, rule in enumerate(program.rules):
+        loc = Location(row=ri, file=file)
         for ci in rule.conjuncts:
             if ci < 0 or ci >= nn:
                 err("ir_dangling_ref",

@@ -1,0 +1,682 @@
+"""Stage 3, part 2: whole-policy-set analysis.
+
+Stages 1 and 2 vet one template in isolation; this module reasons over
+the *set* of installed policies:
+
+- **Cross-template predicate dedup** — conjunct subtrees of lowered
+  programs are canonically hashed (:func:`canonical_conjuncts`): input
+  leaves normalize to their prep-request identity (resource path +
+  extraction mode, not the per-template serial name) and per-constraint
+  scalars backed by a value that is uniform across the kind's
+  constraints (string literals lower this way — ir/lower._as_id) fold
+  to the resolved constant.  Subtrees appearing under more than one
+  template therefore collide — e.g. the ``input.review.object.kind ==
+  "Pod"`` gate most library templates open with.  A :class:`DedupPlan`
+  rewrites every member program to read the predicate from one injected
+  boolean input, which the audit sweep computes ONCE on the host
+  (:func:`eval_shared_host`, a numpy twin of engine/veval's evaluator)
+  instead of once per member kind on device.  Soundness: an injected
+  ``r_bool``/``e_bool`` input *fires* exactly its stored value
+  (veval._fires on a bool is ``defined & value`` with defined = ones),
+  and the stored value is the original subtree's fires lattice
+  evaluated over the same bound arrays.
+
+- **Match shadowing / unreachability** — the match-criteria semantics
+  of engine/match.py lifted to a static subsumption order:
+  constraint B is *shadowed* when an installed A of the same kind with
+  JSON-equal parameters matches a superset of B's objects at
+  equal-or-stricter enforcement, and *unreachable* when its match
+  criteria statically match nothing (non-list/empty ``kinds``, empty
+  ``namespaces``).
+
+- **Cost-budget admission** — every template is priced by the static
+  cost model (:mod:`.costmodel`) at reference scale and gated on
+  ``GATEKEEPER_COST_BUDGET=warn|strict|off``.
+
+All findings are :class:`.diagnostics.Diagnostic` records in the
+``cost_*`` / ``set_*`` families so the reconcilers forward them into
+``status.byPod[]`` unchanged.  Upstream Gatekeeper has no equivalent
+pass — see BASELINE.md.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+
+import numpy as np
+
+from gatekeeper_tpu.analysis import costmodel
+from gatekeeper_tpu.analysis.diagnostics import (
+    ERROR, WARNING, Diagnostic,
+)
+from gatekeeper_tpu.errors import Location
+from gatekeeper_tpu.ir.program import Node, Program, RuleSpec
+
+# ---------------------------------------------------------------------------
+# canonical conjunct hashing
+
+
+class _Unshareable(Exception):
+    """Subtree cannot be proven identical across templates."""
+
+
+# ops whose semantics are closed over canonicalized inputs; everything
+# else (ptable_*, in_cset, cset_*_memb, elem_keys_missing, keyed_val)
+# is inherently per-constraint-parameter and never shared
+_SHAREABLE_OPS = frozenset({
+    "const", "input", "table", "cmp", "and", "or", "not", "arith",
+    "any_e", "all_e", "count_e",
+})
+
+_SIMPLE_SCALARS = (str, int, float, bool, bytes, type(None))
+
+
+def _fn_fingerprint(fn) -> tuple | None:
+    """Structural identity of a host-table fn: code object + closure
+    cells + defaults, admitted only when every captured value is a
+    simple scalar or a named callable.  None = not provable equal."""
+    code = getattr(fn, "__code__", None)
+    if code is None:
+        return None
+
+    def _cell(v):
+        if isinstance(v, _SIMPLE_SCALARS):
+            return ("v", type(v).__name__, repr(v))
+        if callable(v) and getattr(v, "__qualname__", None):
+            return ("f", getattr(v, "__module__", ""), v.__qualname__)
+        raise _Unshareable()
+
+    try:
+        cells = tuple(_cell(c.cell_contents)
+                      for c in (fn.__closure__ or ()))
+        defaults = tuple(_cell(v) for v in (fn.__defaults__ or ()))
+    except (_Unshareable, ValueError):
+        return None
+    return (code.co_filename, code.co_firstlineno, code.co_code.hex(),
+            cells, defaults)
+
+
+def _spec_maps(spec) -> dict:
+    return {
+        "r": {rc.name: rc for rc in spec.r_cols},
+        "e": {ec.name: ec for ec in spec.e_cols},
+        "cv": {cv.name: cv for cv in spec.cvals},
+        "t": {t.name: t for t in spec.tables},
+        "ij": {ij.name: ij for ij in spec.inv_joins},
+    }
+
+
+class _Canon:
+    """Canonicalizer for one kind's program: node index -> (form,
+    r-dependent, unreduced element axes, compute-node count)."""
+
+    def __init__(self, program: Program, spec, constraints: list[dict]):
+        self.p = program
+        self.maps = _spec_maps(spec)
+        self.constraints = constraints
+        self.cache: dict[int, tuple] = {}
+
+    def node(self, i: int) -> tuple:
+        hit = self.cache.get(i)
+        if hit is None:
+            hit = self._canon(self.p.nodes[i])
+            self.cache[i] = hit
+        return hit
+
+    def _uniform_cval(self, name: str) -> tuple:
+        """Fold a per-constraint scalar whose resolved value is the
+        same for every constraint of the kind (string/encoded literals
+        always are — the same literal resolves identically under every
+        constraint) into a canonical constant."""
+        cv = self.maps["cv"].get(name)
+        if cv is None or not self.constraints:
+            raise _Unshareable()
+        try:
+            vals = [cv.fn(c) for c in self.constraints]
+        except Exception:
+            raise _Unshareable() from None
+        v0 = vals[0]
+        if v0 is None or any(type(v) is not type(v0) or v != v0
+                             for v in vals[1:]):
+            raise _Unshareable()
+        return ("cconst", cv.kind, repr(v0))
+
+    def _canon(self, n: Node) -> tuple:
+        op = n.op
+        if op not in _SHAREABLE_OPS:
+            raise _Unshareable()
+        if op == "const":
+            value, dtype = n.meta
+            return (("const", repr(value), dtype), False, frozenset(), 0)
+        if op == "input":
+            name, kind = n.meta
+            axis_char = kind[0]
+            if axis_char == "r":
+                rc = self.maps["r"].get(name)
+                if rc is not None:
+                    return (("rcol", rc.path, rc.mode), True,
+                            frozenset(), 0)
+                ij = self.maps["ij"].get(name)
+                if ij is not None:
+                    return (("ij", ij.kind, ij.inv_path, ij.src_path,
+                             ij.exclude_same_name, ij.namespaced_only),
+                            True, frozenset(), 0)
+                raise _Unshareable()
+            if axis_char == "e":
+                ec = self.maps["e"].get(name)
+                if ec is None:
+                    raise _Unshareable()
+                return (("ecol", ec.axis, ec.rel, ec.mode), True,
+                        frozenset({ec.axis}), 0)
+            return (self._uniform_cval(name), False, frozenset(), 0)
+        arg = [self.node(a) for a in n.args]
+        r = any(a[1] for a in arg)
+        eaxes = frozenset().union(*(a[2] for a in arg)) if arg \
+            else frozenset()
+        compute = 1 + sum(a[3] for a in arg)
+        forms = tuple(a[0] for a in arg)
+        if op == "table":
+            t = self.maps["t"].get(n.meta[0])
+            if t is None or t.ext_providers:
+                # provider-backed tables can observe breaker/cache state
+                # that shifts between member binding builds mid-sweep
+                raise _Unshareable()
+            fp = _fn_fingerprint(t.fn)
+            if fp is None:
+                raise _Unshareable()
+            form = ("table", forms[0], t.out, t.src_val, t.regex, fp)
+        elif op in ("cmp", "arith"):
+            form = (op, n.meta[0], forms[0], forms[1])
+        elif op in ("and", "or"):
+            form = (op, forms[0], forms[1])
+        elif op == "not":
+            form = ("not", forms[0])
+        else:                               # any_e / all_e / count_e
+            form = (op, n.meta[0], forms[0])
+            eaxes = frozenset()             # the element axis is reduced
+        if len(eaxes) > 1:
+            raise _Unshareable()            # no single injectable shape
+        return (form, r, eaxes, compute)
+
+
+def canonical_conjuncts(lowered, constraints: list[dict]) -> dict:
+    """node_idx -> (digest, ekind, axis) for every rule-conjunct root
+    that qualifies for cross-template sharing: canonicalizable, varies
+    over the resource (or element) axis, and contains at least one
+    compute node (a bare input is cheaper to read directly than to
+    share)."""
+    program = lowered.program
+    canon = _Canon(program, lowered.spec, constraints)
+    out: dict[int, tuple] = {}
+    roots = {ci for rule in program.rules for ci in rule.conjuncts}
+    for idx in sorted(roots):
+        try:
+            form, r, eaxes, compute = canon.node(idx)
+        except _Unshareable:
+            continue
+        if compute < 1 or not (r or eaxes):
+            continue
+        digest = hashlib.sha1(repr(form).encode()).hexdigest()[:12]
+        if eaxes:
+            out[idx] = (digest, "e", next(iter(eaxes)))
+        else:
+            out[idx] = (digest, "r", None)
+    return out
+
+
+def template_digests(lowered, constraints: list[dict] | None = None) -> set:
+    """Digest set of one template's shareable conjuncts.  Without
+    constraints (template install time, none exist yet) a parameterless
+    dummy stands in: literal-backed scalars still resolve, genuinely
+    parameter-dependent ones drop out as unshareable."""
+    if lowered is None:
+        return set()
+    cons = constraints or [{"spec": {"parameters": {}}}]
+    return {d for d, _, _ in canonical_conjuncts(lowered, cons).values()}
+
+
+# ---------------------------------------------------------------------------
+# the dedup plan
+
+
+@dataclasses.dataclass
+class SharedMember:
+    kind: str
+    node_idx: int           # representative root in the ORIGINAL program
+    sites: int              # distinct conjunct roots with this digest
+
+
+@dataclasses.dataclass
+class SharedGroup:
+    digest: str
+    ekind: str              # "r" | "e"
+    axis: str | None        # element axis key for ekind == "e"
+    binding: str            # injected input binding name
+    members: dict[str, SharedMember]
+
+    @property
+    def total_sites(self) -> int:
+        return sum(m.sites for m in self.members.values())
+
+
+@dataclasses.dataclass
+class DedupPlan:
+    groups: dict[str, SharedGroup]          # digest -> group
+    rewritten: dict[str, Program]           # kind -> rewritten program
+    originals: dict[str, Program]           # kind -> original program
+    kind_digests: dict[str, list[str]]      # kind -> digests it reads
+
+
+def shared_binding(digest: str, ekind: str) -> str:
+    return (f"__shared_e__:{digest}" if ekind == "e"
+            else f"__shared__:{digest}")
+
+
+def build_dedup_plan(kinds: dict) -> DedupPlan:
+    """kinds: kind -> (LoweredProgram, constraints).  Groups every
+    shareable conjunct digest with >= 2 sites across the set and
+    rewrites each member program to read the injected shared input.
+    Rebuilt from scratch every full sweep — it is a pure function of
+    the installed set and costs milliseconds, so nothing is cached to
+    go stale."""
+    per_kind: dict[str, dict[int, tuple]] = {}
+    count: dict[str, int] = {}
+    meta: dict[str, tuple] = {}
+    for kind in sorted(kinds):
+        lowered, constraints = kinds[kind]
+        if lowered is None or not constraints:
+            continue
+        conj = canonical_conjuncts(lowered, constraints)
+        per_kind[kind] = conj
+        for digest, ekind, axis in conj.values():
+            count[digest] = count.get(digest, 0) + 1
+            meta[digest] = (ekind, axis)
+    shared = {d for d, n in count.items() if n >= 2}
+    groups: dict[str, SharedGroup] = {}
+    rewritten: dict[str, Program] = {}
+    originals: dict[str, Program] = {}
+    kind_digests: dict[str, list[str]] = {}
+    for d in sorted(shared):
+        ekind, axis = meta[d]
+        groups[d] = SharedGroup(d, ekind, axis, shared_binding(d, ekind),
+                                {})
+    for kind, conj in per_kind.items():
+        repl: dict[int, str] = {}           # node idx -> digest
+        for idx, (digest, ekind, _axis) in conj.items():
+            if digest in shared and meta[digest][0] == ekind:
+                repl[idx] = digest
+        if not repl:
+            continue
+        program = kinds[kind][0].program
+        nodes = list(program.nodes)
+        injected: dict[str, int] = {}
+        new_idx: dict[int, int] = {}
+        used: list[str] = []
+        for idx in sorted(repl):
+            digest = repl[idx]
+            g = groups[digest]
+            if digest not in injected:
+                injected[digest] = len(nodes)
+                nodes.append(Node("input", (),
+                                  (g.binding, f"{g.ekind}_bool")))
+                used.append(digest)
+            new_idx[idx] = injected[digest]
+            if kind not in g.members:
+                g.members[kind] = SharedMember(kind, idx, 0)
+            g.members[kind].sites += 1
+        rules = tuple(RuleSpec(
+            conjuncts=tuple(new_idx.get(ci, ci) for ci in r.conjuncts),
+            elem_axis=r.elem_axis) for r in program.rules)
+        rewritten[kind] = Program(tuple(nodes), rules)
+        originals[kind] = program
+        kind_digests[kind] = used
+    # a group can end up with a single applied site (ekind-mismatched
+    # twins dropped above): its member program already reads the
+    # injected input, so the group stays — it just saves nothing, and
+    # reporting/savings math discounts it via total_sites
+    return DedupPlan(groups=groups, rewritten=rewritten,
+                     originals=originals, kind_digests=kind_digests)
+
+
+# ---------------------------------------------------------------------------
+# host twin evaluator (numpy mirror of engine/veval._Evaluator over the
+# shareable op subset — kept in exact step with veval semantics)
+
+
+def _np_fires(dv):
+    d, v = dv
+    if v.dtype == np.bool_:
+        return d & v
+    return d
+
+
+class _HostEval:
+    def __init__(self, program: Program, arrays: dict):
+        self.p = program
+        self.arrays = arrays
+        self.cache: dict[int, tuple] = {}
+
+    def _arr(self, name: str) -> np.ndarray:
+        a = np.asarray(self.arrays[name])
+        if a.dtype in (np.int8, np.int16):      # veval._widen_args
+            a = a.astype(np.int32)
+        return a
+
+    def _to3(self, a: np.ndarray, axes: str) -> np.ndarray:
+        if axes == "c":
+            # shared subtrees are constraint-uniform by construction
+            # (canonicalization folded every c input): one constraint
+            # row stands in for all of them
+            return a[:1].reshape(1, 1, 1)
+        if axes == "r":
+            return a.reshape(1, a.shape[0], 1)
+        return a.reshape(1, a.shape[0], a.shape[1])
+
+    def node(self, i: int):
+        hit = self.cache.get(i)
+        if hit is None:
+            hit = self._eval(self.p.nodes[i])
+            self.cache[i] = hit
+        return hit
+
+    def _eval(self, n: Node):
+        op = n.op
+        ones = lambda v: np.ones(v.shape, dtype=bool)  # noqa: E731
+        if op == "const":
+            value, dtype = n.meta
+            v = np.asarray(value, dtype=dtype).reshape(1, 1, 1)
+            return np.ones((1, 1, 1), dtype=bool), v
+        if op == "input":
+            name, kind = n.meta
+            axes = kind[0]
+            if kind.endswith("_num"):
+                return (self._to3(self._arr(name + ".p"), axes),
+                        self._to3(self._arr(name + ".v"), axes))
+            if kind.endswith("_id"):
+                v = self._to3(self._arr(name), axes)
+                return v >= 0, v
+            v = self._to3(self._arr(name), axes)
+            return ones(v), v
+        if op == "table":
+            (tname,) = n.meta
+            d_i, idx = self.node(n.args[0])
+            ci = np.clip(idx, 0, None)
+            return (d_i & self._arr(tname + ".ok")[ci],
+                    self._arr(tname + ".v")[ci])
+        if op == "cmp":
+            (cop,) = n.meta
+            da, va = self.node(n.args[0])
+            db, vb = self.node(n.args[1])
+            d = da & db
+            v = {"==": np.equal, "!=": np.not_equal, "<": np.less,
+                 "<=": np.less_equal, ">": np.greater,
+                 ">=": np.greater_equal}[cop](va, vb)
+            return d, v
+        if op in ("and", "or"):
+            a = _np_fires(self.node(n.args[0]))
+            b = _np_fires(self.node(n.args[1]))
+            v = (a & b) if op == "and" else (a | b)
+            return ones(v), v
+        if op == "not":
+            a = _np_fires(self.node(n.args[0]))
+            return ones(a), ~a
+        if op in ("any_e", "all_e", "count_e"):
+            (axis,) = n.meta
+            pres = self._arr(f"__elem__:{axis}")[None]
+            a = _np_fires(self.node(n.args[0]))
+            if op == "any_e":
+                v = np.any(a & pres, axis=2, keepdims=True)
+                return ones(v), v
+            if op == "all_e":
+                v = np.all(a | ~pres, axis=2, keepdims=True)
+                return ones(v), v
+            v = np.sum((a & pres).astype(np.float32), axis=2,
+                       keepdims=True)
+            return np.ones(v.shape, dtype=bool), v
+        if op == "arith":
+            (aop,) = n.meta
+            da, va = self.node(n.args[0])
+            db, vb = self.node(n.args[1])
+            d = da & db
+            if aop == "+":
+                v = va + vb
+            elif aop == "-":
+                v = va - vb
+            elif aop == "*":
+                v = va * vb
+            else:
+                d = d & (vb != 0)
+                v = va / np.where(vb == 0, np.float32(1.0), vb)
+            return d, v
+        raise ValueError(f"unshareable IR op reached the host twin: {op!r}")
+
+
+def eval_shared_host(program: Program, node_idx: int, arrays: dict,
+                     ekind: str) -> np.ndarray:
+    """Fires lattice of one shared conjunct, computed once on the host
+    over the bound arrays of any member kind.  Returns bool [r_pad]
+    (ekind 'r') or [r_pad, e_pad] (ekind 'e') — the injected value the
+    rewritten programs read."""
+    ev = _HostEval(program, arrays)
+    f = _np_fires(ev.node(node_idx))
+    f = np.broadcast_to(f, (1,) + f.shape[1:]) if f.ndim == 3 else f
+    if ekind == "e":
+        return np.ascontiguousarray(f[0]).astype(bool)
+    return np.ascontiguousarray(f[0, :, 0]).astype(bool)
+
+
+# ---------------------------------------------------------------------------
+# match shadowing / unreachability (static mirror of engine/match._View.mask)
+
+ENFORCE_RANK = {"dryrun": 0, "warn": 1, "deny": 2}
+
+
+def _rank(doc: dict) -> int:
+    action = (doc.get("spec") or {}).get("enforcementAction", "deny")
+    return ENFORCE_RANK.get(action, 2)
+
+
+def match_unreachable(match: dict) -> str | None:
+    """Reason string when the criteria statically match nothing, by the
+    exact engine semantics: non-list / empty ``kinds`` zeroes the kind
+    mask, empty ``namespaces`` zeroes the namespace mask."""
+    if "kinds" in match:
+        kinds = match["kinds"]
+        if not isinstance(kinds, list):
+            return "spec.match.kinds is not a list — matches no object"
+        live = False
+        for ks in kinds:
+            if not isinstance(ks, dict):
+                continue
+            groups = ks.get("apiGroups") or []
+            knames = ks.get("kinds") or []
+            g_ok = "*" in groups or any(isinstance(g, str) for g in groups)
+            k_ok = "*" in knames or any(isinstance(k, str) for k in knames)
+            if g_ok and k_ok:
+                live = True
+        if not live:
+            return ("no spec.match.kinds entry names both an apiGroup "
+                    "and a kind — matches no object")
+    ns = match.get("namespaces")
+    if "namespaces" in match and isinstance(ns, list) and not ns:
+        return "spec.match.namespaces is empty — matches no object"
+    return None
+
+
+def _kinds_entry_covers(a: dict, b: dict) -> bool:
+    ag = a.get("apiGroups") or []
+    bg = b.get("apiGroups") or []
+    ak = a.get("kinds") or []
+    bk = b.get("kinds") or []
+    g = "*" in ag or ("*" not in bg and set(bg) <= set(ag))
+    k = "*" in ak or ("*" not in bk and set(bk) <= set(ak))
+    return g and k
+
+
+def match_subsumes(a: dict, b: dict) -> bool:
+    """True when A's criteria match a superset of B's under the engine
+    semantics — only the four clauses the engine evaluates (kinds,
+    namespaces, namespaceSelector, labelSelector) exist; selectors are
+    covered only by exact equality or absence in A.  A statically
+    unreachable B is the set_unreachable finding's job, not this
+    one's."""
+    if match_unreachable(b) is not None:
+        return False
+    if "kinds" in a:
+        a_kinds = a["kinds"]
+        if not isinstance(a_kinds, list):
+            return False                    # A matches nothing
+        if "kinds" not in b:
+            return False                    # B kind-wildcard, A restricted
+        for be in b["kinds"]:
+            if not isinstance(be, dict):
+                continue
+            if not any(isinstance(ae, dict) and _kinds_entry_covers(ae, be)
+                       for ae in a_kinds):
+                return False
+    a_ns = a.get("namespaces")
+    if "namespaces" in a and a_ns is not None:
+        b_ns = b.get("namespaces")
+        if "namespaces" not in b or not isinstance(b_ns, list) \
+                or not isinstance(a_ns, list) \
+                or not set(s for s in b_ns if isinstance(s, str)) \
+                <= set(s for s in a_ns if isinstance(s, str)):
+            return False
+    if a.get("namespaceSelector") is not None:
+        if json.dumps(a.get("namespaceSelector"), sort_keys=True) != \
+                json.dumps(b.get("namespaceSelector"), sort_keys=True):
+            return False
+    if a.get("labelSelector"):
+        if json.dumps(a.get("labelSelector"), sort_keys=True) != \
+                json.dumps(b.get("labelSelector"), sort_keys=True):
+            return False
+    return True
+
+
+def _params_equal(a: dict, b: dict) -> bool:
+    pa = (a.get("spec") or {}).get("parameters")
+    pb = (b.get("spec") or {}).get("parameters")
+    return json.dumps(pa, sort_keys=True) == json.dumps(pb, sort_keys=True)
+
+
+def constraint_set_warnings(kind: str, name: str, doc: dict,
+                            installed: list) -> list[Diagnostic]:
+    """set_* findings for one reconciled constraint against the other
+    installed constraints of its kind (``installed``: (name, doc)
+    pairs, the reconciled constraint excluded)."""
+    out: list[Diagnostic] = []
+    loc = Location(file=f"{kind}/{name}")
+    match = (doc.get("spec") or {}).get("match") or {}
+    reason = match_unreachable(match)
+    if reason is not None:
+        out.append(Diagnostic("set_unreachable", WARNING, reason, loc))
+    for oname, odoc in installed:
+        if oname == name or not _params_equal(doc, odoc):
+            continue
+        omatch = (odoc.get("spec") or {}).get("match") or {}
+        if match_subsumes(omatch, match) and _rank(odoc) >= _rank(doc):
+            out.append(Diagnostic(
+                "set_shadowed", WARNING,
+                f"subsumed by constraint {oname!r}: identical parameters, "
+                f"superset match criteria, equal-or-stricter enforcement "
+                f"— this constraint can never add a violation", loc))
+        elif match_subsumes(match, omatch) and _rank(doc) >= _rank(odoc):
+            out.append(Diagnostic(
+                "set_shadows", WARNING,
+                f"subsumes constraint {oname!r}: identical parameters, "
+                f"superset match criteria, equal-or-stricter enforcement "
+                f"— {oname!r} can never add a violation", loc))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# cost-budget admission + duplicate-predicate vetting (reconcile-time)
+
+
+def vet_template_cost(lowered, kind: str) -> list[Diagnostic]:
+    """cost_* findings for one template at reference scale.  strict
+    mode escalates a blown budget to an error (the reconciler rejects
+    the template); warn records it; off skips."""
+    mode = costmodel.budget_mode()
+    if mode == "off" or lowered is None:
+        return []
+    cv = costmodel.estimate(lowered, costmodel.REF_ROWS, 1)
+    units = cv.units()
+    budget = costmodel.budget_units()
+    if units <= budget:
+        return []
+    sev = ERROR if mode == "strict" else WARNING
+    return [Diagnostic(
+        "cost_budget_exceeded", sev,
+        f"predicted static cost {units:.3g} units at {costmodel.REF_ROWS} "
+        f"rows exceeds GATEKEEPER_COST_BUDGET_UNITS={budget:.3g} "
+        f"(mode={mode}; gathers={cv.gathers} compares={cv.compares} "
+        f"matmul_flops={cv.matmul_flops})",
+        Location(file=kind))]
+
+
+def duplicate_predicate_warnings(kind: str, lowered,
+                                 others: dict) -> list[Diagnostic]:
+    """set_duplicate_predicate findings: conjuncts of the new template
+    whose canonical digest already appears in an installed template
+    (``others``: kind -> LoweredProgram).  Informational — the audit
+    sweep dedups them automatically."""
+    mine = template_digests(lowered)
+    if not mine:
+        return []
+    out: list[Diagnostic] = []
+    for okind in sorted(others):
+        if okind == kind:
+            continue
+        shared = mine & template_digests(others[okind])
+        if shared:
+            out.append(Diagnostic(
+                "set_duplicate_predicate", WARNING,
+                f"{len(shared)} predicate subprogram(s) identical to "
+                f"template {okind!r} ({', '.join(sorted(shared))}); the "
+                f"audit sweep evaluates each once per sweep (dedup)",
+                Location(file=kind)))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# whole-set report (probe --policyset)
+
+
+def analyze_policy_set(entries: list, n_rows: int = costmodel.REF_ROWS) -> dict:
+    """entries: (kind, LoweredProgram | None, constraints) triples.
+    Returns the full policy-set report: shared-subprogram groups, per-
+    kind static cost, and shadowing/unreachability findings."""
+    kinds = {k: (low, cons) for k, low, cons in entries if low is not None}
+    plan = build_dedup_plan(kinds)
+    groups = []
+    for d in sorted(plan.groups):
+        g = plan.groups[d]
+        if g.total_sites < 2:
+            continue
+        groups.append({
+            "digest": d, "ekind": g.ekind, "axis": g.axis,
+            "kinds": sorted(g.members),
+            "sites": g.total_sites,
+        })
+    costs = {}
+    for kind, low, cons in entries:
+        if low is None:
+            continue
+        cv = costmodel.estimate(low, n_rows, max(len(cons), 1))
+        costs[kind] = cv.as_dict()
+    findings: list[Diagnostic] = []
+    for kind, low, cons in entries:
+        installed = [((c.get("metadata") or {}).get("name", ""), c)
+                     for c in cons]
+        for cname, cdoc in installed:
+            others = [(n, d) for n, d in installed if n != cname]
+            findings.extend(
+                constraint_set_warnings(kind, cname, cdoc, others))
+    return {
+        "shared_subprograms": groups,
+        "template_costs": costs,
+        "findings": findings,
+    }

@@ -26,6 +26,17 @@ engine, where jitted entry points reach helpers by name.
 
 Exit status: number of findings (0 = clean).  Wired as the ci.sh lint
 stage over ``gatekeeper_tpu/engine`` and ``gatekeeper_tpu/ir``.
+
+``--locks`` switches to the LOCK-DISCIPLINE checker for host control-
+plane code: inside any ``with ...lock:`` block whose context expression
+ends in ``_lock``, blocking calls — provider round-trips (``fetch``,
+``fetch_keys``, ``urlopen``), future waits (``.result()``),
+``time.sleep`` — are flagged.  This codifies the WatchManager
+lock-split rule (compute deltas under ``_lock``, apply subscribe/
+unsubscribe outside it) as a CI gate over ``watch/``, ``controllers/``
+and ``externaldata/``: a blocking call under a lock serializes every
+reader behind one slow provider.  Nested function definitions inside
+the ``with`` body are skipped (they run later, not under the lock).
 """
 
 from __future__ import annotations
@@ -41,6 +52,12 @@ _FORBIDDEN_QUALIFIED = {
     ("onp", "asarray"),
     ("time", "time"),
 }
+
+
+# lock-discipline rule set (--locks): calls that block the calling
+# thread on I/O, a timer, or another thread's completion
+_LOCK_BLOCKING_ATTRS = {"fetch", "fetch_keys", "urlopen", "result"}
+_LOCK_BLOCKING_QUALIFIED = {("time", "sleep")}
 
 
 def _dotted(node: ast.AST) -> tuple[str, ...] | None:
@@ -168,8 +185,71 @@ def _lint_tree(tree: ast.Module, path: str) -> list[str]:
     return findings
 
 
-def lint_paths(paths: list[str]) -> list[str]:
+def _lock_name(item: ast.withitem) -> str | None:
+    """Name of the lock a with-item acquires, or None.
+
+    Matches ``with self._lock:``, ``with mgr._prep_lock:`` and call
+    wrappers like ``with self._lock.acquire_timeout(1):`` — any dotted
+    context expression with a segment ending in ``_lock`` (or exactly
+    ``lock``)."""
+    expr = item.context_expr
+    if isinstance(expr, ast.Call):
+        expr = expr.func
+    d = _dotted(expr)
+    if d is None:
+        return None
+    for seg in d:
+        if seg.endswith("_lock") or seg == "lock":
+            return ".".join(d)
+    return None
+
+
+def _lint_lock_tree(tree: ast.Module, path: str) -> list[str]:
+    """Flag blocking calls lexically inside ``with *_lock:`` bodies."""
     findings: list[str] = []
+
+    def walk_pruned(node: ast.AST):
+        """ast.walk, but don't descend into nested defs/lambdas — code
+        inside them runs later, not under the lock."""
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                continue
+            yield child
+            yield from walk_pruned(child)
+
+    def scan_body(body: list[ast.stmt], lockname: str) -> None:
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            for sub in [stmt, *walk_pruned(stmt)]:
+                if not isinstance(sub, ast.Call):
+                    continue
+                if isinstance(sub.func, ast.Attribute) \
+                        and sub.func.attr in _LOCK_BLOCKING_ATTRS:
+                    findings.append(
+                        f"{path}:{sub.lineno}: blocking .{sub.func.attr}() "
+                        f"while holding {lockname}")
+                    continue
+                d = _dotted(sub.func)
+                if d is not None and len(d) == 2 \
+                        and (d[0], d[1]) in _LOCK_BLOCKING_QUALIFIED:
+                    findings.append(
+                        f"{path}:{sub.lineno}: blocking {d[0]}.{d[1]}() "
+                        f"while holding {lockname}")
+
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.With, ast.AsyncWith)):
+            continue
+        for item in node.items:
+            lockname = _lock_name(item)
+            if lockname is not None:
+                scan_body(node.body, lockname)
+                break
+    return findings
+
+
+def _iter_files(paths: list[str]) -> list[str]:
     files: list[str] = []
     for p in paths:
         if os.path.isdir(p):
@@ -178,7 +258,12 @@ def lint_paths(paths: list[str]) -> list[str]:
                              for f in sorted(names) if f.endswith(".py"))
         elif p.endswith(".py"):
             files.append(p)
-    for f in sorted(files):
+    return sorted(files)
+
+
+def _lint_files(paths: list[str], lint_fn) -> list[str]:
+    findings: list[str] = []
+    for f in _iter_files(paths):
         with open(f, encoding="utf-8") as fh:
             src = fh.read()
         try:
@@ -186,22 +271,36 @@ def lint_paths(paths: list[str]) -> list[str]:
         except SyntaxError as e:
             findings.append(f"{f}:{e.lineno}: syntax error: {e.msg}")
             continue
-        findings.extend(_lint_tree(tree, f))
+        findings.extend(lint_fn(tree, f))
     return findings
+
+
+def lint_paths(paths: list[str]) -> list[str]:
+    return _lint_files(paths, _lint_tree)
+
+
+def lint_lock_paths(paths: list[str]) -> list[str]:
+    return _lint_files(paths, _lint_lock_tree)
 
 
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
+    locks = "--locks" in argv
+    argv = [a for a in argv if a != "--locks"]
     if not argv:
         print("usage: python -m gatekeeper_tpu.analysis.selflint "
-              "<dir-or-file>...", file=sys.stderr)
+              "[--locks] <dir-or-file>...", file=sys.stderr)
         return 2
-    findings = lint_paths(argv)
+    if locks:
+        findings = lint_lock_paths(argv)
+        kind_msg = "blocking call(s) under _lock"
+    else:
+        findings = lint_paths(argv)
+        kind_msg = "host-sync call(s) in kernel-side code"
     for line in findings:
         print(line)
     if findings:
-        print(f"selflint: {len(findings)} host-sync call(s) in "
-              "kernel-side code", file=sys.stderr)
+        print(f"selflint: {len(findings)} {kind_msg}", file=sys.stderr)
     else:
         print("selflint: clean")
     return 1 if findings else 0

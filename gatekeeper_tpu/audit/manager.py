@@ -135,7 +135,7 @@ class AuditManager:
         phases = getattr(self.client.driver, "last_sweep_phases", None)
         if phases:
             for k in ("host_prep_s", "h2d_s", "device_s",
-                      "overlap_fraction", "external"):
+                      "overlap_fraction", "external", "dedup"):
                 if k in phases:
                     report[k] = phases[k]
 

@@ -323,9 +323,36 @@ def lint_template_doc(doc: dict, file: str = "") -> list:
     return diags
 
 
-def run_lint(paths: list[str], use_library: bool = False) -> int:
+def _doc_kind(doc: dict) -> str:
+    return ((((doc.get("spec") or {}).get("crd") or {}).get("spec") or {})
+            .get("names") or {}).get("kind") or \
+        (doc.get("metadata") or {}).get("name") or "<template>"
+
+
+def _scalar_fallback_pins() -> set:
+    """Template kinds pinned ``scalar-fallback`` in
+    library/lowering_buckets.json — the acknowledgment record a strict
+    lint honors: a pinned kind's ``rego_not_vectorizable`` warning is
+    expected, not a regression (keys are ``Kind`` or ``Kind (path)``)."""
+    import json as _json
+    import os as _os
+    path = _os.path.join(_os.path.dirname(_os.path.dirname(
+        _os.path.abspath(__file__))), "library", "lowering_buckets.json")
+    try:
+        with open(path, encoding="utf-8") as fh:
+            data = _json.load(fh)
+    except (OSError, ValueError):
+        return set()
+    return {k.split(" (")[0] for k, v in data.items()
+            if isinstance(v, str) and v.startswith("scalar-fallback")}
+
+
+def run_lint(paths: list[str], use_library: bool = False,
+             strict: bool = False) -> int:
     """``--lint``: print diagnostics with locations; exit 1 iff any
-    error-severity finding, 2 on unreadable input."""
+    error-severity finding, 2 on unreadable input.  ``--strict``
+    escalates warnings to failures too — except a pinned kind's
+    ``rego_not_vectorizable`` (see :func:`_scalar_fallback_pins`)."""
     import yaml
     docs: list[tuple[str, dict]] = []
     for p in paths:
@@ -342,14 +369,123 @@ def run_lint(paths: list[str], use_library: bool = False) -> int:
     if use_library:
         from gatekeeper_tpu.library import all_docs
         docs.extend(("<library>", tdoc) for tdoc, _c in all_docs())
+    pins = _scalar_fallback_pins() if strict else set()
     n_err = 0
+    n_warn = 0
     for label, doc in docs:
+        kind = _doc_kind(doc)
         for d in lint_template_doc(doc, file=label):
             print(d.format())
             if d.severity == "error":
                 n_err += 1
-    print(f"lint: {len(docs)} template(s), {n_err} error(s)")
-    return 1 if n_err else 0
+            elif strict and not (d.code == "rego_not_vectorizable"
+                                 and kind in pins):
+                n_warn += 1
+    tail = f", {n_warn} unpinned warning(s)" if strict else ""
+    print(f"lint: {len(docs)} template(s), {n_err} error(s){tail}")
+    return 1 if (n_err or n_warn) else 0
+
+
+def _library_entries() -> list:
+    """(kind, LoweredProgram | None, [example constraint doc]) per
+    built-in library template — the policy set the --policyset/--cost
+    reports analyze."""
+    from gatekeeper_tpu.api.templates import compile_target_rego
+    from gatekeeper_tpu.ir.lower import CannotLower, lower_template
+    from gatekeeper_tpu.library import all_docs
+    entries = []
+    for tdoc, cdoc in all_docs():
+        kind = _doc_kind(tdoc)
+        lowered = None
+        for tt in ((tdoc.get("spec") or {}).get("targets") or ()):
+            try:
+                compiled = compile_target_rego(
+                    kind, tt.get("target") or "", tt.get("rego") or "")
+                lowered = lower_template(compiled.module, compiled.interp)
+            except (CannotLower, Exception):    # noqa: B014
+                lowered = None
+            break
+        entries.append((kind, lowered, [cdoc]))
+    return entries
+
+
+def run_policyset() -> int:
+    """``--policyset``: the Stage-3 whole-set report over the built-in
+    library — shared predicate subprograms (what the audit sweep
+    dedups), shadowing/unreachability findings, and the top static
+    costs."""
+    from gatekeeper_tpu.analysis.policyset import analyze_policy_set
+    entries = _library_entries()
+    report = analyze_policy_set(entries)
+    groups = report["shared_subprograms"]
+    for g in groups:
+        print(f"  shared {g['digest']} [{g['ekind']}] "
+              f"sites={g['sites']}: {', '.join(g['kinds'])}")
+    for d in report["findings"]:
+        print("  " + d.format())
+    top = sorted(report["template_costs"].items(),
+                 key=lambda kv: -kv[1]["units"])[:5]
+    for kind, cv in top:
+        print(f"  cost {kind}: {cv['units']} units "
+              f"(gathers={cv['gathers']} matmul_flops={cv['matmul_flops']} "
+              f"padding_waste={cv['padding_waste']})")
+    n_vec = sum(1 for _k, low, _c in entries if low is not None)
+    print(f"policyset: {len(entries)} template(s) ({n_vec} lowered), "
+          f"{len(groups)} shared subprogram group(s), "
+          f"{len(report['findings'])} finding(s)")
+    return 0
+
+
+def run_cost() -> int:
+    """``--cost``: predicted-vs-measured static cost over the built-in
+    library.  Builds a GATEKEEPER_COST_PROBE_N-row mixed workload (one
+    constraint per template), runs one warm full device sweep for the
+    measured ``device_s``, fits the seconds-per-unit scale
+    (costmodel.calibrate), and reports the per-template predicted
+    seconds that scale implies."""
+    import os as _os
+    import random
+    from gatekeeper_tpu.analysis import costmodel
+    from gatekeeper_tpu.client.client import Backend
+    import gatekeeper_tpu.engine.jax_driver as jd_mod
+    from gatekeeper_tpu.library import all_docs, make_mixed
+    from gatekeeper_tpu.target.k8s import K8sValidationTarget
+
+    n = int(_os.environ.get("GATEKEEPER_COST_PROBE_N", "2000"))
+    entries = _library_entries()
+    units = {kind: costmodel.estimate(low, n, 1).units()
+             for kind, low, _c in entries if low is not None}
+    total_units = sum(units.values())
+    jd = jd_mod.JaxDriver()
+    c = Backend(jd).new_client([K8sValidationTarget()])
+    for tdoc, cdoc in all_docs():
+        c.add_template(tdoc)
+        c.add_constraint(cdoc)
+    c.add_data_batch(make_mixed(random.Random(7), n))
+    measured = None
+    if not jd.scalar_only:
+        saved = jd_mod.SMALL_WORKLOAD_EVALS
+        jd_mod.SMALL_WORKLOAD_EVALS = 0
+        try:
+            c.audit(limit_per_constraint=20, full=True)   # compile warm
+            c.audit(limit_per_constraint=20, full=True)
+        finally:
+            jd_mod.SMALL_WORKLOAD_EVALS = saved
+        measured = (jd.last_sweep_phases or {}).get("device_s")
+    if measured is None or total_units <= 0:
+        print(f"cost: {len(units)} lowered template(s), "
+              f"{total_units:.3g} units at n={n}; no device measurement "
+              "(scalar-only backend)")
+        return 0
+    scale = costmodel.calibrate([(total_units, measured)])
+    for kind in sorted(units, key=lambda k: -units[k]):
+        pred = costmodel.predict_seconds(units[kind], scale)
+        print(f"  {kind}: {units[kind]:.3g} units -> "
+              f"predicted {pred * 1e3:.3f} ms")
+    print(f"cost: n={n}, measured device_s={measured:.4f}, "
+          f"predicted total={costmodel.predict_seconds(total_units, scale):.4f} "
+          f"(scale={scale:.3e} s/unit, {len(units)} templates)")
+    return 0
 
 
 def main(argv=None) -> int:
@@ -371,9 +507,15 @@ def main(argv=None) -> int:
     if "--builtins" in argv:
         print("\n".join(list_builtins()))
         return 0
+    if "--policyset" in argv:
+        return run_policyset()
+    if "--cost" in argv:
+        return run_cost()
     if "--lint" in argv:
-        rest = [a for a in argv if a not in ("--lint", "--library")]
-        return run_lint(rest, use_library="--library" in argv)
+        rest = [a for a in argv
+                if a not in ("--lint", "--library", "--strict")]
+        return run_lint(rest, use_library="--library" in argv,
+                        strict="--strict" in argv)
 
     from gatekeeper_tpu.client.local_driver import LocalDriver
     from gatekeeper_tpu.engine.jax_driver import JaxDriver

@@ -42,6 +42,7 @@ class ReconcileConstraint(Reconciler):
                     return result
             status = get_ha_status(instance)
             status.pop("errors", None)
+            status.pop("warnings", None)
             set_ha_status(instance, status)
             try:
                 self.client.add_constraint(instance)
@@ -51,6 +52,26 @@ class ReconcileConstraint(Reconciler):
                 set_ha_status(instance, status)
                 self._update(instance)
                 return DONE
+            # Stage-3 set analysis (analysis/policyset.py): flag this
+            # constraint as shadowed/unreachable against the other
+            # installed constraints of its kind.  Warnings only — the
+            # constraint still enforces (a shadowed constraint is
+            # redundant, not wrong).
+            try:
+                from gatekeeper_tpu.analysis.policyset import (
+                    constraint_set_warnings)
+                name = (instance.get("metadata") or {}).get("name", "")
+                installed = [
+                    (n, d) for n, d in
+                    self.client.constraints.get(self.gvk.kind, {}).items()
+                    if n != name]
+                for d in constraint_set_warnings(
+                        self.gvk.kind, name, instance, installed):
+                    status.setdefault("warnings", []).append(
+                        {"code": d.code, "message": d.message,
+                         "location": str(d.location)})
+            except Exception:
+                pass        # set analysis must never block enforcement
             status["enforced"] = True
             set_ha_status(instance, status)
             _, result = self._update(instance)

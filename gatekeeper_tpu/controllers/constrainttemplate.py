@@ -270,6 +270,62 @@ class ReconcileConstraintTemplate(Reconciler):
             status.setdefault("warnings", []).append(
                 {"code": d.code, "message": d.message,
                  "location": str(d.location)})
+        self._policyset_vet(instance, kind, status)
+
+    def _policyset_vet(self, instance: dict, kind: str,
+                       status: dict) -> None:
+        """Stage-3 policy-set vet (analysis/policyset.py): price the
+        lowered program against the static cost budget (strict mode
+        raises VetError, rejecting the template) and flag predicate
+        subprograms already installed under another template
+        (``set_duplicate_predicate`` — informational; the audit sweep
+        dedups them).  Scalar-fallback templates have no lowered
+        program and no device cost to gate."""
+        from gatekeeper_tpu.analysis import costmodel, has_errors
+        from gatekeeper_tpu.analysis.policyset import (
+            duplicate_predicate_warnings, vet_template_cost)
+        from gatekeeper_tpu.errors import VetError
+
+        lowered = self._lower_instance(instance)
+        if lowered is None:
+            return
+        diags = vet_template_cost(lowered, kind)
+        others = {}
+        for st in (getattr(self.client.driver, "state", None) or {}).values():
+            for okind, compiled in getattr(st, "templates", {}).items():
+                low = getattr(compiled, "vectorized", None)
+                if low is not None and okind != kind:
+                    others[okind] = low
+        diags.extend(duplicate_predicate_warnings(kind, lowered, others))
+        if has_errors(diags):
+            raise VetError(diags)
+        for d in diags:
+            status.setdefault("warnings", []).append(
+                {"code": d.code, "message": d.message,
+                 "location": str(d.location)})
+        metrics = getattr(self.client.driver, "metrics", None)
+        if metrics is not None:
+            cv = costmodel.estimate(lowered, costmodel.REF_ROWS, 1)
+            metrics.gauge(f"template_cost_units_{kind}").set(cv.units())
+
+    @staticmethod
+    def _lower_instance(instance: dict):
+        """Lowered device program of a template doc, or None when it
+        takes the scalar fallback (CannotLower) or fails to compile —
+        compile errors are the Stage-1 vet's job, not this pass's."""
+        from gatekeeper_tpu.api.templates import compile_target_rego
+        from gatekeeper_tpu.ir.lower import CannotLower, lower_template
+        kind = _template_kind(instance)
+        for tt in ((instance.get("spec") or {}).get("targets") or ()):
+            try:
+                compiled = compile_target_rego(
+                    kind, tt.get("target", ""), tt.get("rego") or "")
+                return lower_template(compiled.module, compiled.interp)
+            except CannotLower:
+                return None
+            except Exception:
+                return None
+        return None
 
     def _add_template(self, instance: dict) -> bool:
         """AddTemplate with update_error status reporting (:198-205)."""

@@ -691,6 +691,48 @@ class JaxDriver(LocalDriver):
                                "__rank__": pad_rank(rank, bindings.r_pad)}
             d["_rank_src"] = rank
 
+    @staticmethod
+    def _apply_dedup(plan, kind: str, bindings, shared_cols: dict,
+                     applied: dict):
+        """Swap one kind's program for its dedup rewrite
+        (analysis/policyset.py), injecting the shared predicate columns
+        as plain bool bindings.  The column for a digest is computed
+        ONCE per sweep — on the host, from the first member kind's
+        bound arrays (the numpy twin of the device evaluator) — and
+        handed to every member; member kinds bind identical arrays for
+        identical canonical inputs (same inventory, same interner, same
+        row bucket), which the shape guard re-checks per kind.  Any
+        mismatch or twin failure keeps the kind on its original
+        program.  Returns the rewritten Program or None."""
+        from gatekeeper_tpu.analysis.policyset import eval_shared_host
+        add: dict = {}
+        try:
+            for digest in plan.kind_digests[kind]:
+                g = plan.groups[digest]
+                col = shared_cols.get(digest)
+                if col is None:
+                    member = g.members[kind]
+                    col = eval_shared_host(
+                        plan.originals[kind], member.node_idx,
+                        bindings.arrays, g.ekind)
+                    shared_cols[digest] = col
+                if g.ekind == "e":
+                    if col.shape != (bindings.r_pad,
+                                     bindings.e_pads.get(g.axis)):
+                        return None
+                elif col.shape != (bindings.r_pad,):
+                    return None
+                add[g.binding] = col
+        except Exception:
+            return None     # dedup is an optimization, never a failure
+        # rebind, never mutate (see the _install_gates NOTE): readers
+        # may hold the previous arrays dict
+        bindings.arrays = {**bindings.arrays, **add}
+        for digest in plan.kind_digests[kind]:
+            applied[digest] = applied.get(digest, 0) \
+                + plan.groups[digest].members[kind].sites
+        return plan.rewritten[kind]
+
     # ------------------------------------------------------------------
 
     @locked_read
@@ -833,11 +875,38 @@ class JaxDriver(LocalDriver):
                 for r_pad, c_pad in pads:
                     pool.submit(self.executor.prewarm_reduce, limit, c_pad,
                                 r_pad)
+            # cross-template predicate dedup (analysis/policyset.py):
+            # full sweeps only — the plan is a pure function of the
+            # installed set, rebuilt each time (milliseconds), so there
+            # is no cached plan to go stale under template churn.
+            # GATEKEEPER_DEDUP=off is the parity oracle's kill switch.
+            dedup_plan = None
+            dedup_shared_cols: dict = {}
+            dedup_applied: dict = {}
+            dedup_host_s = 0.0
             _t_pipe = _time.perf_counter()
             try:
                 with self._prep_lock:
                     _tk = _time.perf_counter()
                     self._prefetch_axes(st)
+                    if full and not self.scalar_only and \
+                            os.environ.get("GATEKEEPER_DEDUP", "on") != "off":
+                        try:
+                            from gatekeeper_tpu.analysis.policyset import \
+                                build_dedup_plan
+                            dkinds = {}
+                            for k in st.templates:
+                                cons = self._kind_constraints(st, k)
+                                if st.templates[k].vectorized is not None \
+                                        and cons:
+                                    dkinds[k] = (st.templates[k].vectorized,
+                                                 cons)
+                            if dkinds:
+                                dedup_plan = build_dedup_plan(dkinds)
+                        except Exception:
+                            # dedup is an optimization; the original
+                            # programs are always a valid fallback
+                            dedup_plan = None
                     ph["host_prep_s"] += _time.perf_counter() - _tk
                     for kind in sorted(st.templates):
                         _tk = _time.perf_counter()
@@ -883,6 +952,16 @@ class JaxDriver(LocalDriver):
                             self._install_gates(st, kind, bindings, mask,
                                                 mask_dirty, rank, padded)
                             prog = compiled.vectorized.program
+                            if dedup_plan is not None and \
+                                    kind in dedup_plan.rewritten:
+                                _t_dd = _time.perf_counter()
+                                prog2 = self._apply_dedup(
+                                    dedup_plan, kind, bindings,
+                                    dedup_shared_cols, dedup_applied)
+                                if prog2 is not None:
+                                    prog = prog2
+                                dedup_host_s += \
+                                    _time.perf_counter() - _t_dd
                             mode = "topk" if limit is not None else "mask"
                             spec = (mode, kind, compiled, constraints, prog,
                                     bindings, mask)
@@ -1028,6 +1107,23 @@ class JaxDriver(LocalDriver):
                 ext = self._external_sweep_stats(ext_fut)
                 if ext is not None:
                     self.last_sweep_phases["external"] = ext
+                if dedup_plan is not None:
+                    n_res = len(ordered_rows)
+                    saved = sum(max(0, c - 1) * n_res
+                                for c in dedup_applied.values())
+                    shared_n = sum(1 for c in dedup_applied.values()
+                                   if c >= 2)
+                    self.last_sweep_phases["dedup"] = {
+                        "enabled": True,
+                        "groups": len(dedup_plan.groups),
+                        "subprograms_shared": shared_n,
+                        "evaluations_saved": int(saved),
+                        "host_eval_s": round(dedup_host_s, 6),
+                    }
+                    m.counter("dedup_shared_subprograms").inc(shared_n)
+                    m.counter("dedup_evaluations_saved").inc(saved)
+                else:
+                    self.last_sweep_phases["dedup"] = {"enabled": False}
                 m.counter("full_sweeps").inc()
                 m.timer("full_sweep_host_prep").observe(ph["host_prep_s"])
                 m.timer("full_sweep_h2d").observe(ph["h2d_s"])

@@ -98,6 +98,10 @@ def binding_axes(name: str) -> tuple:
         return ("r",)                            # inventory join bool [R]
     if base.startswith("t") and base[1:].isdigit():
         return (None,)                           # unary table [T]
+    if name.startswith("__shared_e__:"):
+        return ("r", None)                       # dedup-injected [R, E]
+    if name.startswith("__shared__:"):
+        return ("r",)                            # dedup-injected [R]
     raise ValueError(f"binding_axes: unrecognized binding {name!r}; "
                      f"add its axes rule here")
 

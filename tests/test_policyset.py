@@ -1,0 +1,426 @@
+"""Stage-3 whole-policy-set analysis (gatekeeper_tpu/analysis): the
+static IR cost model, the install-time cost-budget gate, cross-
+constraint shadowing/unreachability, cross-template predicate dedup
+(with parity against a no-dedup oracle sweep), and the lock-discipline
+self-lint."""
+
+from __future__ import annotations
+
+import random
+import textwrap
+
+import pytest
+
+from gatekeeper_tpu.analysis import costmodel
+from gatekeeper_tpu.analysis.costmodel import (calibrate, estimate,
+                                               predict_seconds)
+from gatekeeper_tpu.analysis.policyset import (analyze_policy_set,
+                                               build_dedup_plan,
+                                               constraint_set_warnings,
+                                               duplicate_predicate_warnings,
+                                               match_subsumes,
+                                               match_unreachable,
+                                               template_digests,
+                                               vet_template_cost)
+from gatekeeper_tpu.analysis.selflint import lint_lock_paths
+from gatekeeper_tpu.api.templates import compile_target_rego
+from gatekeeper_tpu.client.client import Backend
+from gatekeeper_tpu.engine.jax_driver import JaxDriver
+from gatekeeper_tpu.ir.lower import lower_template
+from gatekeeper_tpu.ir.prep import PrepSpec, RColReq
+from gatekeeper_tpu.ir.program import Node, Program, RuleSpec
+from gatekeeper_tpu.library import LIBRARY, TARGET, constraint_doc, \
+    make_mixed, template_doc
+from gatekeeper_tpu.target.k8s import K8sValidationTarget, TARGET_NAME
+
+
+def _lower(kind: str):
+    rego, _params = LIBRARY[kind]
+    compiled = compile_target_rego(kind, TARGET, rego)
+    return lower_template(compiled.module, compiled.interp)
+
+
+class _FakeLowered:
+    """Minimal LoweredProgram stand-in for hand-built cost goldens."""
+
+    def __init__(self, program: Program, spec: PrepSpec = PrepSpec()):
+        self.program = program
+        self.spec = spec
+        self.n_rules_total = len(program.rules)
+        self.n_rules_lowered = len(program.rules)
+
+
+# ---------------------------------------------------------------------------
+# cost model: golden values per op class
+
+
+class TestCostModel:
+    # n_rows=100, n_constraints=1 -> r_pad=128 (power-of-two bucket,
+    # min 8), c_pad=4 (min 4); these goldens are hand-derived from the
+    # padding rules in ir/prep.audit_pads
+
+    def test_golden_compare_chain(self):
+        prog = Program(
+            nodes=(Node("input", (), ("x", "r_num")),
+                   Node("const", (), (3.0, "float32")),
+                   Node("cmp", (0, 1), (">",)),
+                   Node("not", (2,), ())),
+            rules=(RuleSpec(conjuncts=(2, 3)),))
+        spec = PrepSpec(r_cols=(RColReq("x", ("spec", "x"), "num"),))
+        cv = estimate(_FakeLowered(prog, spec), 100, 1)
+        assert cv.compares == 128                 # cmp over [r_pad]
+        # not over [r_pad] + 2 conjunct-ANDs over [c_pad, r_pad]
+        assert cv.logicals == 128 + 2 * 4 * 128
+        assert cv.reductions == 4 * 128           # rule any-reduce
+        assert cv.gathers == cv.arith == cv.matmul_flops == 0
+        assert cv.units() == pytest.approx(
+            128 * 1.0 + (128 + 1024) * 0.25 + 512 * 1.0)
+        assert cv.live_cells == 100
+        assert cv.padded_cells == 512
+        assert cv.padding_waste() == pytest.approx((512 - 100) / 512)
+        # h2d: alive [r_pad] + cvalid [c_pad] + match [c_pad, r_pad]
+        # + one num r_col at 5 bytes/row
+        assert cv.h2d_bytes == 128 + 4 + 512 + 128 * 5
+
+    def test_golden_gather(self):
+        prog = Program(
+            nodes=(Node("input", (), ("x", "r_id")),
+                   Node("in_cset", (0,), ("s",))),
+            rules=(RuleSpec(conjuncts=(1,)),))
+        cv = estimate(_FakeLowered(prog), 100, 1)
+        assert cv.gathers == 4 * 128              # in_cset is per-constraint
+        assert cv.gather_volume_bytes == 4 * cv.gathers
+        assert cv.compares == 0
+
+    def test_golden_elem_reduction(self):
+        prog = Program(
+            nodes=(Node("input", (), ("e", "e_bool")),
+                   Node("any_e", (0,), ("ax",))),
+            rules=(RuleSpec(conjuncts=(1,)),))
+        cv = estimate(_FakeLowered(prog), 100, 1, e_pad=8)
+        # the reduction consumes its operand's [r_pad, e_pad] cells,
+        # plus the rule's own any-reduce over [c_pad, r_pad]
+        assert cv.reductions == 128 * 8 + 4 * 128
+
+    def test_dead_subtrees_are_free(self):
+        live = Program(
+            nodes=(Node("input", (), ("x", "r_num")),
+                   Node("const", (), (3.0, "float32")),
+                   Node("cmp", (0, 1), (">",))),
+            rules=(RuleSpec(conjuncts=(2,)),))
+        dead_extra = Program(
+            nodes=live.nodes + (Node("cmp", (0, 1), ("<",)),),
+            rules=live.rules)
+        a = estimate(_FakeLowered(live), 100, 1)
+        b = estimate(_FakeLowered(dead_extra), 100, 1)
+        assert a.units() == b.units()
+
+    def test_calibrate_recovers_scale(self):
+        scale = calibrate([(100.0, 1e-4), (200.0, 2e-4)])
+        assert scale == pytest.approx(1e-6)
+        assert predict_seconds(1000.0, scale) == pytest.approx(1e-3)
+        assert calibrate([]) == 0.0
+
+    def test_library_templates_price_positive(self):
+        for kind in ("K8sRequiredLabels", "K8sAllowedRepos",
+                     "K8sContainerLimits"):
+            cv = estimate(_lower(kind), 2_000, 1)
+            assert cv.units() > 0, kind
+            assert cv.h2d_bytes > 0, kind
+
+
+# ---------------------------------------------------------------------------
+# install-time cost-budget gate
+
+
+class TestBudgetGate:
+    def test_warn_mode_warns(self, monkeypatch):
+        monkeypatch.setenv("GATEKEEPER_COST_BUDGET", "warn")
+        monkeypatch.setenv("GATEKEEPER_COST_BUDGET_UNITS", "1")
+        [d] = vet_template_cost(_lower("K8sRequiredLabels"),
+                                "K8sRequiredLabels")
+        assert d.code == "cost_budget_exceeded"
+        assert d.severity == "warning"
+
+    def test_strict_mode_errors(self, monkeypatch):
+        monkeypatch.setenv("GATEKEEPER_COST_BUDGET", "strict")
+        monkeypatch.setenv("GATEKEEPER_COST_BUDGET_UNITS", "1")
+        [d] = vet_template_cost(_lower("K8sRequiredLabels"),
+                                "K8sRequiredLabels")
+        assert d.severity == "error"
+
+    def test_off_mode_skips(self, monkeypatch):
+        monkeypatch.setenv("GATEKEEPER_COST_BUDGET", "off")
+        monkeypatch.setenv("GATEKEEPER_COST_BUDGET_UNITS", "1")
+        assert vet_template_cost(_lower("K8sRequiredLabels"),
+                                 "K8sRequiredLabels") == []
+
+    def test_default_budget_admits_library(self, monkeypatch):
+        monkeypatch.delenv("GATEKEEPER_COST_BUDGET", raising=False)
+        monkeypatch.delenv("GATEKEEPER_COST_BUDGET_UNITS", raising=False)
+        for kind in sorted(LIBRARY):
+            try:
+                lowered = _lower(kind)
+            except Exception:
+                continue        # scalar-fallback templates have no cost
+            assert vet_template_cost(lowered, kind) == [], kind
+
+    def test_reconcile_statuses(self, monkeypatch):
+        """warn mode installs the template with a status warning;
+        strict mode rejects it with a status error."""
+        from gatekeeper_tpu.cluster.fake import FakeCluster
+        from gatekeeper_tpu.controllers.constrainttemplate import \
+            TEMPLATE_GVK
+        from gatekeeper_tpu.controllers.registry import add_to_manager
+        from gatekeeper_tpu.client.local_driver import LocalDriver
+        from gatekeeper_tpu.utils.ha_status import get_ha_status
+
+        monkeypatch.setenv("GATEKEEPER_COST_BUDGET_UNITS", "1")
+        rego, _params = LIBRARY["K8sRequiredLabels"]
+        tdoc = template_doc("K8sRequiredLabels", rego)
+
+        def plane():
+            cluster = FakeCluster()
+            cluster.register_kind(TEMPLATE_GVK, "constrainttemplates")
+            client = Backend(LocalDriver()).new_client(
+                [K8sValidationTarget()])
+            return cluster, add_to_manager(cluster, client)
+
+        monkeypatch.setenv("GATEKEEPER_COST_BUDGET", "warn")
+        cluster, p = plane()
+        cluster.create(tdoc)
+        p.run_until_idle()
+        tmpl = cluster.get(TEMPLATE_GVK, "k8srequiredlabels")
+        st = get_ha_status(tmpl)
+        assert tmpl["status"].get("created") is True
+        assert any(w["code"] == "cost_budget_exceeded"
+                   for w in st.get("warnings", []))
+        assert not st.get("errors")
+
+        monkeypatch.setenv("GATEKEEPER_COST_BUDGET", "strict")
+        cluster, p = plane()
+        cluster.create(tdoc)
+        p.run_until_idle()
+        tmpl = cluster.get(TEMPLATE_GVK, "k8srequiredlabels")
+        st = get_ha_status(tmpl)
+        assert any(e["code"] == "cost_budget_exceeded"
+                   for e in st.get("errors", []))
+        assert tmpl.get("status", {}).get("created") is not True
+
+
+# ---------------------------------------------------------------------------
+# shadowing / unreachability truth table
+
+
+def _con(name, match=None, params=None, action=None):
+    doc = constraint_doc("K", name, params=params or {"p": 1}, match=match)
+    if action is not None:
+        doc["spec"]["enforcementAction"] = action
+    return doc
+
+
+def _codes(diags):
+    return sorted(d.code for d in diags)
+
+
+class TestShadowing:
+    def test_unreachable_cases(self):
+        assert match_unreachable({"kinds": "Pod"}) is not None
+        assert match_unreachable({"kinds": []}) is not None
+        assert match_unreachable({"kinds": [{"apiGroups": [],
+                                             "kinds": ["Pod"]}]}) is not None
+        assert match_unreachable({"namespaces": []}) is not None
+        assert match_unreachable({}) is None
+        assert match_unreachable(
+            {"kinds": [{"apiGroups": ["*"], "kinds": ["Pod"]}]}) is None
+
+    def test_subsumption_truth_table(self):
+        everything = {}
+        pods = {"kinds": [{"apiGroups": [""], "kinds": ["Pod"]}]}
+        pods_svcs = {"kinds": [{"apiGroups": [""],
+                                "kinds": ["Pod", "Service"]}]}
+        wild = {"kinds": [{"apiGroups": ["*"], "kinds": ["*"]}]}
+        ns_ab = {"namespaces": ["a", "b"]}
+        ns_a = {"namespaces": ["a"]}
+        assert match_subsumes(everything, pods)
+        assert match_subsumes(pods_svcs, pods)
+        assert not match_subsumes(pods, pods_svcs)
+        assert match_subsumes(wild, pods)
+        # A restricted by kinds, B the kind-wildcard: no subsumption
+        assert not match_subsumes(pods, everything)
+        assert match_subsumes(ns_ab, ns_a)
+        assert not match_subsumes(ns_a, ns_ab)
+        # selector clauses: covered only by equality or absence in A
+        sel = {"labelSelector": {"matchLabels": {"x": "1"}}}
+        assert match_subsumes(everything, sel)
+        assert not match_subsumes(sel, everything)
+        assert match_subsumes(sel, sel)
+        # a statically unreachable B is never "shadowed"
+        assert not match_subsumes(everything, {"namespaces": []})
+
+    def test_set_warnings(self):
+        broad = _con("broad")                       # matches everything
+        narrow = _con("narrow",
+                      match={"kinds": [{"apiGroups": [""],
+                                        "kinds": ["Pod"]}]})
+        assert _codes(constraint_set_warnings(
+            "K", "narrow", narrow, [("broad", broad)])) == ["set_shadowed"]
+        assert _codes(constraint_set_warnings(
+            "K", "broad", broad, [("narrow", narrow)])) == ["set_shadows"]
+
+    def test_no_warning_across_params_or_weaker_action(self):
+        broad = _con("broad")
+        narrow = _con("narrow", match={"namespaces": ["a"]})
+        other_params = _con("narrow", match={"namespaces": ["a"]},
+                            params={"p": 2})
+        assert constraint_set_warnings(
+            "K", "narrow", other_params, [("broad", broad)]) == []
+        # the subsuming constraint only dryruns: it does not shadow a
+        # denying one
+        weak_broad = _con("broad", action="dryrun")
+        assert _codes(constraint_set_warnings(
+            "K", "narrow", narrow, [("broad", weak_broad)])) == []
+        # but a deny constraint does shadow a dryrun one
+        weak_narrow = _con("narrow", match={"namespaces": ["a"]},
+                           action="dryrun")
+        assert _codes(constraint_set_warnings(
+            "K", "narrow", weak_narrow, [("broad", broad)])) \
+            == ["set_shadowed"]
+
+    def test_unreachable_constraint_flagged(self):
+        dead = _con("dead", match={"namespaces": []})
+        assert _codes(constraint_set_warnings(
+            "K", "dead", dead, [])) == ["set_unreachable"]
+
+
+# ---------------------------------------------------------------------------
+# cross-template predicate dedup
+
+# library kinds known to share a canonical predicate subprogram (the
+# container/initContainer any-walk over pod-ish objects); asserted by
+# the acceptance criterion "probe --policyset reports >= 1 shared
+# subprogram"
+DEDUP_KINDS = ("K8sAllowedSeccompProfiles", "K8sAutomountServiceAccountToken",
+               "K8sImagePullSecrets", "K8sPriorityClass",
+               "K8sRequiredServiceAccount")
+
+
+class TestDedup:
+    def test_plan_finds_shared_subprograms(self):
+        kinds = {}
+        for kind in DEDUP_KINDS:
+            cdoc = constraint_doc(kind, f"{kind.lower()}-1",
+                                  params=LIBRARY[kind][1])
+            kinds[kind] = (_lower(kind), [cdoc])
+        plan = build_dedup_plan(kinds)
+        shared = [g for g in plan.groups.values() if g.total_sites >= 2]
+        assert shared, "expected >= 1 shared subprogram group"
+        assert plan.rewritten, "expected rewritten member programs"
+        for kind in plan.rewritten:
+            assert kind in plan.originals
+
+    def test_digest_is_cross_template_stable(self):
+        a = template_digests(_lower(DEDUP_KINDS[0]))
+        b = template_digests(_lower(DEDUP_KINDS[1]))
+        assert a & b, "expected a common canonical digest"
+
+    def test_duplicate_predicate_warning(self):
+        kind = DEDUP_KINDS[0]
+        others = {k: _lower(k) for k in DEDUP_KINDS[1:]}
+        diags = duplicate_predicate_warnings(kind, _lower(kind), others)
+        assert diags and all(d.code == "set_duplicate_predicate"
+                             for d in diags)
+
+    def test_analyze_policy_set_report(self):
+        entries = []
+        for kind in DEDUP_KINDS:
+            cdoc = constraint_doc(kind, f"{kind.lower()}-1",
+                                  params=LIBRARY[kind][1])
+            entries.append((kind, _lower(kind), [cdoc]))
+        report = analyze_policy_set(entries)
+        assert report["shared_subprograms"]
+        assert set(report["template_costs"]) == set(DEDUP_KINDS)
+
+    def test_sweep_parity_and_savings(self, monkeypatch):
+        """The deduped full sweep must return verdicts identical to a
+        GATEKEEPER_DEDUP=off oracle sweep, while actually saving
+        evaluations."""
+        from gatekeeper_tpu.engine import jax_driver as jd_mod
+
+        resources = make_mixed(random.Random(11), 200)
+
+        def sweep(dedup: str):
+            monkeypatch.setenv("GATEKEEPER_DEDUP", dedup)
+            jd = JaxDriver()
+            c = Backend(jd).new_client([K8sValidationTarget()])
+            for kind in DEDUP_KINDS:
+                rego, params = LIBRARY[kind]
+                c.add_template(template_doc(kind, rego))
+                c.add_constraint(constraint_doc(
+                    kind, f"{kind.lower()}-1", params=params))
+            c.add_data_batch(resources)
+            monkeypatch.setattr(jd_mod, "SMALL_WORKLOAD_EVALS", 0)
+            resp = c.audit(limit_per_constraint=50, full=True)
+            verdicts = sorted(
+                ((r.constraint or {}).get("kind", ""),
+                 ((r.resource or {}).get("metadata") or {}).get("name", ""),
+                 r.msg)
+                for r in resp.results())
+            return verdicts, dict(jd.last_sweep_phases.get("dedup") or {})
+
+        v_off, st_off = sweep("off")
+        v_on, st_on = sweep("on")
+        assert st_off == {"enabled": False}
+        assert v_on == v_off
+        assert st_on.get("enabled") is True
+        assert st_on.get("subprograms_shared", 0) >= 1
+        assert st_on.get("evaluations_saved", 0) > 0
+
+
+# ---------------------------------------------------------------------------
+# lock-discipline self-lint
+
+
+class TestLockLint:
+    def test_blocking_calls_under_lock_flagged(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text(textwrap.dedent("""\
+            import time
+
+            class M:
+                def go(self):
+                    with self._lock:
+                        time.sleep(1)
+                        v = self.provider.fetch("k")
+                        f.result()
+            """))
+        findings = lint_lock_paths([str(bad)])
+        assert len(findings) == 3
+        assert all("while holding self._lock" in f for f in findings)
+
+    def test_clean_patterns_admit(self, tmp_path):
+        good = tmp_path / "good.py"
+        good.write_text(textwrap.dedent("""\
+            import time
+
+            class M:
+                def go(self):
+                    with self._lock:
+                        x = self.cache.get("k")      # non-blocking
+                        def deferred():
+                            time.sleep(3)            # runs later
+                        self._pending.append(deferred)
+                    time.sleep(0.1)                  # outside the lock
+                    v = self.provider.fetch("k")     # outside the lock
+
+                def other(self):
+                    with open("f") as fh:            # not a lock
+                        fh.read()
+            """))
+        assert lint_lock_paths([str(good)]) == []
+
+    def test_repo_host_control_plane_is_clean(self):
+        findings = lint_lock_paths(["gatekeeper_tpu/watch",
+                                    "gatekeeper_tpu/controllers",
+                                    "gatekeeper_tpu/externaldata"])
+        assert findings == []
