@@ -34,6 +34,37 @@ if [ "${SOAK:-0}" = "1" ]; then
   GATEKEEPER_SOAK=1 python -m pytest tests/test_soak.py -q
 fi
 
+echo "== restart smoke (warm-restart persistence) =="
+# cold run in a fresh snapshot dir, then a warm run in a FRESH PROCESS
+# against the same dir: the warm process must skip all Rego lowering,
+# restore the store, report snapshot hits, produce bit-identical
+# verdicts, and start up in under half the cold wall-clock
+SNAPDIR=$(mktemp -d)
+COLD=$(JAX_PLATFORMS=cpu GATEKEEPER_SNAPSHOT_DIR="$SNAPDIR" \
+       GATEKEEPER_SMOKE_N=200 python -m gatekeeper_tpu.resilience.smoke)
+WARM=$(JAX_PLATFORMS=cpu GATEKEEPER_SNAPSHOT_DIR="$SNAPDIR" \
+       GATEKEEPER_SMOKE_N=200 python -m gatekeeper_tpu.resilience.smoke)
+rm -rf "$SNAPDIR"
+COLD="$COLD" WARM="$WARM" python - <<'EOF'
+import json, os
+cold = json.loads(os.environ["COLD"])
+warm = json.loads(os.environ["WARM"])
+assert warm["restart_persistent_cache_hits"] > 0, \
+    f"warm run reused nothing: {warm}"
+assert warm["lowerings"] == 0, f"warm run re-lowered Rego: {warm}"
+assert warm["store_restored"] is True, f"store not restored: {warm}"
+assert warm["verdict_digest"] == cold["verdict_digest"], \
+    f"verdicts diverged: cold {cold['verdict_digest']} " \
+    f"warm {warm['verdict_digest']}"
+assert warm["startup_seconds"] < 0.5 * cold["startup_seconds"], \
+    f"warm startup {warm['startup_seconds']}s not < 50% of " \
+    f"cold {cold['startup_seconds']}s"
+print(f"restart smoke ok: startup cold {cold['startup_seconds']}s -> "
+      f"warm {warm['startup_seconds']}s; "
+      f"{warm['restart_persistent_cache_hits']} snapshot hits, "
+      f"0 re-lowerings, verdict digest {warm['verdict_digest']}")
+EOF
+
 echo "== bench smoke (quick shapes) =="
 GATEKEEPER_BENCH_QUICK=1 GATEKEEPER_BENCH_N=20000 python bench.py > /tmp/bench.json
 python - <<'EOF'

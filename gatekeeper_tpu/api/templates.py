@@ -60,13 +60,27 @@ class CompiledTemplate:
     target: str
     source: str
     module: Module
-    interp: Interpreter
     # vectorized program attached by the jax driver's lowerer; None = the
     # scalar fallback handles this template entirely
     vectorized: Any = None
     # does any rule read data.inventory?  If not, drivers skip building
     # the frozen inventory document for message evaluation
     uses_inventory: bool = False
+    # lazily-built scalar interpreter (see the `interp` property)
+    _interp: "Interpreter | None" = dataclasses.field(
+        default=None, repr=False, compare=False)
+
+    @property
+    def interp(self) -> Interpreter:
+        """The scalar oracle over this module, built on first use: a
+        warm-restarted process that serves from snapshotted lowered IR
+        never pays interpreter construction at startup, and a cold one
+        pays it where it is first needed (lowering or scalar eval).  A
+        racing double-build is benign — construction is a pure function
+        of the module and the last assignment wins."""
+        if self._interp is None:
+            self._interp = Interpreter(self.module)
+        return self._interp
 
     def violations(self, input_doc, data_doc, tracer=None) -> list:
         return self.interp.query_set("violation", input_doc, data_doc, tracer=tracer)
@@ -105,6 +119,18 @@ def check_rego_conformance(module: Module) -> None:
         raise CompileError("; ".join(sorted(set(errs))))
 
 
+def rebuild_from_module(kind: str, target: str, rego_src: str,
+                        module: Module,
+                        uses_inventory: bool) -> CompiledTemplate:
+    """Rebuild a CompiledTemplate from a snapshotted parsed Module
+    (resilience/snapshot.py warm-restart path).  The Interpreter is
+    never snapshotted — its side tables are id()-keyed over the live
+    AST objects and must not cross a process boundary; the lazy
+    `interp` property reconstructs it on first use."""
+    return CompiledTemplate(kind=kind, target=target, source=rego_src,
+                            module=module, uses_inventory=uses_inventory)
+
+
 def compile_target_rego(kind: str, target: str, rego_src: str) -> CompiledTemplate:
     module = parse_module(rego_src)  # ParseError propagates with its location
     check_rego_conformance(module)
@@ -117,5 +143,4 @@ def compile_target_rego(kind: str, target: str, rego_src: str) -> CompiledTempla
     for rule in module.rules:
         walk_terms(rule, spot_data)
     return CompiledTemplate(kind=kind, target=target, source=rego_src,
-                            module=module, interp=Interpreter(module),
-                            uses_inventory=uses_inv[0])
+                            module=module, uses_inventory=uses_inv[0])

@@ -139,6 +139,19 @@ class AuditManager:
                 if k in phases:
                     report[k] = phases[k]
 
+        # serving posture (resilience/supervisor): a sweep that ran —
+        # partly or wholly — on the scalar/CPU fallback is correct but
+        # must say so (maps to the reference's status.byPod[] operating
+        # report; see BASELINE.md)
+        from gatekeeper_tpu.resilience.supervisor import HEALTHY, \
+            get_supervisor
+        sup = get_supervisor()
+        report["backend_state"] = sup.state
+        if sup.state != HEALTHY:
+            report["degraded"] = True
+            report["degraded_reason"] = sup.reason
+            self.metrics.counter("audit_sweeps_degraded").inc()
+
         update_lists = self._update_lists(results)
 
         # discovery: constraint kinds under constraints.gatekeeper.sh/v1alpha1
@@ -153,7 +166,25 @@ class AuditManager:
         updated = self._write_audit_results(kinds, update_lists, timestamp)
         report["write_seconds"] = self._now() - t_write
         report["constraints_updated"] = updated
+        self._maybe_snapshot_store()
         return report
+
+    def _maybe_snapshot_store(self) -> None:
+        """Warm-restart persistence: after a successful sweep, persist
+        each target's columnar store so a restarted pod restores the
+        inventory from disk instead of replaying it.  No-op unless
+        GATEKEEPER_SNAPSHOT_DIR is set."""
+        drv = getattr(self.client, "driver", None)
+        if drv is None or not hasattr(drv, "save_store_snapshot"):
+            return
+        try:
+            from gatekeeper_tpu.resilience import snapshot as _snap
+            if not _snap.enabled():
+                return
+            for target in getattr(drv, "targets", {}):
+                drv.save_store_snapshot(target)
+        except Exception as e:   # noqa: BLE001 — persistence is
+            _log.warning("store snapshot failed", error=e)   # best-effort
 
     def _update_lists(self, results) -> dict[str, list[dict]]:
         """Group results per constraint selfLink with cap + truncation
@@ -241,10 +272,29 @@ class AuditManager:
         if drv is not None and hasattr(drv, "executor"):
             for target in getattr(drv, "targets", {}):
                 warm_audit(drv, target, cap=self.violations_limit)
+            # backend recovery => the driver drops its executables; the
+            # same warmup re-jits them onto the recovered backend in
+            # the background so the next interval tick sweeps on-device
+            from gatekeeper_tpu.resilience.supervisor import get_supervisor
+            get_supervisor().add_recovery_listener(self, "_rewarm_on_recovery")
         self._stop.clear()
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="audit-manager")
         self._thread.start()
+
+    def _rewarm_on_recovery(self) -> None:
+        """Recovery listener: re-warm the audit executables after the
+        driver re-targeted the recovered backend."""
+        from gatekeeper_tpu.utils.compile_cache import warm_audit
+        drv = getattr(self.client, "driver", None)
+        if drv is None or not hasattr(drv, "executor"):
+            return
+        for target in getattr(drv, "targets", {}):
+            try:
+                warm_audit(drv, target, cap=self.violations_limit)
+            except Exception as e:   # noqa: BLE001 — next sweep warms
+                _log.warning("post-recovery warmup failed",   # lazily
+                             target=target, error=e)
 
     def stop(self) -> None:
         self._stop.set()

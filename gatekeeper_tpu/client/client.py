@@ -67,19 +67,42 @@ class Client:
                 raise ClientError(f"unknown target {tt.target!r}")
             if first_handler is None:
                 first_handler = handler
-            compiled = compile_target_rego(tmpl.kind, tt.target, tt.rego)
-            # Stage-1 static vet (analysis/vetter.py): error findings
-            # reject the template at ingestion, before anything is
-            # registered.  providers=None here — the client has no
-            # provider registry in scope (providers may legitimately be
-            # registered after the template); the reconciler enforces
-            # provider existence with the live set.
-            from gatekeeper_tpu.analysis import has_errors, vet_module
-            diags = vet_module(compiled.module, providers=None,
-                               file=tmpl.kind)
-            if has_errors(diags):
-                from gatekeeper_tpu.errors import VetError
-                raise VetError(diags)
+            # warm-restart fast path: a snapshotted module is the parsed
+            # AST of this exact source AFTER it passed hygiene checks and
+            # the stage-1 vet (entries are only written below, post-vet),
+            # so parse + vet are skipped wholesale on a hit
+            from gatekeeper_tpu.resilience import snapshot as _snap
+            compiled = None
+            if _snap.enabled():
+                hit = _snap.load_template_module(tmpl.kind, tt.target,
+                                                 tt.rego)
+                if hit is not None:
+                    try:
+                        from gatekeeper_tpu.api.templates import \
+                            rebuild_from_module
+                        module, uses_inv = hit[0]
+                        compiled = rebuild_from_module(
+                            tmpl.kind, tt.target, tt.rego, module, uses_inv)
+                    except Exception:   # noqa: BLE001 — cold rebuild
+                        compiled = None
+            if compiled is None:
+                compiled = compile_target_rego(tmpl.kind, tt.target, tt.rego)
+                # Stage-1 static vet (analysis/vetter.py): error findings
+                # reject the template at ingestion, before anything is
+                # registered.  providers=None here — the client has no
+                # provider registry in scope (providers may legitimately be
+                # registered after the template); the reconciler enforces
+                # provider existence with the live set.
+                from gatekeeper_tpu.analysis import has_errors, vet_module
+                diags = vet_module(compiled.module, providers=None,
+                                   file=tmpl.kind)
+                if has_errors(diags):
+                    from gatekeeper_tpu.errors import VetError
+                    raise VetError(diags)
+                if _snap.enabled():
+                    _snap.save_template_module(
+                        tmpl.kind, tt.target, tt.rego,
+                        (compiled.module, compiled.uses_inventory))
             compiled_by_target[tt.target] = compiled
         return compiled_by_target, build_crd(tmpl, first_handler.match_schema())
 

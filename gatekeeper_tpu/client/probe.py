@@ -488,6 +488,46 @@ def run_cost() -> int:
     return 0
 
 
+def run_health() -> int:
+    """``probe --health``: the k8s liveness/readiness consumer.  One
+    JSON line with the backend supervisor's serving posture (state,
+    degradation reason, probe timestamps) and the warm-restart
+    persistent-cache counters; exit 0 only while the device backend is
+    healthy — a degraded/recovering/poisoned pod still serves correct
+    verdicts (scalar fallback) but reports not-ready so the operator
+    sees the posture, mirroring the reference's status.byPod[]."""
+    import json
+    import time as _time
+
+    from gatekeeper_tpu.resilience.snapshot import restart_report
+    from gatekeeper_tpu.resilience.supervisor import HEALTHY, get_supervisor
+
+    sup = get_supervisor()
+    st = sup.status()
+    rep = restart_report()
+    iso = lambda t: (_time.strftime("%Y-%m-%dT%H:%M:%SZ", _time.gmtime(t))
+                     if t else None)
+    out = {
+        "state": st["state"],
+        "backend": st["backend"],
+        "reason": st["reason"],
+        "since": iso(st["since"]),
+        "last_probe_at": iso(st["last_probe_at"]),
+        "last_ok_at": iso(st["last_ok_at"]),
+        "reprobe_attempts": st["reprobe_attempts"],
+        "restart_persistent_cache_hits":
+            rep["restart_persistent_cache_hits"],
+        "restart_persistent_cache_misses":
+            rep["restart_persistent_cache_misses"],
+    }
+    print(json.dumps(out))
+    if st["state"] != HEALTHY:
+        print(f"HEALTH FAIL ({st['state']}: {st['reason']})")
+        return 2
+    print(f"HEALTH OK ({st['backend']})")
+    return 0
+
+
 def main(argv=None) -> int:
     """``python -m gatekeeper_tpu.client.probe``: self-validate both
     engines (the readiness wiring the reference's Probe exists for).
@@ -507,6 +547,8 @@ def main(argv=None) -> int:
     if "--builtins" in argv:
         print("\n".join(list_builtins()))
         return 0
+    if "--health" in argv:
+        return run_health()
     if "--policyset" in argv:
         return run_policyset()
     if "--cost" in argv:

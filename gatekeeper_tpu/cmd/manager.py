@@ -282,6 +282,15 @@ def run_demo(mgr: Manager, n_namespaces: int = 1000) -> dict:
 
 def main(argv=None) -> int:
     args = parse_args(argv)
+    # warm-restart persistence defaults ON for the managed entry point:
+    # snapshots (lowered IR / dedup plan / store) live next to the XLA
+    # executable cache.  GATEKEEPER_SNAPSHOT_DIR="" disables; tests
+    # constructing Manager directly stay hermetic (no default there).
+    import os as _os
+    if "GATEKEEPER_SNAPSHOT_DIR" not in _os.environ:
+        from gatekeeper_tpu.utils.compile_cache import cache_root
+        _os.environ["GATEKEEPER_SNAPSHOT_DIR"] = \
+            _os.path.join(cache_root(), "snapshots")
     mgr = Manager(args)
     if args.demo:
         out = run_demo(mgr)

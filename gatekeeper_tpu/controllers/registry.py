@@ -69,6 +69,18 @@ class ControlPlane:
                 return total
 
 
+def _template_requeuer(cluster, mgr, template_controller):
+    from gatekeeper_tpu.controllers.runtime import Request
+
+    def _requeue():
+        for obj in cluster.list(TEMPLATE_GVK):
+            meta = obj.get("metadata") or {}
+            mgr.enqueue(template_controller,
+                        Request(name=meta.get("name", ""),
+                                namespace=meta.get("namespace")))
+    return _requeue
+
+
 def add_to_manager(cluster: FakeCluster, client: Client,
                    mgr: ControllerManager | None = None,
                    external_data: ExternalDataRuntime | None = None) \
@@ -104,6 +116,17 @@ def add_to_manager(cluster: FakeCluster, client: Client,
     if served is None or served(PROVIDER_GVK):
         provider_controller = ReconcileProvider(cluster, external_data)
         mgr.watch(PROVIDER_GVK, provider_controller)
+    # backend recovery (resilience/supervisor): re-enqueue every
+    # ConstraintTemplate so the idempotent reconcile re-installs each
+    # template through the driver's warm put_template path — the
+    # controller-runtime answer to "re-jit onto the recovered backend"
+    # (failure recovery is reconcile idempotence).  The manager is held
+    # weakly: test-built control planes don't accumulate in the
+    # process-wide supervisor.
+    from gatekeeper_tpu.resilience.supervisor import get_supervisor
+    mgr._requeue_templates = _template_requeuer(  # type: ignore[attr-defined]
+        cluster, mgr, template_controller)
+    get_supervisor().add_recovery_listener(mgr, "_requeue_templates")
     return ControlPlane(cluster=cluster, client=client, mgr=mgr,
                         watch_manager=wm,
                         constraint_registrar=constraint_registrar,

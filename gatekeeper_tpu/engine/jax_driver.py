@@ -181,11 +181,15 @@ class JaxDriver(LocalDriver):
         # OR hangs must not block construction — the reference's driver
         # always constructs (drivers/local/local.go:28-48), and SURVEY
         # §5 requires CPU fallback on device failure.  scalar_only
-        # routes every evaluation through the scalar oracle (which
-        # never touches jax) for the life of this process.
+        # (a property over the backend supervisor) routes every
+        # evaluation through the scalar oracle, which never touches
+        # jax.  Unlike the old cached bool, the supervisor can bring a
+        # degraded backend home: each dispatch re-consults it, and a
+        # recovery re-jits through _on_backend_recovered.
         from gatekeeper_tpu.utils.device_probe import probe_devices
+        from gatekeeper_tpu.resilience.supervisor import get_supervisor
         res = probe_devices()
-        self.scalar_only = not res.ok
+        self.supervisor = get_supervisor()
         if not res.ok:
             from gatekeeper_tpu.utils.log import logger
             logger("engine").warning(
@@ -194,6 +198,7 @@ class JaxDriver(LocalDriver):
         elif res.n_devices > 1:
             from gatekeeper_tpu.parallel.sharding import make_mesh
             mesh = make_mesh()          # a real failure here should raise
+        self.supervisor.add_recovery_listener(self, "_on_backend_recovered")
         self.executor = ProgramExecutor(mesh=mesh)
         self.metrics = Metrics()
         # serializes reader-side cache fills (bindings/mask delta prep):
@@ -206,6 +211,12 @@ class JaxDriver(LocalDriver):
         # one-shot background churn-delta prewarm after the first sweep
         # (shape changes later recompile lazily on the sweep, as before)
         self._delta_warmed = False
+        # cross-template dedup plan memo: target -> (policyset digest,
+        # plan).  The digest is a pure function of the installed set, so
+        # template/constraint churn invalidates by key mismatch — no
+        # staleness window.  prepare_audit fills it at startup; the
+        # sweep consults it before building.
+        self._dedup_plan_memo: dict = {}
         # per-phase breakdown of the most recent audit sweep (the audit
         # manager copies host_prep_s/h2d_s/device_s/overlap_fraction
         # into its sweep report; phase timings are only measured on
@@ -214,21 +225,92 @@ class JaxDriver(LocalDriver):
 
     # ------------------------------------------------------------------
 
+    @property
+    def scalar_only(self) -> bool:
+        """Is the device path unavailable *right now*?  A property, not
+        a construction-time bool: serving paths re-consult the backend
+        supervisor per dispatch, so a mid-sweep degradation routes the
+        remaining kinds through the scalar oracle and a recovery routes
+        later sweeps back onto the device."""
+        return not self.supervisor.use_device()
+
+    def _on_backend_recovered(self) -> None:
+        """Recovery listener: compiled executables (and uploaded
+        buffers, via the bindings they hang off) may reference the dead
+        backend's client — drop them so the next dispatch re-jits onto
+        the recovered backend.  The XLA persistent cache and the warm
+        IR snapshots make that re-jit cheap."""
+        try:
+            with self._prep_lock:
+                self.executor.reset_for_recovery()
+                for st in self.state.values():
+                    st.bindings_cache.clear()
+                    st.bindings_retired.clear()
+                    st.mask_cache.clear()
+                    st.installed_match.clear()
+                    st.rank_cache = None
+                    st.order_cache = None
+                self._dedup_plan_memo.clear()
+            self.metrics.counter("backend_rejits").inc()
+        except Exception as e:   # noqa: BLE001 — recovery cleanup must
+            from gatekeeper_tpu.utils.log import logger   # never throw
+            logger("engine").warning("post-recovery re-jit reset failed",
+                                     error=e)
+
     def init(self, targets) -> None:
         self.targets = dict(targets)
         for name in targets:
             self.state.setdefault(name, JaxTargetState())
 
     @locked
+    def save_store_snapshot(self, target: str) -> bool:
+        """Persist the target's columnar store (rows + interned string
+        table) for warm restart.  No-op unless GATEKEEPER_SNAPSHOT_DIR
+        is set."""
+        from gatekeeper_tpu.resilience import snapshot as _snap
+        if not _snap.enabled():
+            return False
+        st = self._state(target)
+        return _snap.save_store(target, st.table.snapshot_state())
+
+    @locked
+    def restore_store_snapshot(self, target: str) -> bool:
+        """Warm restart: rebuild the target's columnar store from the
+        on-disk snapshot instead of replaying the full inventory.
+        Only valid on a fresh (empty) store; returns False on miss,
+        disabled persistence, or a non-empty table."""
+        from gatekeeper_tpu.resilience import snapshot as _snap
+        st = self._state(target)
+        if len(st.table) > 0:
+            return False
+        hit = _snap.load_store(target)
+        if hit is None:
+            return False
+        st.table.restore_state(hit[0])
+        return True
+
+    @locked
     def put_template(self, target: str, kind: str, compiled: CompiledTemplate) -> None:
         if compiled.vectorized is None:
-            try:
-                compiled.vectorized = lower_template(compiled.module, compiled.interp)
-            except CannotLower:
-                compiled.vectorized = None  # scalar fallback
-            if compiled.vectorized is not None:
-                compiled.vectorized = self._verify_lowered(
-                    kind, compiled.vectorized)
+            from gatekeeper_tpu.resilience import snapshot as _snap
+            hit = _snap.load_template_ir(kind, target, compiled.source)
+            if hit is not None:
+                # warm restart: lowering AND stage-2 verification are
+                # skipped — the snapshot stores the verified outcome
+                # (possibly None: a known-scalar-only certificate)
+                compiled.vectorized = hit[0]
+                self.metrics.counter("template_ir_snapshot_hits").inc()
+            else:
+                try:
+                    compiled.vectorized = lower_template(
+                        compiled.module, compiled.interp)
+                except CannotLower:
+                    compiled.vectorized = None  # scalar fallback
+                if compiled.vectorized is not None:
+                    compiled.vectorized = self._verify_lowered(
+                        kind, compiled.vectorized)
+                _snap.save_template_ir(kind, target, compiled.source,
+                                       compiled.vectorized)
         st = self._state(target)
         st.templates[kind] = compiled
         st.bump(kind)
@@ -691,6 +773,62 @@ class JaxDriver(LocalDriver):
                                "__rank__": pad_rank(rank, bindings.r_pad)}
             d["_rank_src"] = rank
 
+    def _audit_dedup_plan(self, st, target: str):
+        """The cross-template predicate dedup plan for the currently
+        installed set, or None.  Caller holds ``_prep_lock``.  The plan
+        is a pure function of the installed set — it is memoized by the
+        set digest (churn invalidates by key mismatch) and persisted to
+        the warm-restart snapshot tier, so a restarted pod loads it
+        instead of re-running the whole-policy-set analysis."""
+        try:
+            from gatekeeper_tpu.analysis.policyset import build_dedup_plan
+            dkinds = {}
+            for k in st.templates:
+                cons = self._kind_constraints(st, k)
+                if st.templates[k].vectorized is not None and cons:
+                    dkinds[k] = (st.templates[k].vectorized, cons)
+            if not dkinds:
+                return None
+            import json as _json
+            from gatekeeper_tpu.resilience import snapshot as _snap
+            parts = [
+                f"{k}|"
+                f"{_snap.template_digest(k, target, st.templates[k].source)}|"
+                + _json.dumps(cons, sort_keys=True, default=str)
+                for k, (_, cons) in dkinds.items()]
+            pdigest = _snap.policyset_digest(parts)
+            memo = self._dedup_plan_memo.get(target)
+            if memo is not None and memo[0] == pdigest:
+                return memo[1]
+            hit = _snap.load_dedup_plan(pdigest)
+            if hit is not None:
+                plan = hit[0]
+            else:
+                plan = build_dedup_plan(dkinds)
+                _snap.save_dedup_plan(pdigest, plan)
+            self._dedup_plan_memo[target] = (pdigest, plan)
+            return plan
+        except Exception:
+            # dedup is an optimization; the original programs are
+            # always a valid fallback
+            return None
+
+    @locked_read
+    def prepare_audit(self, target: str) -> bool:
+        """Pre-build the serving structures a full audit sweep needs —
+        today the cross-template dedup plan — so a (re)started pod pays
+        that cost at startup, before declaring itself ready, instead of
+        inside its first sweep.  Warm restarts load the plan from the
+        snapshot tier; cold starts run the analysis here.  Returns True
+        when a plan is ready (False: scalar-only, dedup off, or nothing
+        lowered — the sweep then runs without a plan, as always)."""
+        st = self.state.get(target)
+        if st is None or self.scalar_only \
+                or os.environ.get("GATEKEEPER_DEDUP", "on") == "off":
+            return False
+        with self._prep_lock:
+            return self._audit_dedup_plan(st, target) is not None
+
     @staticmethod
     def _apply_dedup(plan, kind: str, bindings, shared_cols: dict,
                      applied: dict):
@@ -891,24 +1029,23 @@ class JaxDriver(LocalDriver):
                     self._prefetch_axes(st)
                     if full and not self.scalar_only and \
                             os.environ.get("GATEKEEPER_DEDUP", "on") != "off":
-                        try:
-                            from gatekeeper_tpu.analysis.policyset import \
-                                build_dedup_plan
-                            dkinds = {}
-                            for k in st.templates:
-                                cons = self._kind_constraints(st, k)
-                                if st.templates[k].vectorized is not None \
-                                        and cons:
-                                    dkinds[k] = (st.templates[k].vectorized,
-                                                 cons)
-                            if dkinds:
-                                dedup_plan = build_dedup_plan(dkinds)
-                        except Exception:
-                            # dedup is an optimization; the original
-                            # programs are always a valid fallback
-                            dedup_plan = None
+                        dedup_plan = self._audit_dedup_plan(st, target)
                     ph["host_prep_s"] += _time.perf_counter() - _tk
-                    for kind in sorted(st.templates):
+                    _sweep_kinds = sorted(st.templates)
+                    for _kind_i, kind in enumerate(_sweep_kinds):
+                        # fault injection: kill the backend mid-sweep
+                        # (after the first kind when there are several)
+                        # — the scalar_only property re-consults the
+                        # supervisor below, so the remaining kinds
+                        # route through the scalar oracle and the
+                        # sweep completes with correct verdicts
+                        if _kind_i > 0 or len(_sweep_kinds) == 1:
+                            from gatekeeper_tpu.resilience import \
+                                faults as _faults
+                            if _faults.take("device_lost"):
+                                self.supervisor.report_failure(
+                                    "fault injection: device_lost "
+                                    "mid-sweep")
                         _tk = _time.perf_counter()
                         compiled = st.templates[kind]
                         constraints = self._kind_constraints(st, kind)

@@ -706,6 +706,17 @@ class ProgramExecutor:
         # via shard_map (parallel/sharding.py).  None = single device.
         self.mesh = mesh
 
+    def reset_for_recovery(self) -> None:
+        """Drop in-process compiled executables after a backend
+        recovery (resilience/supervisor): cached jitted fns hold the
+        dead backend's client, so the next dispatch must re-trace and
+        re-jit onto the recovered one.  The on-disk persistent cache
+        and the pending upgrade queue survive — only live handles are
+        dropped."""
+        with self._lock:
+            self._cache.clear()
+            self._upgrade_q.clear()
+
     # ------------------------------------------------------------------
     # two-tier compilation
     #

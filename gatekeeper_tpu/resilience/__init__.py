@@ -1,0 +1,26 @@
+"""Runtime resilience: warm-restart persistence + backend supervision.
+
+Two halves (ROADMAP item 5):
+
+- ``snapshot``: versioned on-disk persistence of the expensive
+  startup artifacts — each template's lowered IR, the whole-set dedup
+  plan, and a columnar-store snapshot — keyed by host fingerprint +
+  artifact digest, stored alongside the XLA compilation cache.  A
+  restarted pod skips Rego lowering and cache replication and is
+  serving in seconds (the compiler-first O(1)-caching discipline:
+  persist the compiled artifact, not the source).
+- ``supervisor``: a supervised state machine over the device backend
+  (healthy -> degraded(cpu-fallback) -> recovering -> healthy) that
+  replaces the old one-shot, one-way ``mark_unavailable`` demotion.
+  Serving paths consult the supervisor per dispatch; bounded re-probes
+  with exponential backoff bring a flapped backend home and re-jit the
+  executables onto it.
+- ``faults``: the fault-injection harness
+  (``GATEKEEPER_FAULT=probe_hang|device_lost|snapshot_corrupt``)
+  exercising both halves in tests and CI.
+"""
+
+from gatekeeper_tpu.resilience import faults  # noqa: F401
+from gatekeeper_tpu.resilience.supervisor import (  # noqa: F401
+    DEGRADED, HEALTHY, POISONED, RECOVERING, BackendSupervisor,
+    get_supervisor)

@@ -1,0 +1,56 @@
+"""Fault-injection harness: ``GATEKEEPER_FAULT=<name>[,<name>...]``.
+
+Tests and CI arm faults through the environment; the production code
+consults this module at the exact seams a real failure would hit:
+
+- ``probe_hang``       — the device probe (initial and supervisor
+                         re-probes) parks forever, simulating a
+                         blackholed PJRT tunnel (the round-4 failure).
+- ``device_lost``      — fires ONCE, mid-sweep, demoting the backend
+                         supervisor as if the device died under a
+                         dispatched executable.
+- ``snapshot_corrupt`` — fires ONCE per snapshot read, making the
+                         loader treat the entry as corrupt; exercises
+                         the delete-and-rebuild path.
+
+``active`` faults apply every time they are consulted; ``take`` faults
+are one-shot per process (the set of already-fired names is kept here)
+so a single armed fault produces one discrete failure event rather
+than a permanently broken subsystem.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+_fired: set[str] = set()
+_lock = threading.Lock()
+
+
+def _armed() -> set[str]:
+    spec = os.environ.get("GATEKEEPER_FAULT", "")
+    return {f.strip() for f in spec.split(",") if f.strip()}
+
+
+def active(name: str) -> bool:
+    """Is the fault armed right now?  (Re-reads the env every call so
+    tests can arm/disarm without process restarts.)"""
+    return name in _armed()
+
+
+def take(name: str) -> bool:
+    """One-shot: True exactly once per process while the fault is
+    armed; later calls return False even if it stays armed."""
+    if name not in _armed():
+        return False
+    with _lock:
+        if name in _fired:
+            return False
+        _fired.add(name)
+        return True
+
+
+def reset_for_tests() -> None:
+    with _lock:
+        _fired.clear()

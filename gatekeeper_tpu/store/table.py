@@ -194,6 +194,38 @@ class ResourceTable:
         self._ver = np.full((max(len(new_objs), 16),), self.generation,
                             dtype=np.int64)
 
+    def snapshot_state(self) -> dict:
+        """Plain-data snapshot for warm-restart persistence
+        (resilience/snapshot.py): live rows in row order plus the
+        interned string table, so a restored table reproduces both the
+        row layout and the string ids (device column caches rebuilt
+        from it are bit-identical).  No numpy arrays, no locks — the
+        payload pickles with the stdlib."""
+        entries = []
+        for key, row in sorted(self._rows.items(), key=lambda kv: kv[1]):
+            m = self._metas[row]
+            entries.append((key, self._objs[row],
+                            None if m is None else
+                            (m.api_version, m.kind, m.name, m.namespace)))
+        return {
+            "entries": entries,
+            "strings": list(self.interner._strings),
+            "max_str_len": self.interner.max_str_len,
+        }
+
+    def restore_state(self, state: dict) -> None:
+        """Load a ``snapshot_state()`` payload into this (fresh) table.
+        The interner is seeded first, in saved order, so string ids —
+        and therefore every encoded column — match the snapshotting
+        process exactly."""
+        for s in state.get("strings", ()):
+            self.interner.intern(s)
+        entries = [(key, obj,
+                    ResourceMeta(*meta) if meta is not None else None)
+                   for key, obj, meta in state.get("entries", ())]
+        if entries:
+            self.bulk_upsert(entries)
+
     def dirty_rows_since(self, gen: int) -> np.ndarray:
         """Row indices modified (upserted/tombstoned) after generation
         `gen` — the delta set for every incremental consumer.  Only valid

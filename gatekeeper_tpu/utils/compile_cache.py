@@ -26,6 +26,16 @@ _enabled = False
 _lock = threading.Lock()
 
 
+def cache_root() -> str:
+    """The on-disk root shared by every persistence tier: the XLA
+    executable cache lives under ``<root>/<backend-subdir>``, and the
+    warm-restart snapshots (resilience/snapshot.py) default to
+    ``<root>/snapshots`` when GATEKEEPER_SNAPSHOT_DIR is unset by the
+    embedding application."""
+    return os.environ.get("GATEKEEPER_XLA_CACHE_DIR") \
+        or os.path.join(os.getcwd(), ".gatekeeper_xla_cache")
+
+
 def host_fingerprint() -> str:
     """A short stable fingerprint of THIS host's CPU capabilities.
 
@@ -132,8 +142,7 @@ def enable_persistent_cache(path: str | None = None) -> str:
             _enabled = True
             return ""       # no usable backend: persistence is moot
         backend = res.platform if res.ok else "unknown"
-        root = path or os.environ.get("GATEKEEPER_XLA_CACHE_DIR") \
-            or os.path.join(os.getcwd(), ".gatekeeper_xla_cache")
+        root = path or cache_root()
         path = resolve_cache_path(backend, root)
         _enabled = True
         if path is None:

@@ -66,6 +66,14 @@ _RESULT: ProbeResult | None = None
 _LOCK = threading.Lock()
 
 
+def _fault_probe_hang() -> bool:
+    try:
+        from gatekeeper_tpu.resilience import faults
+        return faults.active("probe_hang")
+    except Exception:   # noqa: BLE001 — probing must never depend on
+        return False    # the fault harness importing cleanly
+
+
 def _timeout_s() -> float:
     try:
         return float(os.environ.get(
@@ -104,8 +112,14 @@ def _probe_locked(timeout_s: float) -> ProbeResult:
 
     def _init():
         try:
-            if os.environ.get("GATEKEEPER_PROBE_TEST_HANG") == "1":
+            if (os.environ.get("GATEKEEPER_PROBE_TEST_HANG") == "1"
+                    or _fault_probe_hang()):
                 time.sleep(3600)    # simulated dead tunnel
+            if os.environ.get("GATEKEEPER_PROBE_TEST_FAIL") == "1":
+                # simulated transient init error: fails WITHOUT
+                # poisoning, so reprobe()/bench retry loops engage
+                raise RuntimeError("simulated transient backend "
+                                   "init failure (test hook)")
             import jax
             # a JAX_PLATFORMS env var does NOT reliably stick: PJRT
             # plugins re-assert themselves during import, so a process
@@ -167,19 +181,52 @@ def mark_unavailable(reason: str) -> None:
     (not the probe) discovered the backend hangs or died.  Every driver
     constructed from now on serves scalar-only, and children get pinned
     to cpu via child_env().  One-way: a dead tunnel does not come back
-    for this process (its in-flight op is still stuck)."""
+    for this process (its in-flight op is still stuck) — this routes to
+    the backend supervisor as a *poisoned* (terminal) failure.  For a
+    recoverable degradation, call
+    ``resilience.supervisor.get_supervisor().report_failure(reason)``
+    instead: that path re-probes with backoff and can return to
+    healthy."""
+    from gatekeeper_tpu.resilience.supervisor import get_supervisor
+    get_supervisor().report_failure(reason, poisoned=True)
+
+
+def _install_result(res: ProbeResult) -> None:
+    """Supervisor-owned verdict transitions (degrade/recover) land
+    here so probe_devices()/child_env() stay coherent with supervisor
+    state.  Not for general use."""
     global _RESULT
     with _LOCK:
-        _RESULT = ProbeResult(False, 0, "", True, reason)
-    os.environ["JAX_PLATFORMS"] = "cpu"
+        _RESULT = res
+
+
+def reprobe(timeout_s: float | None = None) -> ProbeResult:
+    """Drop a *non-poisoned* failed verdict and probe again (bench's
+    bounded retry loop).  An ok or poisoned verdict is returned as-is:
+    success needs no retry, and a poisoned process must never re-enter
+    backend init — the hung thread may still hold jax's init lock."""
+    global _RESULT
+    with _LOCK:
+        r = _RESULT
+        if r is not None and (r.ok or r.poisoned):
+            return r
+        _RESULT = None
+    return probe_devices(timeout_s)
 
 
 def reset_for_tests() -> None:
     """Drop the cached verdict (tests only — a real process's verdict
-    is immutable because a jax backend initializes once)."""
+    is immutable because a jax backend initializes once).  Also drops
+    the backend supervisor singleton, which is seeded from it."""
     global _RESULT
     with _LOCK:
         _RESULT = None
+    try:
+        from gatekeeper_tpu.resilience import faults, supervisor
+        supervisor.reset_for_tests()
+        faults.reset_for_tests()
+    except Exception:   # noqa: BLE001 — reset must stay usable even if
+        pass            # the resilience package is mid-import
 
 
 def child_env(base: dict | None = None) -> dict:
@@ -191,4 +238,5 @@ def child_env(base: dict | None = None) -> dict:
     if r is not None and not r.ok:
         env["JAX_PLATFORMS"] = "cpu"
         env.pop("GATEKEEPER_PROBE_TEST_HANG", None)
+        env.pop("GATEKEEPER_PROBE_TEST_FAIL", None)
     return env

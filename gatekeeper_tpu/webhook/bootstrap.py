@@ -140,15 +140,59 @@ def bootstrap_webhook(cluster, cert_dir: str, port: int,
             _apply(cluster, {
                 "apiVersion": "admissionregistration.k8s.io/v1",
                 "kind": "ValidatingWebhookConfiguration",
-                "metadata": {"name": webhook_name},
+                "metadata": {"name": webhook_name,
+                             "annotations": _backend_annotations()},
                 "webhooks": [{**hook, "sideEffects": "None",
                               "admissionReviewVersions": ["v1", "v1beta1"]}]})
         except NotFoundError:
             _apply(cluster, {
                 "apiVersion": "admissionregistration.k8s.io/v1beta1",
                 "kind": "ValidatingWebhookConfiguration",
-                "metadata": {"name": webhook_name},
+                "metadata": {"name": webhook_name,
+                             "annotations": _backend_annotations()},
                 "webhooks": [hook]})
     except ApiError:
         return False        # registration kinds not served: manual deploy
+    _watch_backend_recovery(cluster, webhook_name)
     return True
+
+
+def _backend_annotations() -> dict:
+    """Serving-posture annotations on the VWC — the operator-visible
+    analogue of the reference's ``status.byPod[]`` report (BASELINE.md):
+    failurePolicy stays Ignore either way (a degraded webhook serves
+    correct verdicts from the scalar fallback; it never fails closed),
+    but the annotations say which engine answers admissions right now."""
+    from gatekeeper_tpu.resilience.supervisor import get_supervisor
+    sup = get_supervisor()
+    st = sup.status()
+    ann = {"gatekeeper.sh/backend-state": st["state"],
+           "gatekeeper.sh/backend": st["backend"]}
+    if st["state"] != "healthy" and st["reason"]:
+        ann["gatekeeper.sh/backend-reason"] = st["reason"][:256]
+    return ann
+
+
+def _watch_backend_recovery(cluster, webhook_name: str) -> None:
+    """Refresh the VWC's backend annotations when the supervisor
+    transitions back to healthy, so the operating report recovers with
+    the backend."""
+    from gatekeeper_tpu.resilience.supervisor import get_supervisor
+
+    def _refresh():
+        for api in ("admissionregistration.k8s.io/v1",
+                    "admissionregistration.k8s.io/v1beta1"):
+            try:
+                gvk = GVK.from_api_version(
+                    api, "ValidatingWebhookConfiguration")
+                obj = cluster.get(gvk, webhook_name)
+                meta = obj.setdefault("metadata", {})
+                ann = meta.setdefault("annotations", {})
+                ann.pop("gatekeeper.sh/backend-reason", None)
+                ann.update(_backend_annotations())
+                cluster.update(obj)
+                return
+            except ApiError:
+                continue
+
+    get_supervisor().on_recovery(_refresh)

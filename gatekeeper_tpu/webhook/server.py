@@ -110,11 +110,45 @@ class WebhookServer:
 
             def do_GET(self):
                 """GET /metrics — Prometheus text exposition of the
-                shared registry (audit/admission/device counters)."""
+                shared registry (audit/admission/device counters) plus
+                the backend supervisor's gauges.  GET /healthz — the
+                supervisor's serving posture as JSON: 200 while the
+                device backend is healthy, 503 when degraded/poisoned
+                (admissions still serve, via the scalar fallback — the
+                status code is for k8s readiness, which maps to the
+                reference's failurePolicy escape hatch; BASELINE.md)."""
+                if self.path == "/healthz":
+                    import json as _json
+                    from gatekeeper_tpu.resilience.supervisor import (
+                        HEALTHY, get_supervisor)
+                    from gatekeeper_tpu.resilience.snapshot import \
+                        restart_report
+                    sup = get_supervisor()
+                    body = dict(sup.status())
+                    rep = restart_report()
+                    body["restart_persistent_cache_hits"] = \
+                        rep["restart_persistent_cache_hits"]
+                    body["restart_persistent_cache_misses"] = \
+                        rep["restart_persistent_cache_misses"]
+                    payload = _json.dumps(body).encode()
+                    self.send_response(
+                        200 if body["state"] == HEALTHY else 503)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(payload)))
+                    self.end_headers()
+                    self.wfile.write(payload)
+                    return
                 if self.path != "/metrics":
                     self.send_error(404)
                     return
-                payload = outer.metrics.render_prometheus().encode()
+                text = outer.metrics.render_prometheus()
+                try:
+                    from gatekeeper_tpu.resilience.supervisor import \
+                        get_supervisor
+                    text += get_supervisor().metrics.render_prometheus()
+                except Exception:   # noqa: BLE001 — metrics must render
+                    pass            # even if the supervisor can't seed
+                payload = text.encode()
                 self.send_response(200)
                 self.send_header("Content-Type",
                                  "text/plain; version=0.0.4")

@@ -1,0 +1,91 @@
+"""Two-process warm restart: the acceptance test for the snapshot tier.
+
+Runs ``python -m gatekeeper_tpu.resilience.smoke`` twice against the
+same snapshot directory, as ci.sh's restart-smoke stage does, but from
+pytest and without the wall-clock ratio assert (timing belongs to the
+dedicated CI stage where the machine is quiescent; correctness —
+hits > 0, zero re-lowering, restored store, bit-identical verdicts —
+belongs here).  A third scenario vandalizes every snapshot entry on
+disk and requires the restarted process to rebuild cold rather than
+crash.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _smoke(snapdir: str, n: int = 120) -> dict:
+    env = {**os.environ,
+           "JAX_PLATFORMS": "cpu",
+           "GATEKEEPER_SNAPSHOT_DIR": snapdir,
+           "GATEKEEPER_SMOKE_N": str(n)}
+    for var in ("GATEKEEPER_FAULT", "GATEKEEPER_PROBE_TEST_HANG",
+                "GATEKEEPER_PROBE_TEST_FAIL"):
+        env.pop(var, None)
+    out = subprocess.run(
+        [sys.executable, "-m", "gatekeeper_tpu.resilience.smoke"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=600)
+    assert out.returncode == 0, out.stderr[-3000:]
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def _snap_files(snapdir: str) -> list[str]:
+    found = []
+    for root, _dirs, files in os.walk(snapdir):
+        found.extend(os.path.join(root, f)
+                     for f in files if f.endswith(".snap"))
+    return sorted(found)
+
+
+def test_two_process_warm_restart(tmp_path):
+    snapdir = str(tmp_path)
+    cold = _smoke(snapdir)
+    warm = _smoke(snapdir)
+
+    # the cold process did the real work and persisted it
+    assert cold["lowerings"] > 0
+    assert cold["store_restored"] is False
+    assert _snap_files(snapdir), "cold run wrote no snapshot entries"
+
+    # the warm process reused it: no Rego re-lowering, restored store,
+    # nonzero restart counter — and bit-identical verdicts
+    assert warm["restart_persistent_cache_hits"] > 0
+    assert warm["restart_persistent_cache_misses"] == 0
+    assert warm["lowerings"] == 0
+    assert warm["store_restored"] is True
+    assert warm["templates"] == cold["templates"]
+    assert warm["n_rows"] == cold["n_rows"]
+    assert warm["n_results"] == cold["n_results"]
+    assert warm["verdict_digest"] == cold["verdict_digest"]
+
+
+def test_corrupted_snapshot_dir_rebuilds_cold(tmp_path):
+    snapdir = str(tmp_path)
+    cold = _smoke(snapdir)
+    files = _snap_files(snapdir)
+    assert files
+    # vandalize EVERY entry: truncate half of them, garbage the rest
+    for i, path in enumerate(files):
+        with open(path, "rb") as f:
+            raw = f.read()
+        with open(path, "wb") as f:
+            f.write(raw[:len(raw) // 2] if i % 2 else b"\x00garbage\xff")
+
+    # the restarted process must never crash on corruption: every bad
+    # entry is discarded and rebuilt on the cold path, and the verdicts
+    # still come out bit-identical
+    warm = _smoke(snapdir)
+    assert warm["lowerings"] == cold["lowerings"]   # rebuilt, not reused
+    assert warm["store_restored"] is False
+    assert warm["verdict_digest"] == cold["verdict_digest"]
+
+    # ...and the rebuild re-persisted good entries: a third process is
+    # warm again
+    warm2 = _smoke(snapdir)
+    assert warm2["lowerings"] == 0
+    assert warm2["restart_persistent_cache_hits"] > 0
+    assert warm2["verdict_digest"] == cold["verdict_digest"]
