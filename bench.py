@@ -190,6 +190,13 @@ def _slim_headline() -> dict:
                              "subprograms_shared", "evaluations_saved",
                              "dedup_parity")
                             if an.get(k) is not None}
+    tv = DETAIL.get("transval")
+    if isinstance(tv, dict):
+        slim["transval"] = {k: tv.get(k) for k in
+                            ("certify_wall_seconds",
+                             "templates_certified", "counterexamples",
+                             "models_checked")
+                            if tv.get(k) is not None}
     if DETAIL.get("aborted"):
         slim["aborted"] = DETAIL["aborted"]
     return slim
@@ -1015,6 +1022,52 @@ def bench_analysis(detail):
         f"evaluations saved | parity={parity}")
 
 
+def bench_transval(detail):
+    """Stage-4 translation validation at library scale: certify every
+    device-lowered built-in template against the interpreter on its
+    bounded small-model universe.  The whole library must certify
+    (0 counterexamples) and the pass must stay well inside the 60s
+    budget ci.sh gives the certify stage — it runs at install time."""
+    from gatekeeper_tpu.analysis import transval
+    from gatekeeper_tpu.api.templates import compile_target_rego
+    from gatekeeper_tpu.ir.lower import CannotLower, lower_template
+    from gatekeeper_tpu.library import all_docs
+
+    t0 = time.perf_counter()
+    n_cert = n_pin = n_ce = models = 0
+    for tdoc, cdoc in all_docs():
+        kind = ((tdoc.get("spec") or {}).get("crd") or {}) \
+            .get("spec", {}).get("names", {}).get("kind") \
+            or tdoc.get("metadata", {}).get("name", "?")
+        tt = ((tdoc.get("spec") or {}).get("targets") or [{}])[0]
+        compiled = compile_target_rego(
+            kind, tt.get("target") or "", tt.get("rego") or "")
+        try:
+            lowered = lower_template(compiled.module, compiled.interp)
+        except CannotLower:
+            n_pin += 1
+            continue
+        res = transval.validate_template(kind, compiled, lowered, [cdoc])
+        if isinstance(res, transval.Certificate):
+            n_cert += 1
+            models += res.models_checked
+        else:
+            n_ce += 1
+    wall = time.perf_counter() - t0
+    detail["transval"] = {
+        "certify_wall_seconds": round(wall, 3),
+        "templates_certified": n_cert,
+        "templates_pinned": n_pin,
+        "counterexamples": n_ce,
+        "models_checked": models,
+    }
+    log(f"[transval] {n_cert} certified, {n_pin} pinned, {n_ce} "
+        f"counterexample(s), {models} models in {wall*1e3:.0f}ms")
+    if n_ce:
+        raise AssertionError(
+            f"{n_ce} library template(s) failed translation validation")
+
+
 def bench_selector_heavy(detail):
     """namespaceSelector-heavy matching at 100k namespaces: the
     namespace-axis selector evaluation is the cost center (VERDICT r2
@@ -1493,6 +1546,8 @@ def main():
     run_phase("external_data", bench_external_data, 300)
     quiesce_upgrades()
     run_phase("analysis", bench_analysis, 300)
+    quiesce_upgrades()
+    run_phase("transval", bench_transval, 240)
     quiesce_upgrades()
     run_phase("regex_heavy", bench_regex_heavy, 300)
     run_phase("selector_heavy", bench_selector_heavy, 300)

@@ -20,6 +20,18 @@ python -m gatekeeper_tpu.analysis.selflint gatekeeper_tpu/engine gatekeeper_tpu/
 # control-plane code
 python -m gatekeeper_tpu.analysis.selflint --locks gatekeeper_tpu/watch gatekeeper_tpu/controllers gatekeeper_tpu/externaldata
 
+echo "== certify (translation validation over the library) =="
+# Stage-4 translation validation: bounded-model Rego<->IR equivalence
+# over every library template.  Every device-lowered template must
+# certify (0 counterexamples); the whole stage gets a 60s cpu budget.
+CERT=$(JAX_PLATFORMS=cpu timeout -k 10 60 \
+       python -m gatekeeper_tpu.client.probe --certify --library | tail -5)
+echo "$CERT"
+echo "$CERT" | grep -q " 0 counterexample(s)" \
+  || { echo "certify stage found counterexamples" >&2; exit 1; }
+echo "$CERT" | grep -Eq "[1-9][0-9]* certified" \
+  || { echo "certify stage certified nothing" >&2; exit 1; }
+
 echo "== tests (virtual 8-device CPU mesh) =="
 python -m pytest tests/ -q
 
@@ -52,6 +64,10 @@ warm = json.loads(os.environ["WARM"])
 assert warm["restart_persistent_cache_hits"] > 0, \
     f"warm run reused nothing: {warm}"
 assert warm["lowerings"] == 0, f"warm run re-lowered Rego: {warm}"
+assert warm["validations"] == 0, \
+    f"warm run re-ran translation validation: {warm}"
+assert cold["validations"] > 0, \
+    f"cold run never validated (transval off?): {cold}"
 assert warm["store_restored"] is True, f"store not restored: {warm}"
 assert warm["verdict_digest"] == cold["verdict_digest"], \
     f"verdicts diverged: cold {cold['verdict_digest']} " \

@@ -12,6 +12,12 @@ Stage 3 (:mod:`.costmodel` + :mod:`.policyset`) analyzes the *set* of
 installed policies: static per-program cost vectors with budget
 admission, cross-template predicate dedup feeding the audit sweep, and
 match shadowing/unreachability — ``cost_*`` / ``set_*`` findings.
+
+Stage 4 (:mod:`.transval` + :mod:`.smallmodel`) is translation
+validation: a bounded-model equivalence check of every lowered program
+against the interpreter semantics, emitting a Certificate (persisted
+through the warm-restart snapshot) or a minimal Counterexample that
+joins the ``tests/corpus/transval/`` regression corpus.
 """
 
 from gatekeeper_tpu.analysis.diagnostics import (   # noqa: F401
@@ -28,4 +34,7 @@ from gatekeeper_tpu.analysis.costmodel import (   # noqa: F401
 from gatekeeper_tpu.analysis.policyset import (   # noqa: F401
     analyze_policy_set, build_dedup_plan, constraint_set_warnings,
     duplicate_predicate_warnings, eval_shared_host, vet_template_cost,
+)
+from gatekeeper_tpu.analysis.transval import (    # noqa: F401
+    Certificate, Counterexample, certify, replay_case, validate_template,
 )

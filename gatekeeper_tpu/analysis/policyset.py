@@ -73,6 +73,24 @@ _SHAREABLE_OPS = frozenset({
 _SIMPLE_SCALARS = (str, int, float, bool, bytes, type(None))
 
 
+def _stable_repr(v) -> str:
+    """repr() with container contents in sorted order.  Canonical
+    digests feed sha1 — a frozenset-valued cval (encoded membership
+    set) must hash identically under every PYTHONHASHSEED, but
+    ``repr(frozenset(...))`` follows hash-table order."""
+    if isinstance(v, (set, frozenset)):
+        return "{" + ", ".join(sorted(_stable_repr(x) for x in v)) + "}"
+    if isinstance(v, dict):
+        items = sorted((_stable_repr(k), _stable_repr(x))
+                       for k, x in v.items())
+        return "{" + ", ".join(f"{k}: {x}" for k, x in items) + "}"
+    if isinstance(v, tuple):
+        return "(" + ", ".join(_stable_repr(x) for x in v) + ",)"
+    if isinstance(v, list):
+        return "[" + ", ".join(_stable_repr(x) for x in v) + "]"
+    return repr(v)
+
+
 def _fn_fingerprint(fn) -> tuple | None:
     """Structural identity of a host-table fn: code object + closure
     cells + defaults, admitted only when every captured value is a
@@ -141,7 +159,7 @@ class _Canon:
         if v0 is None or any(type(v) is not type(v0) or v != v0
                              for v in vals[1:]):
             raise _Unshareable()
-        return ("cconst", cv.kind, repr(v0))
+        return ("cconst", cv.kind, _stable_repr(v0))
 
     def _canon(self, n: Node) -> tuple:
         op = n.op
@@ -149,7 +167,8 @@ class _Canon:
             raise _Unshareable()
         if op == "const":
             value, dtype = n.meta
-            return (("const", repr(value), dtype), False, frozenset(), 0)
+            return (("const", _stable_repr(value), dtype), False,
+                    frozenset(), 0)
         if op == "input":
             name, kind = n.meta
             axis_char = kind[0]

@@ -24,6 +24,13 @@ Attribute calls (``self._raw``) cannot be resolved statically and are
 skipped — the closure rule above covers the real call graph of the
 engine, where jitted entry points reach helpers by name.
 
+The same walk also enforces the NONDETERMINISM rule inside kernel-side
+functions: clocks (``time.monotonic``/``perf_counter``), RNG calls
+(``random.*``, ``np.random.*``), ``uuid.uuid4``, and ``for``-loops over
+un-sorted set expressions (hash-order iteration) are flagged — any of
+these makes the traced program, and every digest or certificate derived
+from it, vary run to run.
+
 Exit status: number of findings (0 = clean).  Wired as the ci.sh lint
 stage over ``gatekeeper_tpu/engine`` and ``gatekeeper_tpu/ir``.
 
@@ -53,6 +60,18 @@ _FORBIDDEN_QUALIFIED = {
     ("time", "time"),
 }
 
+
+# nondeterminism rule set: any call into these modules inside a
+# kernel-side function bakes a per-trace value into the compiled
+# artifact (clocks, RNG state) — recompiles stop being reproducible and
+# cached executables/certificates stop being trustworthy.  time.time is
+# already in _FORBIDDEN_QUALIFIED; these cover whole module surfaces
+# (random.random, random.choice, np.random.uniform, ...).
+_NONDET_MODULE_PREFIXES = (
+    ("random",), ("np", "random"), ("numpy", "random"), ("onp", "random"),
+)
+_NONDET_QUALIFIED = {("time", "monotonic"), ("time", "perf_counter"),
+                     ("uuid", "uuid4")}
 
 # lock-discipline rule set (--locks): calls that block the calling
 # thread on I/O, a timer, or another thread's completion
@@ -164,10 +183,31 @@ def _kernel_roots(tree: ast.Module) -> list[ast.AST]:
     return roots
 
 
+def _is_unordered_set_expr(node: ast.AST) -> bool:
+    """Set literal / comprehension / bare set()-frozenset() call — an
+    expression whose iteration order follows the process hash seed."""
+    if isinstance(node, (ast.Set, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Call):
+        d = _dotted(node.func)
+        return d in (("set",), ("frozenset",))
+    return False
+
+
 def _lint_tree(tree: ast.Module, path: str) -> list[str]:
     findings: list[str] = []
     for root in _kernel_roots(tree):
         for sub in ast.walk(root):
+            if isinstance(sub, (ast.For, ast.AsyncFor)):
+                # hash-order iteration: the loop body's trace order (and
+                # therefore the compiled program / any digest derived
+                # from it) varies with PYTHONHASHSEED
+                if _is_unordered_set_expr(sub.iter):
+                    findings.append(
+                        f"{path}:{sub.lineno}: iteration over un-sorted "
+                        f"set inside kernel-side function {root.name!r} "
+                        f"(wrap in sorted(...))")
+                continue
             if not isinstance(sub, ast.Call):
                 continue
             if isinstance(sub.func, ast.Attribute) \
@@ -177,11 +217,26 @@ def _lint_tree(tree: ast.Module, path: str) -> list[str]:
                     f"kernel-side function {root.name!r}")
                 continue
             d = _dotted(sub.func)
-            if d is not None and len(d) == 2 \
-                    and (d[0], d[1]) in _FORBIDDEN_QUALIFIED:
+            if d is None:
+                continue
+            if len(d) == 2 and (d[0], d[1]) in _FORBIDDEN_QUALIFIED:
                 findings.append(
                     f"{path}:{sub.lineno}: {d[0]}.{d[1]}() inside "
                     f"kernel-side function {root.name!r}")
+                continue
+            if d in _NONDET_QUALIFIED:
+                findings.append(
+                    f"{path}:{sub.lineno}: nondeterministic "
+                    f"{'.'.join(d)}() inside kernel-side function "
+                    f"{root.name!r}")
+                continue
+            for prefix in _NONDET_MODULE_PREFIXES:
+                if len(d) > len(prefix) and d[:len(prefix)] == prefix:
+                    findings.append(
+                        f"{path}:{sub.lineno}: nondeterministic "
+                        f"{'.'.join(d)}() inside kernel-side function "
+                        f"{root.name!r}")
+                    break
     return findings
 
 

@@ -347,12 +347,22 @@ def _scalar_fallback_pins() -> set:
             if isinstance(v, str) and v.startswith("scalar-fallback")}
 
 
+def _severity_rc(n_err: int, n_warn: int) -> int:
+    """The analysis-subcommand exit contract, shared by --lint /
+    --policyset / --cost / --certify: 0 clean, 1 warning-severity
+    findings only, 2 any error-severity finding (or unreadable
+    input)."""
+    return 2 if n_err else (1 if n_warn else 0)
+
+
 def run_lint(paths: list[str], use_library: bool = False,
              strict: bool = False) -> int:
-    """``--lint``: print diagnostics with locations; exit 1 iff any
-    error-severity finding, 2 on unreadable input.  ``--strict``
-    escalates warnings to failures too — except a pinned kind's
-    ``rego_not_vectorizable`` (see :func:`_scalar_fallback_pins`)."""
+    """``--lint``: print diagnostics with locations.  Exit contract
+    (:func:`_severity_rc`): 2 on any error-severity finding or
+    unreadable input, 1 on warnings-that-matter (``--strict``
+    escalates warnings, except a pinned kind's
+    ``rego_not_vectorizable`` — see :func:`_scalar_fallback_pins`),
+    0 clean."""
     import yaml
     docs: list[tuple[str, dict]] = []
     for p in paths:
@@ -383,7 +393,7 @@ def run_lint(paths: list[str], use_library: bool = False,
                 n_warn += 1
     tail = f", {n_warn} unpinned warning(s)" if strict else ""
     print(f"lint: {len(docs)} template(s), {n_err} error(s){tail}")
-    return 1 if (n_err or n_warn) else 0
+    return _severity_rc(n_err, n_warn)
 
 
 def _library_entries() -> list:
@@ -433,7 +443,9 @@ def run_policyset() -> int:
     print(f"policyset: {len(entries)} template(s) ({n_vec} lowered), "
           f"{len(groups)} shared subprogram group(s), "
           f"{len(report['findings'])} finding(s)")
-    return 0
+    n_err = sum(1 for d in report["findings"] if d.severity == "error")
+    n_warn = sum(1 for d in report["findings"] if d.severity != "error")
+    return _severity_rc(n_err, n_warn)
 
 
 def run_cost() -> int:
@@ -472,11 +484,26 @@ def run_cost() -> int:
         finally:
             jd_mod.SMALL_WORKLOAD_EVALS = saved
         measured = (jd.last_sweep_phases or {}).get("device_s")
+    # the exit contract's warning tier: templates over the configured
+    # install-time unit budget (the same knob the reconciler gate uses)
+    budget_env = _os.environ.get("GATEKEEPER_COST_BUDGET_UNITS")
+    n_over = 0
+    if budget_env:
+        try:
+            budget_units = float(budget_env)
+        except ValueError:
+            budget_units = None
+        if budget_units is not None:
+            for kind, u in sorted(units.items()):
+                if u > budget_units:
+                    n_over += 1
+                    print(f"  over-budget {kind}: {u:.3g} units "
+                          f"> {budget_units:.3g}")
     if measured is None or total_units <= 0:
         print(f"cost: {len(units)} lowered template(s), "
               f"{total_units:.3g} units at n={n}; no device measurement "
               "(scalar-only backend)")
-        return 0
+        return _severity_rc(0, n_over)
     scale = costmodel.calibrate([(total_units, measured)])
     for kind in sorted(units, key=lambda k: -units[k]):
         pred = costmodel.predict_seconds(units[kind], scale)
@@ -485,7 +512,103 @@ def run_cost() -> int:
     print(f"cost: n={n}, measured device_s={measured:.4f}, "
           f"predicted total={costmodel.predict_seconds(total_units, scale):.4f} "
           f"(scale={scale:.3e} s/unit, {len(units)} templates)")
-    return 0
+    return _severity_rc(0, n_over)
+
+
+def run_certify(paths: list[str], use_library: bool = False) -> int:
+    """``--certify``: Stage-4 translation validation
+    (analysis/transval.py) over template files and/or the built-in
+    library.  Each device-lowered template is checked against the
+    interpreter on its bounded small-model universe; scalar-fallback
+    templates are reported as pinned (there is no device program to
+    certify).  Exit contract (:func:`_severity_rc`): 2 on any
+    counterexample or unloadable input, 1 if every lowered template
+    certified but some universe was truncated by the model budget,
+    0 fully certified.
+
+    GATEKEEPER_TRANSVAL_CORPUS=<dir> additionally serializes every
+    counterexample found into the regression corpus directory
+    (tests/corpus/transval/ replays them first in the parity suite)."""
+    import os as _os
+    import sys
+    import time as _time
+
+    import yaml
+
+    from gatekeeper_tpu.analysis import transval
+    from gatekeeper_tpu.api.templates import compile_target_rego
+    from gatekeeper_tpu.ir.lower import CannotLower, lower_template
+
+    work: list[tuple[str, dict, list]] = []
+    for p in paths:
+        try:
+            with open(p, encoding="utf-8") as fh:
+                loaded = list(yaml.safe_load_all(fh))
+        except (OSError, yaml.YAMLError) as e:
+            print(f"{p}: cannot load: {e}", file=sys.stderr)
+            return 2
+        work.extend((p, d, []) for d in loaded
+                    if isinstance(d, dict)
+                    and d.get("kind") == "ConstraintTemplate")
+    if use_library:
+        from gatekeeper_tpu.library import all_docs
+        work.extend(("<library>", tdoc, [cdoc])
+                    for tdoc, cdoc in all_docs())
+    corpus_dir = _os.environ.get("GATEKEEPER_TRANSVAL_CORPUS")
+    t0 = _time.perf_counter()
+    n_cert = n_pin = n_ce = n_err = n_trunc = models = 0
+    for label, tdoc, cdocs in work:
+        kind = _doc_kind(tdoc)
+        compiled = lowered = None
+        for tt in ((tdoc.get("spec") or {}).get("targets") or ()):
+            try:
+                compiled = compile_target_rego(
+                    kind, tt.get("target") or "", tt.get("rego") or "")
+                lowered = lower_template(compiled.module, compiled.interp)
+            except CannotLower:
+                lowered = None
+            except Exception as e:      # noqa: BLE001 — parse/compile
+                n_err += 1
+                print(f"  FAIL {kind}: {type(e).__name__}: {e}",
+                      file=sys.stderr)
+                compiled = None
+            break
+        if compiled is None:
+            continue
+        if lowered is None:
+            n_pin += 1
+            print(f"  pin  {kind}: scalar fallback (no device program)")
+            continue
+        lowered = transval.maybe_miscompiled(kind, lowered)
+        try:
+            result = transval.validate_template(
+                kind, compiled, lowered=lowered,
+                constraints=cdocs or None)
+        except Exception as e:          # noqa: BLE001
+            n_err += 1
+            print(f"  FAIL {kind}: validator error: {e}", file=sys.stderr)
+            continue
+        if isinstance(result, transval.Certificate):
+            n_cert += 1
+            models += result.models_checked
+            n_trunc += 1 if result.truncated else 0
+            excused = result.excused_f32 + result.excused_mixed
+            print(f"  ok   {kind}: certified "
+                  f"({result.models_checked} models, fp={result.fp_models}"
+                  + (f", excused={excused}" if excused else "") + ")")
+        else:
+            n_ce += 1
+            print(f"  FAIL {kind}: counterexample ({result.note}) "
+                  f"expected={result.expected} actual={result.actual}",
+                  file=sys.stderr)
+            if corpus_dir:
+                print(f"       saved: "
+                      f"{transval.save_counterexample(corpus_dir, result)}")
+    wall = _time.perf_counter() - t0
+    print(f"certify: {len(work)} template(s), {n_cert} certified, "
+          f"{n_pin} pinned, {n_ce} counterexample(s), "
+          f"{models} models in {wall:.1f}s")
+    return _severity_rc(n_ce + n_err, n_trunc)
 
 
 def run_health() -> int:
@@ -533,7 +656,9 @@ def main(argv=None) -> int:
     engines (the readiness wiring the reference's Probe exists for).
     ``--builtins`` lists the builtin registry instead of probing;
     ``--lint <template.yaml>... [--library]`` runs the static-analysis
-    pass instead, exiting non-zero iff any error-severity finding.
+    pass and ``--certify`` the Stage-4 translation validator instead;
+    analysis subcommands share one exit contract: 0 clean, 1 warnings
+    only, 2 any error-severity finding or unreadable input.
 
     The verdict line names the backend that actually served the [jax]
     scenarios: with a dead/unreachable device the driver falls back to
@@ -553,6 +678,9 @@ def main(argv=None) -> int:
         return run_policyset()
     if "--cost" in argv:
         return run_cost()
+    if "--certify" in argv:
+        rest = [a for a in argv if a not in ("--certify", "--library")]
+        return run_certify(rest, use_library="--library" in argv)
     if "--lint" in argv:
         rest = [a for a in argv
                 if a not in ("--lint", "--library", "--strict")]

@@ -187,6 +187,7 @@ class ReconcileConstraintTemplate(Reconciler):
                 return result
         if not self._add_template(instance):
             return DONE
+        self._transval_status(instance)
         self.watcher.add_watch(make_constraint_gvk(_template_kind(instance)))
         try:
             crd_create(self.cluster, crd)
@@ -202,6 +203,7 @@ class ReconcileConstraintTemplate(Reconciler):
         engine may have restarted and needs code re-loaded)."""
         if not self._add_template(instance):
             return DONE
+        self._transval_status(instance)
         self.watcher.add_watch(make_constraint_gvk(_template_kind(instance)))
         if found.get("apiVersion") == "apiextensions.k8s.io/v1":
             # compare/update in the stored object's shape, not ours
@@ -307,6 +309,30 @@ class ReconcileConstraintTemplate(Reconciler):
         if metrics is not None:
             cv = costmodel.estimate(lowered, costmodel.REF_ROWS, 1)
             metrics.gauge(f"template_cost_units_{kind}").set(cv.units())
+
+    def _transval_status(self, instance: dict) -> None:
+        """Stage-4 surface: when strict translation validation
+        (GATEKEEPER_TRANSVAL=strict, analysis/transval.py) found a
+        counterexample during AddTemplate, the engine already pinned
+        the template to the scalar fallback; record
+        ``translation_unvalidated`` in ``status.byPod[].errors`` so the
+        operator sees *why* the device path is off.  Unlike VetError
+        this does not reject — the scalar oracle serves the template
+        with reference semantics."""
+        from gatekeeper_tpu.analysis import transval
+        if transval.mode() != "strict":
+            return
+        ce = transval.failure_for(_template_kind(instance))
+        if ce is None:
+            return
+        status = get_ha_status(instance)
+        status.setdefault("errors", []).append(
+            {"code": "translation_unvalidated",
+             "message": (f"lowered program failed translation validation "
+                         f"({ce.note}; oracle={ce.expected} "
+                         f"device={ce.actual}); pinned to the scalar "
+                         "fallback")})
+        set_ha_status(instance, status)
 
     @staticmethod
     def _lower_instance(instance: dict):

@@ -311,9 +311,49 @@ class JaxDriver(LocalDriver):
                         kind, compiled.vectorized)
                 _snap.save_template_ir(kind, target, compiled.source,
                                        compiled.vectorized)
+            # stage 4 runs on BOTH paths: the cert snapshot tier (not
+            # the IR tier) is what makes the warm restart skip it
+            if compiled.vectorized is not None:
+                compiled.vectorized = self._certify_lowered(kind, compiled)
         st = self._state(target)
         st.templates[kind] = compiled
         st.bump(kind)
+
+    def _certify_lowered(self, kind: str, compiled: CompiledTemplate):
+        """Stage-4 translation validation (analysis/transval.py) behind
+        GATEKEEPER_TRANSVAL=off|warn|strict.  strict: a counterexample
+        pins the template to the scalar oracle exactly like CannotLower
+        (and the reconciler surfaces `translation_unvalidated`); warn:
+        log and serve on device anyway.  Certificates are memoized
+        in-process and through the cert snapshot tier, so warm restarts
+        run zero validations."""
+        from gatekeeper_tpu.analysis import transval
+        tv_mode = transval.mode()
+        if tv_mode not in ("warn", "strict"):
+            return compiled.vectorized
+        lowered = transval.maybe_miscompiled(kind, compiled.vectorized)
+        try:
+            result = transval.certify(kind, compiled, lowered)
+        except Exception as e:   # noqa: BLE001 — validation must not
+            # take template install down with it; an inconclusive run
+            # certifies nothing, so strict mode still pins
+            from gatekeeper_tpu.utils.log import logger
+            logger("engine.jax_driver").warning(
+                "translation validation errored", kind=kind, err=str(e))
+            self.metrics.counter("transval_errors").inc()
+            return None if tv_mode == "strict" else compiled.vectorized
+        if isinstance(result, transval.Certificate):
+            self.metrics.counter("transval_certified").inc()
+            return compiled.vectorized
+        self.metrics.counter("transval_counterexamples").inc()
+        from gatekeeper_tpu.utils.log import logger
+        logger("engine.jax_driver").warning(
+            "translation validation found a counterexample",
+            kind=kind, note=result.note, expected=result.expected,
+            actual=result.actual, mode=tv_mode)
+        if tv_mode == "strict":
+            return None   # scalar pin — identical to CannotLower
+        return compiled.vectorized
 
     @staticmethod
     def _verify_lowered(kind: str, lowered):

@@ -12,7 +12,9 @@ persists the store snapshot, and prints a single JSON line::
 Run it twice against the same ``GATEKEEPER_SNAPSHOT_DIR`` (fresh
 directory for the cold run) and the warm process must show
 ``restart_persistent_cache_hits > 0``, ``lowerings == 0`` (no Rego
-re-lowering, no re-verification), an identical ``verdict_digest``, and
+re-lowering, no re-verification), ``validations == 0`` (every
+translation-validation Certificate restored from the cert snapshot
+tier instead of re-derived), an identical ``verdict_digest``, and
 a substantially smaller ``serving_seconds`` — ci.sh's restart-smoke
 stage asserts exactly that.  The workload is deterministic
 (seeded RNG), so cold and warm evaluate the same inventory whether it
@@ -47,10 +49,15 @@ def _verdict_digest(results) -> str:
 
 def main() -> int:
     n = int(os.environ.get("GATEKEEPER_SMOKE_N", "300"))
+    # translation validation on by default here: the warm process must
+    # load every Certificate from the cert snapshot tier instead of
+    # re-running the small-model check ("validations" == 0 warm)
+    os.environ.setdefault("GATEKEEPER_TRANSVAL", "warn")
 
     # imports before the clock starts: interpreter + jax import cost is
     # identical for cold and warm processes and would only dilute the
     # startup ratio the smoke stage asserts on
+    from gatekeeper_tpu.analysis import transval
     from gatekeeper_tpu.client.client import Backend
     from gatekeeper_tpu.client.interface import QueryOpts
     from gatekeeper_tpu.engine import jax_driver as jd_mod
@@ -107,6 +114,7 @@ def main() -> int:
         "n_rows": len(st.table),
         "n_results": len(results),
         "verdict_digest": _verdict_digest(results),
+        "validations": transval.validations_run,
     }
     print(json.dumps(out))
     return 0

@@ -67,7 +67,8 @@ VERSION = 1
 class SnapshotStats:
     _FIELDS = ("ir_hits", "ir_misses", "mod_hits", "mod_misses",
                "plan_hits", "plan_misses",
-               "store_hits", "store_misses", "corrupt_discarded",
+               "store_hits", "store_misses",
+               "cert_hits", "cert_misses", "corrupt_discarded",
                "saves", "save_errors")
 
     def __init__(self):
@@ -354,6 +355,31 @@ def save_dedup_plan(digest: str, plan) -> bool:
     return _write_entry("plan", f"plan:{digest}", payload)
 
 
+def load_cert(digest: str):
+    """Fifth tier: translation-validation certificates, keyed by the
+    transval certificate digest (program cache_key + constraint docs +
+    budget + validator version).  A warm restart that reuses the
+    snapshotted lowered IR also reuses its certificate, so it re-runs
+    zero validations (analysis/transval.certify)."""
+    if not enabled():
+        return None
+    got = _read_entry("cert", f"cert:{digest}")
+    stats.bump("cert_hits" if got is not None else "cert_misses")
+    return got
+
+
+def save_cert(digest: str, cert) -> bool:
+    if not enabled():
+        return False
+    try:
+        payload = dumps(cert)
+    except Exception as e:   # noqa: BLE001
+        stats.bump("save_errors")
+        _log.warning("certificate not snapshottable", error=e)
+        return False
+    return _write_entry("cert", f"cert:{digest}", payload)
+
+
 def load_store(target: str):
     if not enabled():
         return None
@@ -381,9 +407,10 @@ def tier_counts(s: dict) -> tuple[int, int]:
     """(hits, misses) summed across every snapshot tier of a stats dict
     (works on both ``stats.snapshot()`` absolutes and ``delta_since``
     deltas)."""
-    hits = s["ir_hits"] + s["mod_hits"] + s["plan_hits"] + s["store_hits"]
+    hits = (s["ir_hits"] + s["mod_hits"] + s["plan_hits"]
+            + s["store_hits"] + s.get("cert_hits", 0))
     misses = (s["ir_misses"] + s["mod_misses"] + s["plan_misses"]
-              + s["store_misses"])
+              + s["store_misses"] + s.get("cert_misses", 0))
     return hits, misses
 
 

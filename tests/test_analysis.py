@@ -472,6 +472,65 @@ violation[{"msg": msg}] {
 
 
 # ---------------------------------------------------------------------------
+# Stage 4 wiring: strict-mode certification failure surfaces in status
+
+
+class TestTransvalStatus:
+    def _plane(self, monkeypatch):
+        from gatekeeper_tpu.cluster.fake import FakeCluster
+        from gatekeeper_tpu.controllers.constrainttemplate import \
+            TEMPLATE_GVK
+        from gatekeeper_tpu.controllers.registry import add_to_manager
+        from gatekeeper_tpu.analysis import transval
+        monkeypatch.setattr(transval, "failures", {})
+        monkeypatch.setattr(transval, "_memo", {})
+        cluster = FakeCluster()
+        cluster.register_kind(TEMPLATE_GVK, "constrainttemplates")
+        client = Backend(JaxDriver()).new_client([K8sValidationTarget()])
+        return cluster, add_to_manager(cluster, client), TEMPLATE_GVK
+
+    PIN_REGO = """package statuspin
+violation[{"msg": msg}] {
+  input.review.object.spec.replicas > 3
+  msg := "too many"
+}
+"""
+
+    def test_strict_counterexample_in_status(self, monkeypatch):
+        from gatekeeper_tpu.utils.ha_status import get_ha_status
+        monkeypatch.setenv("GATEKEEPER_TRANSVAL", "strict")
+        monkeypatch.setenv("GATEKEEPER_TRANSVAL_TEST_MISCOMPILE",
+                           "StatusPin")
+        cluster, plane, gvk = self._plane(monkeypatch)
+        doc = _template_doc("StatusPin", self.PIN_REGO)
+        doc["metadata"]["name"] = "statuspin"
+        cluster.create(doc)
+        plane.run_until_idle()
+        tmpl = cluster.get(gvk, "statuspin")
+        errors = get_ha_status(tmpl).get("errors")
+        assert errors and any(e["code"] == "translation_unvalidated"
+                              for e in errors)
+        # unlike a VetError the template IS admitted — it serves from
+        # the scalar fallback, exactly as if it had never lowered
+        assert "StatusPin" in plane.client.templates
+        st = plane.client.driver.state["admission.k8s.gatekeeper.sh"]
+        assert st.templates["StatusPin"].vectorized is None
+
+    def test_strict_clean_template_has_no_status_error(self, monkeypatch):
+        from gatekeeper_tpu.utils.ha_status import get_ha_status
+        monkeypatch.setenv("GATEKEEPER_TRANSVAL", "strict")
+        cluster, plane, gvk = self._plane(monkeypatch)
+        doc = _template_doc("StatusPin", self.PIN_REGO)
+        doc["metadata"]["name"] = "statuspin"
+        cluster.create(doc)
+        plane.run_until_idle()
+        tmpl = cluster.get(gvk, "statuspin")
+        assert not get_ha_status(tmpl).get("errors")
+        st = plane.client.driver.state["admission.k8s.gatekeeper.sh"]
+        assert st.templates["StatusPin"].vectorized is not None
+
+
+# ---------------------------------------------------------------------------
 # probe --lint
 
 
@@ -493,7 +552,7 @@ class TestProbeLint:
     def test_error_finding_exits_nonzero(self, tmp_path, capsys):
         from gatekeeper_tpu.client.probe import main
         path = self._write(tmp_path, "bad.yaml", "BadKind", BAD_BUILTIN)
-        assert main(["--lint", path]) == 1
+        assert main(["--lint", path]) == 2
         out = capsys.readouterr().out
         assert "rego_unknown_builtin" in out
         assert f"{path}:3:3" in out
@@ -502,7 +561,7 @@ class TestProbeLint:
         from gatekeeper_tpu.client.probe import main
         path = self._write(tmp_path, "parse.yaml", "ParseKind",
                            "package p\nviolation[ {")
-        assert main(["--lint", path]) == 1
+        assert main(["--lint", path]) == 2
         assert "rego_parse_error" in capsys.readouterr().out
 
     def test_unreadable_input_exits_two(self, tmp_path):
@@ -525,6 +584,51 @@ class TestSelfLint:
         root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
         findings = lint_paths([os.path.join(root, "gatekeeper_tpu", "engine"),
                                os.path.join(root, "gatekeeper_tpu", "ir")])
+        assert findings == []
+
+    def _lint_src(self, src: str):
+        import ast
+        from gatekeeper_tpu.analysis.selflint import _lint_tree
+        return _lint_tree(ast.parse(src), "t.py")
+
+    def test_nondet_rng_and_clock_flagged(self):
+        findings = self._lint_src(
+            "import jax, time, random\n"
+            "import numpy as np\n"
+            "@jax.jit\n"
+            "def kern(x):\n"
+            "    a = random.random()\n"
+            "    b = np.random.uniform()\n"
+            "    c = time.monotonic()\n"
+            "    return x + a + b + c\n")
+        assert len(findings) == 3
+        assert all("nondeterministic" in f for f in findings)
+
+    def test_unsorted_set_iteration_flagged(self):
+        findings = self._lint_src(
+            "import jax\n"
+            "@jax.jit\n"
+            "def kern(x):\n"
+            "    for k in {3, 1, 2}:\n"
+            "        x = x + k\n"
+            "    for k in set(x):\n"
+            "        x = x + k\n"
+            "    return x\n")
+        assert len(findings) == 2
+        assert all("un-sorted set" in f for f in findings)
+
+    def test_sorted_iteration_and_host_code_clean(self):
+        findings = self._lint_src(
+            "import jax, random\n"
+            "@jax.jit\n"
+            "def kern(x):\n"
+            "    for k in sorted({3, 1, 2}):\n"
+            "        x = x + k\n"
+            "    return x\n"
+            "def host():\n"
+            "    random.random()\n"
+            "    for k in {1, 2}:\n"
+            "        pass\n")
         assert findings == []
 
     def test_flags_host_sync_in_jit_closure(self, tmp_path):

@@ -8,6 +8,7 @@ exactly.  The device path must either lower soundly or fall back —
 either way the outputs must match.  This automates the adversarial
 parity reproductions that caught the round's soundness bugs."""
 
+import os
 import random
 
 import pytest
@@ -236,6 +237,28 @@ def cdoc(kind, name, params, match=None):
         spec["match"] = match
     return {"apiVersion": "constraints.gatekeeper.sh/v1alpha1", "kind": kind,
             "metadata": {"name": name}, "spec": spec}
+
+
+CORPUS_DIR = os.path.join(os.path.dirname(__file__), "corpus", "transval")
+
+
+def _corpus_cases():
+    from gatekeeper_tpu.analysis import transval
+    return transval.load_corpus(CORPUS_DIR) or [("<empty>", None)]
+
+
+@pytest.mark.parametrize(
+    "name,case", _corpus_cases(), ids=lambda v: v if isinstance(v, str) else "")
+def test_corpus_replays_clean(name, case):
+    """Replay the translation-validation regression corpus BEFORE any
+    randomized fuzzing: every historical counterexample, re-lowered
+    with the current compiler, must agree with the interpreter.  A
+    non-None replay message is a regressed miscompile."""
+    if case is None:
+        pytest.skip("empty corpus")
+    from gatekeeper_tpu.analysis import transval
+    msg = transval.replay_case(case)
+    assert msg is None, f"corpus case {name} regressed: {msg}"
 
 
 @pytest.mark.parametrize("seed", range(16))

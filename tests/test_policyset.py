@@ -424,3 +424,57 @@ class TestLockLint:
                                     "gatekeeper_tpu/controllers",
                                     "gatekeeper_tpu/externaldata"])
         assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# digest determinism: canonical conjunct digests must not depend on the
+# process hash seed
+
+
+class TestDigestDeterminism:
+    def test_stable_repr_orders_containers(self):
+        from gatekeeper_tpu.analysis.policyset import _stable_repr
+        assert _stable_repr(frozenset({"b", "a", "c"})) \
+            == _stable_repr(frozenset({"c", "a", "b"}))
+        assert _stable_repr({"b": 1, "a": [2, frozenset({"y", "x"})]}) \
+            == "{'a': [2, {'x', 'y'}], 'b': 1}"
+        assert _stable_repr((1, "x")) == "(1, 'x',)"
+        # scalars fall through to plain repr
+        assert _stable_repr("s") == "'s'"
+        assert _stable_repr(None) == "None"
+
+    @pytest.mark.parametrize("seeds", [("1", "2")])
+    def test_digests_survive_hash_seed(self, seeds):
+        """The whole-library conjunct digest set and the dedup plan's
+        group keys must be byte-identical across processes with
+        different PYTHONHASHSEED values — certificates and dedup plans
+        persist across restarts, so a hash-order-dependent repr would
+        invalidate every snapshot on the next boot."""
+        import hashlib
+        import os
+        import subprocess
+        import sys
+        prog = (
+            "import hashlib\n"
+            "from gatekeeper_tpu.client.probe import _library_entries\n"
+            "from gatekeeper_tpu.analysis.policyset import (\n"
+            "    build_dedup_plan, template_digests)\n"
+            "entries = _library_entries()\n"
+            "digests = sorted(d for _k, low, cons in entries\n"
+            "                 for d in template_digests(low, cons))\n"
+            "plan = build_dedup_plan(\n"
+            "    {k: (low, cons) for k, low, cons in entries\n"
+            "     if low is not None})\n"
+            "blob = repr((digests, sorted(plan.groups),\n"
+            "             sorted(plan.kind_digests.items())))\n"
+            "print(hashlib.sha256(blob.encode()).hexdigest())\n")
+        outs = []
+        for seed in seeds:
+            env = dict(os.environ, PYTHONHASHSEED=seed,
+                       JAX_PLATFORMS="cpu")
+            res = subprocess.run([sys.executable, "-c", prog], env=env,
+                                 capture_output=True, text=True,
+                                 timeout=300, check=True)
+            outs.append(res.stdout.strip().splitlines()[-1])
+        assert outs[0] == outs[1], \
+            f"digests vary with PYTHONHASHSEED: {outs}"

@@ -58,3 +58,83 @@ def test_probe_failure_carries_engine_dump(monkeypatch):
         probe.test_funcs()["Deny All"]()
     assert "engine disagrees" in str(ei.value)
     assert "Engine dump:" in str(ei.value)
+
+
+# ---------------------------------------------------------------------------
+# subcommand exit-code contract: 0 clean / 1 warnings / 2 errors
+
+
+GOOD_REGO = """package probeok
+violation[{"msg": msg}] {
+  input.review.object.spec.replicas > 3
+  msg := "too many"
+}
+"""
+
+BAD_REGO = """package probebad
+violation[{"msg": msg}] {
+  x := no.such_builtin(1)
+  msg := "bad"
+}
+"""
+
+
+def _write_template(tmp_path, name, kind, rego):
+    import yaml
+    doc = {"apiVersion": "templates.gatekeeper.sh/v1alpha1",
+           "kind": "ConstraintTemplate",
+           "metadata": {"name": kind.lower()},
+           "spec": {"crd": {"spec": {"names": {"kind": kind}}},
+                    "targets": [{"target": "admission.k8s.gatekeeper.sh",
+                                 "rego": rego}]}}
+    p = tmp_path / name
+    p.write_text(yaml.safe_dump(doc))
+    return str(p)
+
+
+class TestExitCodeContract:
+    def test_severity_rc_table(self):
+        from gatekeeper_tpu.client.probe import _severity_rc
+        assert _severity_rc(0, 0) == 0
+        assert _severity_rc(0, 3) == 1
+        assert _severity_rc(1, 0) == 2
+        assert _severity_rc(2, 5) == 2      # errors dominate warnings
+
+    def test_lint_clean_zero_error_two(self, tmp_path, capsys):
+        from gatekeeper_tpu.client.probe import main
+        good = _write_template(tmp_path, "ok.yaml", "ProbeOk", GOOD_REGO)
+        bad = _write_template(tmp_path, "bad.yaml", "ProbeBad", BAD_REGO)
+        assert main(["--lint", good]) == 0
+        assert main(["--lint", bad]) == 2
+        capsys.readouterr()
+
+    def test_policyset_library_clean(self, capsys):
+        from gatekeeper_tpu.client.probe import main
+        assert main(["--policyset"]) == 0
+        capsys.readouterr()
+
+    def test_cost_over_budget_warns(self, monkeypatch, capsys):
+        from gatekeeper_tpu.client.probe import main
+        monkeypatch.setenv("GATEKEEPER_COST_PROBE_N", "40")
+        assert main(["--cost"]) == 0
+        # an absurdly small unit budget puts every template over: the
+        # warning tier of the contract
+        monkeypatch.setenv("GATEKEEPER_COST_BUDGET_UNITS", "0.001")
+        assert main(["--cost"]) == 1
+        assert "over-budget" in capsys.readouterr().out
+
+    def test_certify_clean_and_counterexample(self, tmp_path, monkeypatch,
+                                              capsys):
+        from gatekeeper_tpu.analysis import transval
+        from gatekeeper_tpu.client.probe import main
+        monkeypatch.setattr(transval, "failures", {})
+        good = _write_template(tmp_path, "ok.yaml", "ProbeOk", GOOD_REGO)
+        assert main(["--certify", good]) == 0
+        assert "1 certified" in capsys.readouterr().out
+        monkeypatch.setenv("GATEKEEPER_TRANSVAL_TEST_MISCOMPILE", "ProbeOk")
+        assert main(["--certify", good]) == 2
+        assert "counterexample" in capsys.readouterr().out
+
+    def test_certify_unloadable_input_exits_two(self, tmp_path):
+        from gatekeeper_tpu.client.probe import main
+        assert main(["--certify", str(tmp_path / "missing.yaml")]) == 2

@@ -1,0 +1,228 @@
+"""Stage-4 translation validation: bounded-model Rego↔IR certifier.
+
+Covers the validator itself (library templates certify; a deliberate
+miscompile yields a minimal counterexample), the corpus round-trip
+(save → load → replay bites the bad program, passes the fixed one),
+certificate persistence through the warm-restart snapshot (zero
+re-validations warm), and the strict-mode end-to-end pin: a template
+whose lowered program fails certification behaves exactly as if it had
+never lowered (scalar fallback, oracle-parity verdicts).
+"""
+
+import pytest
+
+from gatekeeper_tpu.analysis import transval
+from gatekeeper_tpu.api.templates import compile_target_rego
+from gatekeeper_tpu.ir.lower import lower_template
+from gatekeeper_tpu.library import all_docs
+
+
+@pytest.fixture(autouse=True)
+def _reset_transval_state(monkeypatch):
+    """Validator state is process-global (memo, failure registry,
+    validation counter) — isolate every test."""
+    monkeypatch.setattr(transval, "failures", {})
+    monkeypatch.setattr(transval, "_memo", {})
+    monkeypatch.setattr(transval, "validations_run", 0)
+    monkeypatch.delenv("GATEKEEPER_TRANSVAL", raising=False)
+    monkeypatch.delenv("GATEKEEPER_TRANSVAL_TEST_MISCOMPILE", raising=False)
+    monkeypatch.delenv("GATEKEEPER_SNAPSHOT_DIR", raising=False)
+    yield
+
+
+def _library(kind: str):
+    """(compiled, lowered, sample constraint doc) for one built-in."""
+    for tdoc, cdoc in all_docs():
+        k = tdoc["spec"]["crd"]["spec"]["names"]["kind"]
+        if k != kind:
+            continue
+        tt = tdoc["spec"]["targets"][0]
+        compiled = compile_target_rego(kind, tt["target"], tt["rego"])
+        return compiled, lower_template(compiled.module, compiled.interp), cdoc
+    raise LookupError(kind)
+
+
+SUBSET = ["K8sAllowedRepos", "K8sRequiredLabels", "K8sReplicaLimits",
+          "K8sContainerLimits", "K8sBlockNodePort"]
+
+
+class TestValidator:
+    @pytest.mark.parametrize("kind", SUBSET)
+    def test_library_template_certifies(self, kind):
+        compiled, lowered, cdoc = _library(kind)
+        res = transval.validate_template(kind, compiled, lowered, [cdoc])
+        assert isinstance(res, transval.Certificate), res
+        assert res.models_checked > 0
+        assert res.constraints_checked >= 1
+        assert transval.failure_for(kind) is None
+
+    def test_install_time_default_constraint(self):
+        # reconcile order installs templates before constraints: the
+        # empty-parameter stand-in must still certify
+        compiled, lowered, _ = _library("K8sBlockNodePort")
+        res = transval.validate_template("K8sBlockNodePort", compiled,
+                                         lowered, None)
+        assert isinstance(res, transval.Certificate)
+
+    def test_miscompile_yields_counterexample(self):
+        compiled, lowered, cdoc = _library("K8sReplicaLimits")
+        bad = transval.miscompile(lowered)
+        res = transval.validate_template("K8sReplicaLimits", compiled,
+                                         bad, [cdoc])
+        assert isinstance(res, transval.Counterexample), res
+        assert res.expected is True and res.actual is False
+        assert transval.failure_for("K8sReplicaLimits") is res
+        # minimized: one resource, stripped to identity + the one
+        # field the disagreement needs
+        assert len(res.resources) == 1
+        extra = set(res.resources[0]) - {"apiVersion", "kind", "metadata"}
+        assert len(extra) <= 1, res.resources
+
+    def test_digest_distinguishes_programs(self):
+        _, lowered, cdoc = _library("K8sReplicaLimits")
+        bad = transval.miscompile(lowered)
+        cons = transval.expand_constraints("K8sReplicaLimits", [cdoc])
+        assert transval.certificate_digest(lowered, cons, 96) \
+            != transval.certificate_digest(bad, cons, 96)
+
+
+class TestCorpus:
+    def test_roundtrip_and_replay(self, tmp_path):
+        compiled, lowered, cdoc = _library("K8sReplicaLimits")
+        bad = transval.miscompile(lowered)
+        ce = transval.validate_template("K8sReplicaLimits", compiled,
+                                        bad, [cdoc])
+        assert isinstance(ce, transval.Counterexample)
+        path = transval.save_counterexample(str(tmp_path), ce)
+        cases = transval.load_corpus(str(tmp_path))
+        assert len(cases) == 1 and path.endswith(cases[0][0])
+        case = cases[0][1]
+        # the recorded world must bite the corrupted program...
+        assert transval.replay_case(case, lowered=bad) is not None
+        # ...and replay clean against the current (correct) compiler
+        assert transval.replay_case(case) is None
+
+    def test_save_is_content_addressed(self, tmp_path):
+        compiled, lowered, cdoc = _library("K8sReplicaLimits")
+        bad = transval.miscompile(lowered)
+        ce = transval.validate_template("K8sReplicaLimits", compiled,
+                                        bad, [cdoc])
+        p1 = transval.save_counterexample(str(tmp_path), ce)
+        p2 = transval.save_counterexample(str(tmp_path), ce)
+        assert p1 == p2
+        assert len(transval.load_corpus(str(tmp_path))) == 1
+
+
+class TestCertPersistence:
+    def test_snapshot_skips_revalidation(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("GATEKEEPER_SNAPSHOT_DIR", str(tmp_path))
+        compiled, lowered, cdoc = _library("K8sReplicaLimits")
+        res = transval.certify("K8sReplicaLimits", compiled, lowered, [cdoc])
+        assert isinstance(res, transval.Certificate)
+        assert transval.validations_run == 1
+        # simulate a cold process: wipe the in-process memo — the cert
+        # tier alone must answer, with no second validation
+        monkeypatch.setattr(transval, "_memo", {})
+        res2 = transval.certify("K8sReplicaLimits", compiled, lowered, [cdoc])
+        assert isinstance(res2, transval.Certificate)
+        assert res2.digest == res.digest
+        assert transval.validations_run == 1
+
+    def test_counterexample_not_persisted(self, tmp_path, monkeypatch):
+        # a cold process must re-derive counterexamples so a FIXED
+        # lowering is immediately re-admitted
+        monkeypatch.setenv("GATEKEEPER_SNAPSHOT_DIR", str(tmp_path))
+        compiled, lowered, cdoc = _library("K8sReplicaLimits")
+        bad = transval.miscompile(lowered)
+        res = transval.certify("K8sReplicaLimits", compiled, bad, [cdoc])
+        assert isinstance(res, transval.Counterexample)
+        assert transval.validations_run == 1
+        monkeypatch.setattr(transval, "_memo", {})
+        res2 = transval.certify("K8sReplicaLimits", compiled, bad, [cdoc])
+        assert isinstance(res2, transval.Counterexample)
+        assert transval.validations_run == 2
+
+
+PIN_REGO = """package strictpin
+violation[{"msg": msg}] {
+  input.review.object.spec.replicas > 3
+  msg := sprintf("too many replicas on %v",
+                 [input.review.object.metadata.name])
+}
+"""
+
+
+def _tdoc(kind, rego):
+    return {"apiVersion": "templates.gatekeeper.sh/v1alpha1",
+            "kind": "ConstraintTemplate",
+            "metadata": {"name": kind.lower()},
+            "spec": {"crd": {"spec": {"names": {"kind": kind}}},
+                     "targets": [{"target": "admission.k8s.gatekeeper.sh",
+                                  "rego": rego}]}}
+
+
+def _cdoc(kind, name="pin"):
+    return {"apiVersion": "constraints.gatekeeper.sh/v1alpha1",
+            "kind": kind, "metadata": {"name": name},
+            "spec": {"parameters": {}}}
+
+
+def _pods(n=8):
+    return [{"apiVersion": "v1", "kind": "Pod",
+             "metadata": {"name": f"p{i}", "namespace": "default"},
+             "spec": {"replicas": i}} for i in range(n)]
+
+
+class TestStrictPin:
+    """Satellite: strict mode + a failing certification must behave
+    identically to a template that never lowered."""
+
+    def _run(self, driver, monkeypatch=None):
+        from gatekeeper_tpu.client.client import Backend
+        from gatekeeper_tpu.target.k8s import K8sValidationTarget
+        client = Backend(driver).new_client([K8sValidationTarget()])
+        client.add_template(_tdoc("StrictPin", PIN_REGO))
+        client.add_constraint(_cdoc("StrictPin"))
+        for p in _pods():
+            client.add_data(p)
+        results = sorted(
+            (r.msg, (r.resource or {}).get("metadata", {}).get("name", ""))
+            for r in client.audit().results())
+        return client, results
+
+    def test_strict_counterexample_pins_scalar(self, monkeypatch):
+        from gatekeeper_tpu.client.local_driver import LocalDriver
+        from gatekeeper_tpu.engine.jax_driver import JaxDriver
+        monkeypatch.setenv("GATEKEEPER_TRANSVAL", "strict")
+        monkeypatch.setenv("GATEKEEPER_TRANSVAL_TEST_MISCOMPILE",
+                           "StrictPin")
+        jx, jres = self._run(JaxDriver())
+        st = jx.driver.state["admission.k8s.gatekeeper.sh"]
+        # pinned exactly like a never-lowered template: no device program
+        assert st.templates["StrictPin"].vectorized is None
+        assert transval.failure_for("StrictPin") is not None
+        # oracle parity: verdicts identical to the pure interpreter
+        monkeypatch.delenv("GATEKEEPER_TRANSVAL")
+        monkeypatch.delenv("GATEKEEPER_TRANSVAL_TEST_MISCOMPILE")
+        _, lres = self._run(LocalDriver())
+        assert jres == lres
+        assert len(jres) == 4           # replicas 4..7 fire
+
+    def test_warn_mode_serves_on_device(self, monkeypatch):
+        from gatekeeper_tpu.engine.jax_driver import JaxDriver
+        monkeypatch.setenv("GATEKEEPER_TRANSVAL", "warn")
+        monkeypatch.setenv("GATEKEEPER_TRANSVAL_TEST_MISCOMPILE",
+                           "StrictPin")
+        jx, _ = self._run(JaxDriver())
+        st = jx.driver.state["admission.k8s.gatekeeper.sh"]
+        # warn: counterexample logged + registered, device path kept
+        assert st.templates["StrictPin"].vectorized is not None
+        assert transval.failure_for("StrictPin") is not None
+
+    def test_strict_clean_template_stays_lowered(self, monkeypatch):
+        from gatekeeper_tpu.engine.jax_driver import JaxDriver
+        monkeypatch.setenv("GATEKEEPER_TRANSVAL", "strict")
+        jx, _ = self._run(JaxDriver())
+        st = jx.driver.state["admission.k8s.gatekeeper.sh"]
+        assert st.templates["StrictPin"].vectorized is not None
+        assert transval.failure_for("StrictPin") is None
