@@ -46,6 +46,37 @@ if [ "${SOAK:-0}" = "1" ]; then
   GATEKEEPER_SOAK=1 python -m pytest tests/test_soak.py -q
 fi
 
+echo "== obs (sweep trace capture + schema validation) =="
+# capture a full-sweep trace via the probe and validate the Chrome
+# trace-event schema (Perfetto-loadable) plus the attribution contract:
+# per-template device seconds must sum to the measured device time
+TRACE=$(mktemp /tmp/gatekeeper-trace-XXXX.json)
+JAX_PLATFORMS=cpu GATEKEEPER_TRACE_PROBE_N=200 timeout -k 10 120 \
+  python -m gatekeeper_tpu.client.probe --trace --out "$TRACE"
+TRACE="$TRACE" python - <<'EOF'
+import json, os
+t = json.load(open(os.environ["TRACE"]))
+evs = t["traceEvents"]
+assert evs, "empty traceEvents"
+for e in evs:
+    assert e["ph"] == "X" and "name" in e and "ts" in e and "dur" in e \
+        and "pid" in e and "tid" in e, f"malformed trace event: {e}"
+names = {e["name"] for e in evs}
+assert "audit.sweep" in names, f"no audit.sweep span: {sorted(names)[:20]}"
+gt = t["gatekeeperTrace"]
+attr = gt.get("attribution")
+if attr:     # device path only; scalar-only runs carry no attribution
+    total = sum(r["device_seconds"] for r in attr["templates"])
+    dev = gt["device_s"]
+    assert dev > 0 and abs(total - dev) / dev < 0.01, \
+        f"attribution sum {total} vs measured device_s {dev}"
+    print(f"obs ok: {len(evs)} events, {len(attr['templates'])} "
+          f"templates attributed, sum within 1% of device_s")
+else:
+    print(f"obs ok (scalar-only): {len(evs)} events, no attribution")
+EOF
+rm -f "$TRACE"
+
 echo "== restart smoke (warm-restart persistence) =="
 # cold run in a fresh snapshot dir, then a warm run in a FRESH PROCESS
 # against the same dir: the warm process must skip all Rego lowering,
@@ -115,9 +146,16 @@ an = d.get("analysis")
 assert isinstance(an, dict) and "evaluations_saved" in an \
     and an.get("dedup_parity") is True, \
     f"no analysis row (with dedup parity) in the trailing headline: {d}"
+# the trace_overhead row must survive the window too: the always-on
+# tracer's cost on the memoized steady sweep is gated at <2% (with a
+# 2ms absolute floor to damp host jitter)
+to = d.get("trace_overhead")
+assert isinstance(to, dict) and to.get("within_budget") is True, \
+    f"no within-budget trace_overhead row in the trailing headline: {d}"
 print("bench ok:", d["metric"], round(d["value"], 1), d["unit"],
       f"({len(line)} headline chars; external_data warm "
       f"{xd['warm_seconds']}s vs baseline {xd['baseline_seconds']}s; "
-      f"dedup saved {an['evaluations_saved']} evals)")
+      f"dedup saved {an['evaluations_saved']} evals; tracer overhead "
+      f"{to.get('overhead_fraction')})")
 EOF
 echo "CI PASS"

@@ -32,8 +32,10 @@ nodes are free compute-wise and contribute only H2D bytes.
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import os
+import threading
 
 from gatekeeper_tpu.ir.prep import audit_pads
 
@@ -262,6 +264,47 @@ def calibrate(samples) -> float:
 
 def predict_seconds(units: float, scale: float) -> float:
     return units * scale
+
+
+# ---------------------------------------------------------------------------
+# running calibration store
+#
+# Every full sweep's per-template attribution (obs/attribution.py)
+# feeds (units, measured_device_seconds) samples back here, closing
+# the predict→measure→recalibrate loop the Learned-Performance-Model
+# paper describes.  Bounded window so the scale tracks the current
+# backend rather than averaging over a demotion.
+
+_CAL_WINDOW = 256
+_cal_lock = threading.Lock()
+_cal_samples: collections.deque = collections.deque(maxlen=_CAL_WINDOW)
+
+
+def record_sample(units: float, seconds: float) -> None:
+    """Feed one measured (units, device_seconds) calibration sample."""
+    if units > 0 and seconds > 0:
+        with _cal_lock:
+            _cal_samples.append((units, seconds))
+
+
+def current_scale() -> float:
+    """Seconds-per-unit fitted over the recent sample window (0.0
+    while uncalibrated)."""
+    with _cal_lock:
+        samples = list(_cal_samples)
+    return calibrate(samples)
+
+
+def calibration_info() -> dict:
+    with _cal_lock:
+        n = len(_cal_samples)
+    return {"samples": n, "scale": current_scale()}
+
+
+def reset_calibration() -> None:
+    """Drop the sample window (tests)."""
+    with _cal_lock:
+        _cal_samples.clear()
 
 
 # ---------------------------------------------------------------------------

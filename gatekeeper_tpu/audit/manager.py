@@ -89,7 +89,11 @@ class AuditManager:
         so "full sweep" and "memoized steady" stay two separately
         metered numbers."""
         t0 = self._now()
-        report = self._sweep(t0, full=full)
+        # audit.cycle parents the driver's audit.sweep span, so one
+        # trace covers evaluate + status writes end to end
+        from gatekeeper_tpu.obs.trace import get_tracer
+        with get_tracer().span("audit.cycle", cat="audit", full=full):
+            report = self._sweep(t0, full=full)
         if not report["skipped"]:
             report.setdefault("total_seconds", self._now() - t0)
             self.metrics.counter("audit_sweeps").inc()
@@ -135,9 +139,18 @@ class AuditManager:
         phases = getattr(self.client.driver, "last_sweep_phases", None)
         if phases:
             for k in ("host_prep_s", "h2d_s", "device_s",
-                      "overlap_fraction", "external", "dedup"):
+                      "overlap_fraction", "external", "dedup",
+                      "attribution"):
                 if k in phases:
                     report[k] = phases[k]
+
+        # flight recorder: one structured event per sweep so a later
+        # degradation dump shows the sweeps that led up to it
+        from gatekeeper_tpu.obs.flightrecorder import record_event
+        record_event("audit_sweep", full=full,
+                     violations=report["violations"],
+                     eval_seconds=report["eval_seconds"],
+                     device_s=phases.get("device_s") if phases else None)
 
         # serving posture (resilience/supervisor): a sweep that ran —
         # partly or wholly — on the scalar/CPU fallback is correct but

@@ -515,6 +515,74 @@ def run_cost() -> int:
     return _severity_rc(0, n_over)
 
 
+def run_trace(out_path: str | None = None) -> int:
+    """``--trace [--out file]``: capture one forced-full library sweep
+    under the span tracer and emit Chrome trace-event JSON (Perfetto /
+    chrome://tracing loadable) plus the sweep's per-template device-
+    time attribution under the ``gatekeeperTrace`` metadata key (extra
+    top-level keys are explicitly allowed by the trace-event format).
+
+    Exit contract: 0 with a device-attributed trace, 1 when the sweep
+    ran scalar-only (a host-span-only trace still emits), 2 when the
+    sweep failed outright."""
+    import json as _json
+    import os as _os
+    import random
+    import sys as _sys
+    from gatekeeper_tpu.client.client import Backend
+    import gatekeeper_tpu.engine.jax_driver as jd_mod
+    from gatekeeper_tpu.library import all_docs, make_mixed
+    from gatekeeper_tpu.obs.trace import get_tracer
+    from gatekeeper_tpu.target.k8s import K8sValidationTarget
+
+    n = int(_os.environ.get("GATEKEEPER_TRACE_PROBE_N", "500"))
+    tracer = get_tracer()
+    try:
+        jd = jd_mod.JaxDriver()
+        c = Backend(jd).new_client([K8sValidationTarget()])
+        for tdoc, cdoc in all_docs():
+            c.add_template(tdoc)
+            c.add_constraint(cdoc)
+        c.add_data_batch(make_mixed(random.Random(7), n))
+        saved = jd_mod.SMALL_WORKLOAD_EVALS
+        jd_mod.SMALL_WORKLOAD_EVALS = 0
+        try:
+            c.audit(limit_per_constraint=20, full=True)   # compile warm
+            tracer.reset()      # keep only the measured sweep's spans
+            c.audit(limit_per_constraint=20, full=True)
+        finally:
+            jd_mod.SMALL_WORKLOAD_EVALS = saved
+    except Exception as e:      # noqa: BLE001 — render a verdict
+        print(f"trace: sweep failed: {type(e).__name__}: {e}",
+              file=_sys.stderr)
+        return 2
+    phases = jd.last_sweep_phases or {}
+    payload = tracer.export()
+    payload["gatekeeperTrace"] = {
+        "workload_rows": n,
+        "device_s": phases.get("device_s"),
+        "phases": {k: v for k, v in phases.items() if k != "attribution"},
+        "attribution": phases.get("attribution"),
+    }
+    text = _json.dumps(payload, sort_keys=True)
+    if out_path:
+        with open(out_path, "w") as f:
+            f.write(text)
+    else:
+        print(text)
+    att = phases.get("attribution")
+    n_templates = len((att or {}).get("templates", []))
+    print(f"trace: {len(payload['traceEvents'])} events, "
+          f"{n_templates} attributed template(s), "
+          f"device_s={phases.get('device_s')}"
+          + (f" -> {out_path}" if out_path else ""), file=_sys.stderr)
+    if att is None:
+        print("trace: WARNING scalar-only sweep — no device attribution",
+              file=_sys.stderr)
+        return 1
+    return 0
+
+
 def run_certify(paths: list[str], use_library: bool = False) -> int:
     """``--certify``: Stage-4 translation validation
     (analysis/transval.py) over template files and/or the built-in
@@ -678,6 +746,12 @@ def main(argv=None) -> int:
         return run_policyset()
     if "--cost" in argv:
         return run_cost()
+    if "--trace" in argv:
+        out = None
+        if "--out" in argv:
+            i = argv.index("--out")
+            out = argv[i + 1] if i + 1 < len(argv) else None
+        return run_trace(out)
     if "--certify" in argv:
         rest = [a for a in argv if a not in ("--certify", "--library")]
         return run_certify(rest, use_library="--library" in argv)

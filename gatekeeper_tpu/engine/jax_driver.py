@@ -961,6 +961,16 @@ class JaxDriver(LocalDriver):
 
         ordered_rows, row_order = self._ensure_order(st)
         rank = self._row_rank(st, row_order)
+        # sweep root span, entered manually so the 300-line pipeline
+        # body below keeps its indentation; closed in the finally.
+        # Child spans on pool threads parent via _sweep_ctx (context
+        # vars don't flow into pre-existing worker threads).
+        from gatekeeper_tpu.obs.trace import get_tracer as _get_tracer
+        _tracer = _get_tracer()
+        _sweep_cm = _tracer.span("audit.sweep", cat="audit", target=target,
+                                 full=full, rows=len(ordered_rows))
+        _sweep_sp = _sweep_cm.__enter__()
+        _sweep_ctx = _tracer.current()
         self.executor.sweep_active.set()
         try:
 
@@ -978,6 +988,9 @@ class JaxDriver(LocalDriver):
             # wall is the overlap the pipeline buys.
             ph = {"host_prep_s": 0.0, "h2d_s": 0.0, "device_s": 0.0,
                   "h2d_bytes": 0}
+            # per-kind measured device block seconds (full sweeps) —
+            # ground truth for the attribution drift report
+            per_kind_dev: dict[str, float] = {}
             ph_lock = _threading.Lock()
             serial_full = full and FULL_SWEEP_SERIAL
 
@@ -987,12 +1000,15 @@ class JaxDriver(LocalDriver):
                 return self.executor.run_async(prog, bindings)
 
             def dispatch(spec):
-                mode, _, _, _, prog, bindings, mask = spec
+                mode, kind, _, _, prog, bindings, mask = spec
                 # match/rank gates ride bindings.arrays (_install_gates)
                 if mode not in ("topk", "mask"):
                     return None
                 if not full:
-                    return _launch(mode, prog, bindings)
+                    with _tracer.span("device.dispatch", cat="device",
+                                      parent=_sweep_ctx, kind=kind,
+                                      mode=mode):
+                        return _launch(mode, prog, bindings)
                 # full sweep: meter the two device-side pipeline stages
                 # where they run (concurrently across kinds).
                 # stage_uploads enqueues this kind's H2D transfers as
@@ -1006,11 +1022,27 @@ class JaxDriver(LocalDriver):
                 t1 = _time.perf_counter()
                 h = _launch(mode, prog, bindings).block()
                 t2 = _time.perf_counter()
+                _tracer.add_complete("kind.h2d", cat="h2d", t0=t0, t1=t1,
+                                     parent=_sweep_ctx, kind=kind)
+                _tracer.add_complete("kind.device", cat="device", t0=t1,
+                                     t1=t2, parent=_sweep_ctx, kind=kind,
+                                     mode=mode)
                 with ph_lock:
                     ph["h2d_s"] += t1 - t0
                     ph["device_s"] += t2 - t1
                     ph["h2d_bytes"] += bindings.nbytes()
+                    per_kind_dev[kind] = \
+                        per_kind_dev.get(kind, 0.0) + (t2 - t1)
                 return h
+
+            def _prep_done(kind, t0):
+                # close one kind's host-prep region: meter it into the
+                # pipeline phase sum and record the span
+                now = _time.perf_counter()
+                _tracer.add_complete("kind.host_prep", cat="host_prep",
+                                     t0=t0, t1=now, parent=_sweep_ctx,
+                                     kind=kind)
+                ph["host_prep_s"] += now - t0
 
             # prep + dispatch interleaved: each kind's device step is
             # submitted the moment its bindings are ready, so kind N's
@@ -1024,7 +1056,11 @@ class JaxDriver(LocalDriver):
             # bulk external-data warm, overlapped with host prep: by the
             # time a kind's build loop asks for a key it is a cache hit
             # (or a single-flight wait on this very fetch)
-            ext_fut = pool.submit(self._prefetch_external, st)
+            def _ext_prefetch():
+                with _tracer.span("external.prefetch", cat="external",
+                                  parent=_sweep_ctx):
+                    return self._prefetch_external(st)
+            ext_fut = pool.submit(_ext_prefetch)
             # cross-host collective ordering: on a mesh spanning
             # processes, collective launches must happen in the SAME
             # order on every process (see veval._COLLECTIVE_EXEC_LOCK
@@ -1070,7 +1106,7 @@ class JaxDriver(LocalDriver):
                     if full and not self.scalar_only and \
                             os.environ.get("GATEKEEPER_DEDUP", "on") != "off":
                         dedup_plan = self._audit_dedup_plan(st, target)
-                    ph["host_prep_s"] += _time.perf_counter() - _tk
+                    _prep_done("__axes_and_plan__", _tk)
                     _sweep_kinds = sorted(st.templates)
                     for _kind_i, kind in enumerate(_sweep_kinds):
                         # fault injection: kill the backend mid-sweep
@@ -1108,8 +1144,7 @@ class JaxDriver(LocalDriver):
                                 # other template is unaffected
                                 self.metrics.counter(
                                     "external_data_kind_failures").inc()
-                                ph["host_prep_s"] += \
-                                    _time.perf_counter() - _tk
+                                _prep_done(kind, _tk)
                                 continue
                             if bindings.f32_unsafe:
                                 # some bound numeric value does not survive a
@@ -1121,8 +1156,7 @@ class JaxDriver(LocalDriver):
                                     "f32_unsafe_scalar_fallbacks").inc()
                                 spec = ("scalar", kind, compiled, constraints,
                                         None, None, mask)
-                                ph["host_prep_s"] += \
-                                    _time.perf_counter() - _tk
+                                _prep_done(kind, _tk)
                                 futures.append(None)
                                 specs.append(spec)
                                 continue
@@ -1137,12 +1171,16 @@ class JaxDriver(LocalDriver):
                                     dedup_shared_cols, dedup_applied)
                                 if prog2 is not None:
                                     prog = prog2
-                                dedup_host_s += \
-                                    _time.perf_counter() - _t_dd
+                                _t_dd2 = _time.perf_counter()
+                                _tracer.add_complete(
+                                    "dedup.host_eval", cat="dedup",
+                                    t0=_t_dd, t1=_t_dd2,
+                                    parent=_sweep_ctx, kind=kind)
+                                dedup_host_s += _t_dd2 - _t_dd
                             mode = "topk" if limit is not None else "mask"
                             spec = (mode, kind, compiled, constraints, prog,
                                     bindings, mask)
-                            ph["host_prep_s"] += _time.perf_counter() - _tk
+                            _prep_done(kind, _tk)
                             # serial_full: the no-overlap diagnostic
                             # baseline — dispatch inline and (because
                             # dispatch blocks on full sweeps) finish
@@ -1162,7 +1200,7 @@ class JaxDriver(LocalDriver):
                             # to amortize a device dispatch round-trip
                             spec = ("scalar", kind, compiled, constraints, None,
                                     None, mask)
-                            ph["host_prep_s"] += _time.perf_counter() - _tk
+                            _prep_done(kind, _tk)
                             futures.append(None)
                         specs.append(spec)
                 _phase("audit_prep_submit")
@@ -1209,7 +1247,12 @@ class JaxDriver(LocalDriver):
                         # provider failure: same per-kind containment as
                         # the prep loop
                         m.counter("external_data_kind_failures").inc()
-                    fmt_s += _time.perf_counter() - _tf
+                    _tf2 = _time.perf_counter()
+                    _tracer.add_complete("kind.format", cat="format",
+                                         t0=_tf, t1=_tf2,
+                                         parent=_sweep_ctx, kind=kind,
+                                         mode=mode)
+                    fmt_s += _tf2 - _tf
 
                 if trace is None:
                     fut_idx = {f: i for i, f in enumerate(futures)
@@ -1281,6 +1324,23 @@ class JaxDriver(LocalDriver):
                     "pipeline_wall_s": round(pipeline_wall, 6),
                     "overlap_fraction": round(overlap, 4),
                 }
+                # per-template attribution of the measured device time
+                # (obs/attribution.py): CostVector units apportion the
+                # total, the per-kind timed dispatch blocks anchor the
+                # drift report, and the samples recalibrate the cost
+                # model's seconds-per-unit scale
+                _dev_entries = [
+                    (sp[1], sp[2].vectorized, len(sp[3]))
+                    for sp in specs
+                    if sp[0] in ("topk", "mask")
+                    and sp[2].vectorized is not None]
+                if _dev_entries and ph["device_s"] > 0:
+                    from gatekeeper_tpu.obs.attribution import \
+                        attribute_sweep
+                    self.last_sweep_phases["attribution"] = \
+                        attribute_sweep(_dev_entries, ph["device_s"],
+                                        len(ordered_rows),
+                                        measured=per_kind_dev, metrics=m)
                 ext = self._external_sweep_stats(ext_fut)
                 if ext is not None:
                     self.last_sweep_phases["external"] = ext
@@ -1313,11 +1373,20 @@ class JaxDriver(LocalDriver):
                 ext = self._external_sweep_stats(ext_fut)
                 if ext is not None:
                     self.last_sweep_phases["external"] = ext
+            if _sweep_sp is not None:
+                _sweep_sp.args["results"] = len(tagged)
+            from gatekeeper_tpu.obs.flightrecorder import \
+                record_event as _record_event
+            _record_event("sweep", full=full, results=len(tagged),
+                          wall_s=_time.perf_counter() - _t0,
+                          device_s=(ph["device_s"] if full else None),
+                          scalar_only=self.scalar_only)
             return [r for _, r in tagged], ("\n".join(trace) if trace is not None else None)
         finally:
             # ALWAYS cleared — a dispatch error leaving this set
             # would defer background upgrades forever
             self.executor.sweep_active.clear()
+            _sweep_cm.__exit__(None, None, None)
 
     @locked_read
     def query_review_batch(self, target: str, reviews: list[dict],
@@ -1347,8 +1416,11 @@ class JaxDriver(LocalDriver):
                 B * len(constraints_all) < REVIEW_BATCH_MIN_EVALS:
             return [self.query_review(target, r, opts) for r in reviews]
 
+        import time as _time
+
         from gatekeeper_tpu.engine.match import MatchEngine
         from gatekeeper_tpu.store.table import ResourceMeta, ResourceTable
+        _t_batch = _time.perf_counter()
         mt = ResourceTable()
         for i, rv in enumerate(reviews):
             k = rv.get("kind") or {}
@@ -1427,6 +1499,10 @@ class JaxDriver(LocalDriver):
         m = self.metrics
         m.counter("review_batches_device").inc()
         m.counter("reviews_device").inc(B)
+        from gatekeeper_tpu.obs.trace import get_tracer as _get_tracer
+        _get_tracer().add_complete(
+            "admission.device_batch", cat="device", t0=_t_batch,
+            t1=_time.perf_counter(), n_reviews=B, kinds=len(gates))
         return out
 
     @locked_read

@@ -89,8 +89,16 @@ class CircuitBreaker:
 
     def _transition(self, state: str) -> None:
         if state != self._state:
+            prev = self._state
             self._state = state
             self.transitions.append(state)
+            # flight-record the flip (non-blocking append; safe under
+            # the breaker lock)
+            try:
+                from gatekeeper_tpu.obs.flightrecorder import record_event
+                record_event("breaker_flip", frm=prev, to=state)
+            except Exception:   # noqa: BLE001
+                pass
 
     def code(self) -> int:
         return STATE_CODES[self.state]

@@ -1,0 +1,21 @@
+"""Observability: span tracer, flight recorder, device-time attribution.
+
+The reference ships zero tracing — its only instrumentation is the
+never-served OPA metrics registry (SURVEY §5).  This package is the
+window into the pipeline that registry was supposed to be: spans with
+context propagation across the webhook → batcher → device dispatch and
+audit → per-stage sweep paths (Chrome trace-event export, Perfetto-
+loadable), a bounded flight recorder dumped on degradation, and
+per-template attribution of measured device time via the PR-5 static
+cost model.
+"""
+
+from gatekeeper_tpu.obs.flightrecorder import (FlightRecorder,
+                                               get_flight_recorder,
+                                               record_event)
+from gatekeeper_tpu.obs.trace import Span, Tracer, get_tracer
+
+__all__ = [
+    "Span", "Tracer", "get_tracer",
+    "FlightRecorder", "get_flight_recorder", "record_event",
+]

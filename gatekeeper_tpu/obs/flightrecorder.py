@@ -1,0 +1,148 @@
+"""Degradation flight recorder — the last N events, dumped on failure.
+
+A bounded ring (``GATEKEEPER_FLIGHT_RING``, default 2048) of small
+structured events: sweep phase summaries, admission batch sizes, probe
+results, supervisor transitions, circuit-breaker flips, fault trips.
+Recording is cheap (one dict + deque append under a lock) and never
+raises, so it is safe to call from any seam including failure paths.
+
+``dump(reason)`` serializes the ring plus the tracer's current span
+export (so the in-flight sweep's span tree survives) to a JSON
+artifact under ``GATEKEEPER_FLIGHT_DIR`` (default
+``$TMPDIR/gatekeeper-flight``), pruning to the newest
+``GATEKEEPER_FLIGHT_KEEP`` (default 20) files.  It is invoked
+automatically on supervisor degradation, ``GATEKEEPER_FAULT=*`` trips,
+and bench rc-3 exits — PR-7's "fail loudly" with evidence attached.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import tempfile
+import threading
+import time
+from typing import Any, Optional
+
+from gatekeeper_tpu.utils.log import logger
+
+log = logger("obs.flight")
+
+
+def _flight_dir() -> str:
+    return os.environ.get(
+        "GATEKEEPER_FLIGHT_DIR",
+        os.path.join(tempfile.gettempdir(), "gatekeeper-flight"))
+
+
+class FlightRecorder:
+    def __init__(self, ring: Optional[int] = None):
+        if ring is None:
+            ring = int(os.environ.get("GATEKEEPER_FLIGHT_RING", "2048"))
+        self._lock = threading.Lock()
+        self._events: collections.deque[dict] = collections.deque(maxlen=ring)
+        self._dump_seq = 0
+
+    def record(self, etype: str, **fields: Any) -> None:
+        """Append one event; never raises."""
+        try:
+            ev = {"ts": round(time.time(), 6), "type": etype}
+            try:
+                from gatekeeper_tpu.obs.trace import get_tracer
+                tid = get_tracer().current_trace_id()
+                if tid:
+                    ev["trace"] = tid
+            except Exception:
+                pass
+            for k, v in fields.items():
+                if isinstance(v, float):
+                    v = round(v, 6)
+                ev[k] = v
+            with self._lock:
+                self._events.append(ev)
+        except Exception:
+            pass
+
+    def snapshot(self) -> list[dict]:
+        with self._lock:
+            return [dict(e) for e in self._events]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._events)
+
+    def dump(self, reason: str, extra: Optional[dict] = None) -> Optional[str]:
+        """Write the ring + current span export to a JSON artifact.
+        Returns the path, or None on any failure (dumping evidence
+        must never become its own failure mode)."""
+        try:
+            d = _flight_dir()
+            os.makedirs(d, exist_ok=True)
+            with self._lock:
+                self._dump_seq += 1
+                seq = self._dump_seq
+            try:
+                from gatekeeper_tpu.obs.trace import get_tracer
+                trace = get_tracer().export()
+            except Exception:
+                trace = {"traceEvents": []}
+            payload = {
+                "reason": reason,
+                "dumped_at": round(time.time(), 6),
+                "pid": os.getpid(),
+                "events": self.snapshot(),
+                "trace": trace,
+            }
+            if extra:
+                payload["extra"] = extra
+            stamp = time.strftime("%Y%m%dT%H%M%S", time.gmtime())
+            path = os.path.join(
+                d, f"flight-{stamp}-{os.getpid()}-{seq:03d}.json")
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(payload, f, sort_keys=True)
+            os.replace(tmp, path)
+            self._prune(d)
+            log.info("flight recorder dumped", reason=reason, path=path,
+                     events=len(payload["events"]))
+            return path
+        except Exception as exc:  # pragma: no cover - best effort
+            try:
+                log.warning("flight recorder dump failed", error=exc)
+            except Exception:
+                pass
+            return None
+
+    @staticmethod
+    def _prune(d: str) -> None:
+        keep = int(os.environ.get("GATEKEEPER_FLIGHT_KEEP", "20"))
+        try:
+            files = sorted(
+                f for f in os.listdir(d)
+                if f.startswith("flight-") and f.endswith(".json"))
+            for stale in files[:-keep] if keep > 0 else files:
+                try:
+                    os.unlink(os.path.join(d, stale))
+                except OSError:
+                    pass
+        except OSError:
+            pass
+
+
+_recorder: Optional[FlightRecorder] = None
+_recorder_lock = threading.Lock()
+
+
+def get_flight_recorder() -> FlightRecorder:
+    global _recorder
+    if _recorder is None:
+        with _recorder_lock:
+            if _recorder is None:
+                _recorder = FlightRecorder()
+    return _recorder
+
+
+def record_event(etype: str, **fields: Any) -> None:
+    """Module-level convenience for instrumentation seams."""
+    get_flight_recorder().record(etype, **fields)

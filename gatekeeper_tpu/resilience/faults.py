@@ -48,7 +48,17 @@ def take(name: str) -> bool:
         if name in _fired:
             return False
         _fired.add(name)
-        return True
+    # a fired fault is a synthetic failure event: put it on the flight
+    # recorder and dump the ring (best-effort — the injection seam must
+    # behave exactly like the real failure it simulates)
+    try:
+        from gatekeeper_tpu.obs.flightrecorder import get_flight_recorder
+        rec = get_flight_recorder()
+        rec.record("fault_trip", fault=name)
+        rec.dump(f"fault:{name}")
+    except Exception:   # noqa: BLE001
+        pass
+    return True
 
 
 def reset_for_tests() -> None:

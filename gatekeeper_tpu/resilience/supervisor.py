@@ -173,15 +173,37 @@ class BackendSupervisor:
             if self._state == target and not poisoned:
                 self._reason = reason
                 return
+            prev = self._state
             self._state = target
             self._reason = reason
             self._since = time.time()
             self.metrics.counter("backend_degradations").inc()
             self._gauge_state()
         _log.warning("backend degraded", state=target, reason=reason)
+        # flight recorder: the degradation edge is THE moment the last
+        # minute of evidence matters — record the transition, then dump
+        # the ring (outside the lock; dump does file I/O)
+        self._flight_transition(prev, target, reason, dump=True)
         self._pin_children_to_cpu()
         if not poisoned:
             self._maybe_start_reprobe_loop()
+
+    @staticmethod
+    def _flight_transition(prev: str, new: str, reason: str,
+                           dump: bool = False) -> None:
+        """Record a supervisor transition in the flight recorder and
+        optionally dump the ring.  Best-effort: observability must
+        never alter supervisor behavior."""
+        try:
+            from gatekeeper_tpu.obs.flightrecorder import \
+                get_flight_recorder
+            rec = get_flight_recorder()
+            rec.record("supervisor_transition", frm=prev, to=new,
+                       reason=reason)
+            if dump:
+                rec.dump(f"supervisor:{new}")
+        except Exception:   # noqa: BLE001
+            pass
 
     def reprobe_now(self, timeout_s: float | None = None) -> bool:
         """Synchronous bounded re-probe; True iff the backend is (or
@@ -192,8 +214,10 @@ class BackendSupervisor:
                 return False
             if self._state == HEALTHY:
                 return True
+            prev = self._state
             self._state = RECOVERING
             self._gauge_state()
+        self._flight_transition(prev, RECOVERING, "re-probe")
         if timeout_s is None:
             timeout_s = _env_float("GATEKEEPER_SUPERVISOR_REPROBE_TIMEOUT_S",
                                    DEFAULT_REPROBE_TIMEOUT_S)
@@ -217,6 +241,9 @@ class BackendSupervisor:
                     self._reason = f"{self._reason} (re-probe: {err})" \
                         if "(re-probe:" not in self._reason else self._reason
             self._gauge_state()
+        self._flight_transition(
+            RECOVERING, HEALTHY if ok else DEGRADED,
+            self._reason if ok else "re-probe failed")
         if ok:
             _log.info("backend recovered", platform=platform, n_devices=n)
             self._install_probe_result(True, n, platform)

@@ -198,6 +198,13 @@ def _install_result(res: ProbeResult) -> None:
     global _RESULT
     with _LOCK:
         _RESULT = res
+    try:
+        from gatekeeper_tpu.obs.flightrecorder import record_event
+        record_event("probe_result", ok=res.ok, platform=res.platform,
+                     n_devices=res.n_devices, poisoned=res.poisoned,
+                     reason=res.reason)
+    except Exception:   # noqa: BLE001 — observability is best-effort
+        pass
 
 
 def reprobe(timeout_s: float | None = None) -> ProbeResult:

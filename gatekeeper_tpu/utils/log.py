@@ -29,6 +29,18 @@ from typing import Any
 _ROOT = "gatekeeper_tpu"
 _configured = False
 
+# Optional callable returning ambient context kv (e.g. the active
+# trace/span ids) merged into every log line.  obs/trace.py registers
+# one at import; log stays importable without obs.
+_context_provider = None
+
+
+def set_context_provider(fn) -> None:
+    """Register fn() -> dict | None whose pairs prefix every log
+    line's kv (explicit kv wins on key collision)."""
+    global _context_provider
+    _context_provider = fn
+
 
 def _render(v: Any) -> str:
     if isinstance(v, BaseException):
@@ -58,6 +70,13 @@ class Logger:
 
     def _log(self, level: int, msg: str, kv: dict) -> None:
         if self._inner.isEnabledFor(level):
+            if _context_provider is not None:
+                try:
+                    ctx = _context_provider()
+                except Exception:
+                    ctx = None
+                if ctx:
+                    kv = {**ctx, **kv}
             self._inner.log(level, msg, extra={"kv": kv})
 
     def debug(self, msg: str, /, **kv: Any) -> None:

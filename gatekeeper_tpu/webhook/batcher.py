@@ -23,13 +23,16 @@ from gatekeeper_tpu.utils.metrics import Metrics
 
 
 class _Pending:
-    __slots__ = ("request", "event", "response", "error")
+    __slots__ = ("request", "event", "response", "error", "ctx")
 
-    def __init__(self, request):
+    def __init__(self, request, ctx=None):
         self.request = request
         self.event = threading.Event()
         self.response = None
         self.error: Exception | None = None
+        # submitting request's (trace_id, span_id): the batch span on
+        # the worker thread links back to every member request trace
+        self.ctx = ctx
 
 
 class SubmitTimeout(GatekeeperError):
@@ -90,7 +93,8 @@ class MicroBatcher:
         if self._thread is None:
             # no worker: degrade to a single-request batch inline
             return self.evaluate_batch([request])[0]
-        p = _Pending(request)
+        from gatekeeper_tpu.obs.trace import get_tracer
+        p = _Pending(request, ctx=get_tracer().current())
         with self._wake:
             self._queue.append(p)
             self._wake.notify()
@@ -135,17 +139,29 @@ class MicroBatcher:
                 continue
             self.metrics.counter("admission_batches").inc()
             self.metrics.timer("admission_batch_size").observe(len(batch))
-            if self.prefetch is not None:
+            from gatekeeper_tpu.obs.flightrecorder import record_event
+            from gatekeeper_tpu.obs.trace import get_tracer
+            record_event("admission_batch", size=len(batch))
+            # one batch span on the worker thread; member_traces links
+            # it back to every submitting request's own trace, and the
+            # driver's dispatch span nests under it via the context var
+            with get_tracer().span(
+                    "admission.batch", cat="webhook",
+                    batch_size=len(batch),
+                    member_traces=sorted({p.ctx[0] for p in batch
+                                          if p.ctx is not None})):
+                if self.prefetch is not None:
+                    try:
+                        self.prefetch([p.request for p in batch])
+                    except Exception:   # noqa: BLE001 — warm-up only;
+                        pass            # evaluation applies real policy
                 try:
-                    self.prefetch([p.request for p in batch])
-                except Exception:   # noqa: BLE001 — warm-up only;
-                    pass            # evaluation applies real policy
-            try:
-                responses = self.evaluate_batch([p.request for p in batch])
-                for p, r in zip(batch, responses):
-                    p.response = r
-            except Exception as e:
-                for p in batch:
-                    p.error = e
+                    responses = self.evaluate_batch(
+                        [p.request for p in batch])
+                    for p, r in zip(batch, responses):
+                        p.response = r
+                except Exception as e:
+                    for p in batch:
+                        p.error = e
             for p in batch:
                 p.event.set()

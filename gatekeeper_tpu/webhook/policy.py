@@ -66,13 +66,24 @@ class ValidationHandler:
 
     def handle(self, request: dict) -> dict:
         """AdmissionRequest dict -> AdmissionResponse dict."""
+        from gatekeeper_tpu.obs.trace import get_tracer
         t0 = time.perf_counter()
-        try:
-            return self._handle(request)
-        finally:
-            self.metrics.timer("admission_seconds").observe(
-                time.perf_counter() - t0)
-            self.metrics.counter("admission_requests").inc()
+        kind = request.get("kind") or {}
+        # request root span: each admission gets its own trace; the
+        # batcher records which request traces each batch served
+        with get_tracer().span(
+                "admission.request", cat="webhook",
+                operation=request.get("operation", ""),
+                kind=kind.get("kind", "")) as sp:
+            try:
+                resp = self._handle(request)
+                if sp is not None:
+                    sp.args["allowed"] = bool(resp.get("allowed"))
+                return resp
+            finally:
+                self.metrics.timer("admission_seconds").observe(
+                    time.perf_counter() - t0)
+                self.metrics.counter("admission_requests").inc()
 
     def _handle(self, request: dict) -> dict:
         if is_gk_service_account(request.get("userInfo") or {}):

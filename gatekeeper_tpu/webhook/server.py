@@ -138,6 +138,18 @@ class WebhookServer:
                     self.end_headers()
                     self.wfile.write(payload)
                     return
+                if self.path.split("?", 1)[0] == "/debug/trace":
+                    # Chrome trace-event JSON of the tracer's span ring
+                    # — load in Perfetto / chrome://tracing
+                    import json as _json
+                    from gatekeeper_tpu.obs.trace import get_tracer
+                    payload = _json.dumps(get_tracer().export()).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(payload)))
+                    self.end_headers()
+                    self.wfile.write(payload)
+                    return
                 if self.path != "/metrics":
                     self.send_error(404)
                     return
@@ -145,7 +157,12 @@ class WebhookServer:
                 try:
                     from gatekeeper_tpu.resilience.supervisor import \
                         get_supervisor
-                    text += get_supervisor().metrics.render_prometheus()
+                    # distinct prefix: the supervisor keeps its own
+                    # registry, and several of its names (counters it
+                    # shares spelling with the handler registry) would
+                    # otherwise collide in one exposition
+                    text += get_supervisor().metrics.render_prometheus(
+                        prefix="gatekeeper_supervisor")
                 except Exception:   # noqa: BLE001 — metrics must render
                     pass            # even if the supervisor can't seed
                 payload = text.encode()
