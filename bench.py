@@ -51,6 +51,8 @@ to fire before the driver's external kill timeout).
 
 from __future__ import annotations
 
+import copy
+import hashlib
 import json
 import os
 import random
@@ -195,6 +197,12 @@ def _slim_headline() -> dict:
                              "subprograms_shared", "evaluations_saved",
                              "dedup_parity")
                             if an.get(k) is not None}
+    cs = DETAIL.get("churn_selective")
+    if isinstance(cs, dict):
+        slim["churn_selective"] = {k: cs.get(k) for k in
+                                   ("kinds_skipped", "evaluations_saved",
+                                    "parity", "parity_digest")
+                                   if cs.get(k) is not None}
     tv = DETAIL.get("transval")
     if isinstance(tv, dict):
         slim["transval"] = {k: tv.get(k) for k in
@@ -1101,6 +1109,107 @@ def bench_analysis(detail):
         f"evaluations saved | parity={parity}")
 
 
+def bench_churn_selective(detail):
+    """Stage-5 footprint-driven selective invalidation at library
+    scale: install the full library, ingest a mixed inventory, run to
+    steady state, churn 1% of rows (half annotation-only noise that no
+    library template reads, half image edits that several do), then
+    re-sweep with footprints on vs the GATEKEEPER_FOOTPRINT=off oracle.
+    Verdicts must be bit-identical; the selective sweep reports how
+    many kind-sweeps it skipped and the constraint-evaluations saved
+    (jax_driver's ``footprint`` phase stanza)."""
+    import copy
+    from gatekeeper_tpu.engine import jax_driver as jd_mod
+
+    n = sized(BASELINE_N, 400, 1_000)
+    n_churn = max(n // 100, 1)
+    log(f"[churn-selective] n={n}, churn={n_churn} rows, "
+        "footprints on vs off")
+    rng = random.Random(11)
+    resources = make_mixed(rng, n)
+    opts = QueryOpts(limit_per_constraint=CAP)
+    full_opts = QueryOpts(limit_per_constraint=CAP, full=True)
+
+    def run(fp_mode: str):
+        prev = os.environ.get("GATEKEEPER_FOOTPRINT")
+        os.environ["GATEKEEPER_FOOTPRINT"] = fp_mode
+        saved = jd_mod.SMALL_WORKLOAD_EVALS
+        try:
+            if not FALLBACK:
+                jd_mod.SMALL_WORKLOAD_EVALS = 0
+            work = copy.deepcopy(resources)     # churn mutates rows
+            jd = JaxDriver()
+            c = Backend(jd).new_client([K8sValidationTarget()])
+            for tdoc, cdoc in all_docs():
+                c.add_template(tdoc)
+                c.add_constraint(cdoc)
+            c.add_data_batch(work)
+            jd.query_audit(TARGET_NAME, full_opts)      # compile warm
+            jd.query_audit(TARGET_NAME, opts)           # steady state
+            churn_rng = random.Random(77)
+            for j, i in enumerate(churn_rng.sample(range(n), n_churn)):
+                # fresh object per event (a real watch decodes a new
+                # dict each time); re-upserting the mutated stored
+                # reference trips the store's aliasing guard and
+                # dirties the wildcard root, disabling all skips
+                o = copy.deepcopy(work[i])
+                if j % 2 == 0:
+                    # annotation-only edit: outside every library
+                    # template's read-set — the selective sweep must
+                    # skip the whole library for these rows
+                    o.setdefault("metadata", {}).setdefault(
+                        "annotations", {})["bench-churn"] = f"r{j}"
+                else:
+                    # image edit: inside the repos/tags/digest
+                    # templates' read-sets — those kinds must re-sweep
+                    for cont in (o.get("spec") or {}).get(
+                            "containers") or []:
+                        cont["image"] = f"evil.io/churn:{j}"
+                c.add_data(o)
+            t0 = time.perf_counter()
+            results, _ = jd.query_audit(TARGET_NAME, opts)
+            wall = time.perf_counter() - t0
+            verdicts = sorted(
+                ((r.constraint or {}).get("kind", ""),
+                 ((r.constraint or {}).get("metadata") or {}).get("name", ""),
+                 ((r.resource or {}).get("metadata") or {}).get("name", ""),
+                 r.msg)
+                for r in results)
+            stanza = dict(jd.last_sweep_phases.get("footprint") or {})
+            return verdicts, wall, stanza
+        finally:
+            jd_mod.SMALL_WORKLOAD_EVALS = saved
+            if prev is None:
+                os.environ.pop("GATEKEEPER_FOOTPRINT", None)
+            else:
+                os.environ["GATEKEEPER_FOOTPRINT"] = prev
+
+    v_oracle, oracle_s, _ = run("off")
+    v_sel, sel_s, stanza = run("on")
+    parity = v_oracle == v_sel
+    digest = hashlib.sha256(repr(v_sel).encode()).hexdigest()[:16]
+    detail["churn_selective"] = {
+        "n_resources": n,
+        "churn_rows": n_churn,
+        "kinds_skipped": stanza.get("kinds_skipped", 0),
+        "kinds_evaluated": stanza.get("kinds_evaluated", 0),
+        "evaluations_saved": stanza.get("evaluations_saved", 0),
+        "parity": parity,
+        "parity_digest": digest,
+        "selective_seconds": round(sel_s, 4),
+        "oracle_seconds": round(oracle_s, 4),
+    }
+    log(f"[churn-selective] selective sweep {sel_s*1e3:.0f}ms vs oracle "
+        f"{oracle_s*1e3:.0f}ms | skipped {stanza.get('kinds_skipped', 0)}"
+        f"/{stanza.get('kinds_skipped', 0) + stanza.get('kinds_evaluated', 0)}"
+        f" kind-sweeps, {stanza.get('evaluations_saved', 0)} evaluations "
+        f"saved | parity={parity} digest={digest}")
+    if not parity:
+        raise AssertionError(
+            f"selective-invalidation verdict mismatch: "
+            f"oracle={len(v_oracle)} selective={len(v_sel)}")
+
+
 def bench_transval(detail):
     """Stage-4 translation validation at library scale: certify every
     device-lowered built-in template against the interpreter on its
@@ -1625,6 +1734,8 @@ def main():
     run_phase("external_data", bench_external_data, 300)
     quiesce_upgrades()
     run_phase("analysis", bench_analysis, 300)
+    quiesce_upgrades()
+    run_phase("churn_selective", bench_churn_selective, 300)
     quiesce_upgrades()
     run_phase("transval", bench_transval, 240)
     quiesce_upgrades()

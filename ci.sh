@@ -19,6 +19,10 @@ python -m gatekeeper_tpu.analysis.selflint gatekeeper_tpu/engine gatekeeper_tpu/
 # time.sleep, future .result()) while holding a *_lock in host
 # control-plane code
 python -m gatekeeper_tpu.analysis.selflint --locks gatekeeper_tpu/watch gatekeeper_tpu/controllers gatekeeper_tpu/externaldata
+# rebind-only self-lint: Bindings.arrays / base_dirty are shared with
+# the sweep cache and in-flight futures — engine code must rebind a
+# fresh dict, never mutate in place
+python -m gatekeeper_tpu.analysis.selflint --rebind gatekeeper_tpu/engine
 
 echo "== certify (translation validation over the library) =="
 # Stage-4 translation validation: bounded-model Rego<->IR equivalence
@@ -31,6 +35,24 @@ echo "$CERT" | grep -q " 0 counterexample(s)" \
   || { echo "certify stage found counterexamples" >&2; exit 1; }
 echo "$CERT" | grep -Eq "[1-9][0-9]* certified" \
   || { echo "certify stage certified nothing" >&2; exit 1; }
+
+echo "== footprint (Stage-5 dependency analysis over the library) =="
+# Stage-5 column read-set footprints + perturbation validation: every
+# device-lowered template's claimed read-set must survive perturbation
+# of unclaimed columns bit-identically (0 violations).  rc=1 is the
+# expected warning tier (the library's one cross-row template); rc=2
+# (a violation) fails the build.
+FP_RC=0
+FP=$(JAX_PLATFORMS=cpu timeout -k 10 120 \
+     python -m gatekeeper_tpu.client.probe --footprint --library \
+     | tail -3) || FP_RC=$?
+echo "$FP"
+[ "$FP_RC" -le 1 ] \
+  || { echo "footprint stage failed (rc=$FP_RC)" >&2; exit 1; }
+echo "$FP" | grep -q " 0 violation(s)" \
+  || { echo "footprint stage found violations" >&2; exit 1; }
+echo "$FP" | grep -Eq "[1-9][0-9]* row-local" \
+  || { echo "footprint stage analyzed nothing" >&2; exit 1; }
 
 echo "== tests (virtual 8-device CPU mesh) =="
 python -m pytest tests/ -q
@@ -99,6 +121,10 @@ assert warm["validations"] == 0, \
     f"warm run re-ran translation validation: {warm}"
 assert cold["validations"] > 0, \
     f"cold run never validated (transval off?): {cold}"
+assert warm["footprints"] == 0, \
+    f"warm run re-ran Stage-5 dependency analysis: {warm}"
+assert cold["footprints"] > 0, \
+    f"cold run never analyzed footprints (footprint off?): {cold}"
 assert warm["store_restored"] is True, f"store not restored: {warm}"
 assert warm["verdict_digest"] == cold["verdict_digest"], \
     f"verdicts diverged: cold {cold['verdict_digest']} " \
@@ -152,10 +178,20 @@ assert isinstance(an, dict) and "evaluations_saved" in an \
 to = d.get("trace_overhead")
 assert isinstance(to, dict) and to.get("within_budget") is True, \
     f"no within-budget trace_overhead row in the trailing headline: {d}"
+# the churn_selective row must survive the window: footprint-driven
+# selective invalidation must skip unaffected kind-sweeps with
+# verdicts bit-identical to the GATEKEEPER_FOOTPRINT=off oracle
+cs = d.get("churn_selective")
+assert isinstance(cs, dict) and cs.get("parity") is True \
+    and cs.get("kinds_skipped", 0) > 0 \
+    and cs.get("evaluations_saved", 0) > 0, \
+    f"no churn_selective row (with oracle parity) in the headline: {d}"
 print("bench ok:", d["metric"], round(d["value"], 1), d["unit"],
       f"({len(line)} headline chars; external_data warm "
       f"{xd['warm_seconds']}s vs baseline {xd['baseline_seconds']}s; "
       f"dedup saved {an['evaluations_saved']} evals; tracer overhead "
-      f"{to.get('overhead_fraction')})")
+      f"{to.get('overhead_fraction')}; churn skipped "
+      f"{cs['kinds_skipped']} kinds, saved "
+      f"{cs['evaluations_saved']} evals)")
 EOF
 echo "CI PASS"

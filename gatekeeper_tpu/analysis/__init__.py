@@ -18,6 +18,13 @@ validation: a bounded-model equivalence check of every lowered program
 against the interpreter semantics, emitting a Certificate (persisted
 through the warm-restart snapshot) or a minimal Counterexample that
 joins the ``tests/corpus/transval/`` regression corpus.
+
+Stage 5 (:mod:`.footprint`) is dependency analysis over the lowered
+IR: per-template (kind, column) read-set footprints with sensitivity
+classes, row-locality certificates gating shard_map eligibility, and
+perturbation validation of the claimed read-set — footprints persist
+in the snapshot ``fp`` tier and drive the engine's sweep-time
+selective invalidation against the store's dirty-path log.
 """
 
 from gatekeeper_tpu.analysis.diagnostics import (   # noqa: F401

@@ -44,6 +44,19 @@ unsubscribe outside it) as a CI gate over ``watch/``, ``controllers/``
 and ``externaldata/``: a blocking call under a lock serializes every
 reader behind one slow provider.  Nested function definitions inside
 the ``with`` body are skipped (they run later, not under the lock).
+
+``--rebind`` switches to the REBIND-ONLY checker for engine code:
+``Bindings.arrays`` and ``Bindings.base_dirty`` are shared between the
+sweep cache, the per-kind bindings cache, and in-flight executor
+futures, so they must be REBOUND to a fresh dict (``b.arrays = {**...}``)
+and never mutated in place — an in-place write retroactively changes
+arrays a cached sweep or a queued future already captured.  The rule
+flags subscript stores/deletes (``b.arrays[k] = v``, ``del
+b.arrays[k]``), mutating dict methods (``.update``/``.pop``/
+``.setdefault``/``.clear``/``.popitem``), and ``|=`` augmented
+assignment on any ``<expr>.arrays`` / ``<expr>.base_dirty`` attribute.
+Reads stay legal; this codifies the invariant documented at
+engine/jax_driver.py (previously enforced only by comment).
 """
 
 from __future__ import annotations
@@ -77,6 +90,12 @@ _NONDET_QUALIFIED = {("time", "monotonic"), ("time", "perf_counter"),
 # thread on I/O, a timer, or another thread's completion
 _LOCK_BLOCKING_ATTRS = {"fetch", "fetch_keys", "urlopen", "result"}
 _LOCK_BLOCKING_QUALIFIED = {("time", "sleep")}
+
+# rebind-only rule set (--rebind): attributes that alias shared state
+# (sweep cache, bindings cache, in-flight futures) and therefore must be
+# rebound to a fresh dict, never mutated in place
+_REBIND_ATTRS = {"arrays", "base_dirty"}
+_DICT_MUTATORS = {"update", "setdefault", "pop", "clear", "popitem"}
 
 
 def _dotted(node: ast.AST) -> tuple[str, ...] | None:
@@ -304,6 +323,38 @@ def _lint_lock_tree(tree: ast.Module, path: str) -> list[str]:
     return findings
 
 
+def _is_rebind_attr(node: ast.AST) -> bool:
+    """`<anything>.arrays` / `<anything>.base_dirty` attribute access."""
+    return isinstance(node, ast.Attribute) and node.attr in _REBIND_ATTRS
+
+
+def _lint_rebind_tree(tree: ast.Module, path: str) -> list[str]:
+    """Flag in-place mutation of Bindings.arrays / base_dirty."""
+    findings: list[str] = []
+    for sub in ast.walk(tree):
+        if isinstance(sub, ast.Subscript) \
+                and isinstance(sub.ctx, (ast.Store, ast.Del)) \
+                and _is_rebind_attr(sub.value):
+            verb = "del of" if isinstance(sub.ctx, ast.Del) else "store into"
+            findings.append(
+                f"{path}:{sub.lineno}: in-place {verb} "
+                f".{sub.value.attr}[...] (rebind a fresh dict instead)")
+        elif isinstance(sub, ast.AugAssign) \
+                and _is_rebind_attr(sub.target):
+            findings.append(
+                f"{path}:{sub.lineno}: augmented assignment to "
+                f".{sub.target.attr} (rebind a fresh dict instead)")
+        elif isinstance(sub, ast.Call) \
+                and isinstance(sub.func, ast.Attribute) \
+                and sub.func.attr in _DICT_MUTATORS \
+                and _is_rebind_attr(sub.func.value):
+            findings.append(
+                f"{path}:{sub.lineno}: mutating "
+                f".{sub.func.value.attr}.{sub.func.attr}() "
+                f"(rebind a fresh dict instead)")
+    return findings
+
+
 def _iter_files(paths: list[str]) -> list[str]:
     files: list[str] = []
     for p in paths:
@@ -338,17 +389,25 @@ def lint_lock_paths(paths: list[str]) -> list[str]:
     return _lint_files(paths, _lint_lock_tree)
 
 
+def lint_rebind_paths(paths: list[str]) -> list[str]:
+    return _lint_files(paths, _lint_rebind_tree)
+
+
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     locks = "--locks" in argv
-    argv = [a for a in argv if a != "--locks"]
+    rebind = "--rebind" in argv
+    argv = [a for a in argv if a not in ("--locks", "--rebind")]
     if not argv:
         print("usage: python -m gatekeeper_tpu.analysis.selflint "
-              "[--locks] <dir-or-file>...", file=sys.stderr)
+              "[--locks|--rebind] <dir-or-file>...", file=sys.stderr)
         return 2
     if locks:
         findings = lint_lock_paths(argv)
         kind_msg = "blocking call(s) under _lock"
+    elif rebind:
+        findings = lint_rebind_paths(argv)
+        kind_msg = "in-place mutation(s) of rebind-only state"
     else:
         findings = lint_paths(argv)
         kind_msg = "host-sync call(s) in kernel-side code"

@@ -433,6 +433,20 @@ def run_policyset() -> int:
               f"sites={g['sites']}: {', '.join(g['kinds'])}")
     for d in report["findings"]:
         print("  " + d.format())
+    # Stage-5 row-locality verdicts: cross-row templates are shard_map
+    # ineligible and excluded from footprint-driven selective
+    # invalidation
+    from gatekeeper_tpu.analysis import footprint
+    n_cross_row = 0
+    for kind, low, _c in entries:
+        if low is None:
+            continue
+        fp = footprint.analyze(kind, low)
+        if not fp.row_local:
+            n_cross_row += 1
+            reasons = "; ".join(fp.cross_row_reasons) or "cross-row"
+            print(f"  locality {kind}: cross-row (shard_map ineligible) "
+                  f"— {reasons}")
     top = sorted(report["template_costs"].items(),
                  key=lambda kv: -kv[1]["units"])[:5]
     for kind, cv in top:
@@ -442,7 +456,8 @@ def run_policyset() -> int:
     n_vec = sum(1 for _k, low, _c in entries if low is not None)
     print(f"policyset: {len(entries)} template(s) ({n_vec} lowered), "
           f"{len(groups)} shared subprogram group(s), "
-          f"{len(report['findings'])} finding(s)")
+          f"{len(report['findings'])} finding(s), "
+          f"{n_cross_row} cross-row")
     n_err = sum(1 for d in report["findings"] if d.severity == "error")
     n_warn = sum(1 for d in report["findings"] if d.severity != "error")
     return _severity_rc(n_err, n_warn)
@@ -679,6 +694,100 @@ def run_certify(paths: list[str], use_library: bool = False) -> int:
     return _severity_rc(n_ce + n_err, n_trunc)
 
 
+def run_footprint(paths: list[str], use_library: bool = False) -> int:
+    """``--footprint``: Stage-5 dependency analysis
+    (analysis/footprint.py) over template files and/or the built-in
+    library.  For each device-lowered template, print the column
+    read-set with sensitivity classes, external-provider reads, and
+    the row-locality verdict, then perturbation-validate the footprint
+    against smallmodel worlds; scalar-fallback templates are reported
+    as pinned (no device program, so the whole kind invalidates on any
+    change).  Exit contract (:func:`_severity_rc`): 2 on any footprint
+    violation or unloadable input, 1 when every footprint validated
+    but some template is cross-row (shard_map ineligible, selective
+    invalidation disabled for it), 0 fully row-local and validated."""
+    import sys
+    import time as _time
+
+    import yaml
+
+    from gatekeeper_tpu.analysis import footprint
+    from gatekeeper_tpu.api.templates import compile_target_rego
+    from gatekeeper_tpu.ir.lower import CannotLower, lower_template
+
+    work: list[tuple[str, dict, list]] = []
+    for p in paths:
+        try:
+            with open(p, encoding="utf-8") as fh:
+                loaded = list(yaml.safe_load_all(fh))
+        except (OSError, yaml.YAMLError) as e:
+            print(f"{p}: cannot load: {e}", file=sys.stderr)
+            return 2
+        work.extend((p, d, []) for d in loaded
+                    if isinstance(d, dict)
+                    and d.get("kind") == "ConstraintTemplate")
+    if use_library:
+        from gatekeeper_tpu.library import all_docs
+        work.extend(("<library>", tdoc, [cdoc])
+                    for tdoc, cdoc in all_docs())
+    t0 = _time.perf_counter()
+    n_ok = n_pin = n_cross = n_viol = n_err = 0
+    for label, tdoc, cdocs in work:
+        kind = _doc_kind(tdoc)
+        compiled = lowered = None
+        for tt in ((tdoc.get("spec") or {}).get("targets") or ()):
+            try:
+                compiled = compile_target_rego(
+                    kind, tt.get("target") or "", tt.get("rego") or "")
+                lowered = lower_template(compiled.module, compiled.interp)
+            except CannotLower:
+                lowered = None
+            except Exception as e:      # noqa: BLE001 — parse/compile
+                n_err += 1
+                print(f"  FAIL {kind}: {type(e).__name__}: {e}",
+                      file=sys.stderr)
+                compiled = None
+            break
+        if compiled is None:
+            continue
+        if lowered is None:
+            n_pin += 1
+            print(f"  pin  {kind}: scalar fallback (whole-kind "
+                  "invalidation, shard_map ineligible)")
+            continue
+        try:
+            fp = footprint.analyze(kind, lowered)
+            fp = footprint.maybe_narrowed(kind, fp)
+            found = footprint.validate_footprint(
+                kind, compiled, lowered, fp, constraints=cdocs or None)
+        except Exception as e:          # noqa: BLE001
+            n_err += 1
+            print(f"  FAIL {kind}: analyzer error: {e}", file=sys.stderr)
+            continue
+        verdict = "row-local" if fp.row_local else "CROSS-ROW"
+        tag = "ok  " if fp.row_local else "warn"
+        print(f"  {tag} {kind}: {verdict}, "
+              f"{len(fp.columns)} column(s)"
+              + (f", providers={','.join(fp.providers)}"
+                 if fp.providers else ""))
+        for col in fp.columns:
+            print(f"         reads {col.format()}")
+        if not fp.row_local:
+            n_cross += 1
+            for reason in fp.cross_row_reasons:
+                print(f"         cross-row: {reason}")
+        else:
+            n_ok += 1
+        for v in found:
+            n_viol += 1
+            print(f"  FAIL {v.format()}", file=sys.stderr)
+    wall = _time.perf_counter() - t0
+    print(f"footprint: {len(work)} template(s), {n_ok} row-local, "
+          f"{n_cross} cross-row, {n_pin} pinned, "
+          f"{n_viol} violation(s) in {wall:.1f}s")
+    return _severity_rc(n_viol + n_err, n_cross)
+
+
 def run_health() -> int:
     """``probe --health``: the k8s liveness/readiness consumer.  One
     JSON line with the backend supervisor's serving posture (state,
@@ -755,6 +864,9 @@ def main(argv=None) -> int:
     if "--certify" in argv:
         rest = [a for a in argv if a not in ("--certify", "--library")]
         return run_certify(rest, use_library="--library" in argv)
+    if "--footprint" in argv:
+        rest = [a for a in argv if a not in ("--footprint", "--library")]
+        return run_footprint(rest, use_library="--library" in argv)
     if "--lint" in argv:
         rest = [a for a in argv
                 if a not in ("--lint", "--library", "--strict")]

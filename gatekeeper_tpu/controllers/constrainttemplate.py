@@ -188,6 +188,7 @@ class ReconcileConstraintTemplate(Reconciler):
         if not self._add_template(instance):
             return DONE
         self._transval_status(instance)
+        self._footprint_status(instance)
         self.watcher.add_watch(make_constraint_gvk(_template_kind(instance)))
         try:
             crd_create(self.cluster, crd)
@@ -204,6 +205,7 @@ class ReconcileConstraintTemplate(Reconciler):
         if not self._add_template(instance):
             return DONE
         self._transval_status(instance)
+        self._footprint_status(instance)
         self.watcher.add_watch(make_constraint_gvk(_template_kind(instance)))
         if found.get("apiVersion") == "apiextensions.k8s.io/v1":
             # compare/update in the stored object's shape, not ours
@@ -332,6 +334,30 @@ class ReconcileConstraintTemplate(Reconciler):
                          f"({ce.note}; oracle={ce.expected} "
                          f"device={ce.actual}); pinned to the scalar "
                          "fallback")})
+        set_ha_status(instance, status)
+
+    def _footprint_status(self, instance: dict) -> None:
+        """Stage-5 surface (analysis/footprint.py): templates whose
+        lowered program is NOT row-local — the verdict for row *i*
+        reads other rows' columns (inventory joins, aggregations) —
+        get a ``cross_row_dependency`` warning in
+        ``status.byPod[].warnings``: they are ineligible for
+        resource-axis shard_map and are excluded from footprint-driven
+        selective invalidation (any churn re-evaluates them).
+        Informational, never rejects — cross-row semantics are valid,
+        just unshardable."""
+        from gatekeeper_tpu.analysis import footprint
+        if footprint.mode() == "off":
+            return
+        reason = footprint.locality_for(_template_kind(instance))
+        if reason is None:
+            return
+        status = get_ha_status(instance)
+        status.setdefault("warnings", []).append(
+            {"code": "cross_row_dependency",
+             "message": (f"verdict is not row-local ({reason}); "
+                         "shard_map ineligible, selective invalidation "
+                         "disabled for this template")})
         set_ha_status(instance, status)
 
     @staticmethod

@@ -149,6 +149,28 @@ no-overlap serial number against the pipelined full sweep; it is the
 measurement the pipeline exists to beat.  Never enable in production."""
 
 
+class _ResolvedHandle:
+    """Pre-resolved stand-in for a PendingTopK/PendingMask handle:
+    selective invalidation replays a payload captured on a previous
+    sweep instead of dispatching, and the format path only ever calls
+    ``.get()``/``.block()``."""
+
+    def __init__(self, payload):
+        self._payload = payload
+
+    def get(self):
+        return self._payload
+
+    def block(self):
+        return self
+
+
+SWEEP_CACHE_MAX_BYTES = 64 * 1024 * 1024
+"""Per-kind payload cap for the selective-invalidation sweep cache —
+top-k payloads are tiny; uncapped [C, R] masks at cluster scale are
+not worth holding for a maybe-reuse."""
+
+
 class JaxTargetState(TargetState):
     def __init__(self):
         super().__init__()
@@ -165,6 +187,11 @@ class JaxTargetState(TargetState):
         self.order_cache: tuple | None = None      # (gen, ordered_rows, row_order)
         self.fmt_cache: dict[str, tuple] = {}      # kind -> (con_ver, {(cname,row): (ver, results)})
         self.match_engine = None
+        # kind -> Stage-5 dependency footprint (analysis/footprint.py)
+        self.footprints: dict[str, object] = {}
+        # kind -> last device sweep payload + guards, for
+        # footprint-driven selective invalidation (_selective_reuse)
+        self.sweep_cache: dict[str, dict] = {}
 
     def bump(self, kind: str) -> None:
         self.con_version[kind] = self.con_version.get(kind, 0) + 1
@@ -316,8 +343,55 @@ class JaxDriver(LocalDriver):
             if compiled.vectorized is not None:
                 compiled.vectorized = self._certify_lowered(kind, compiled)
         st = self._state(target)
+        # stage 5 (dependency footprint) also runs on both paths — the
+        # fp snapshot tier keeps warm restarts at zero re-analyses
+        if isinstance(st, JaxTargetState):
+            fp = None
+            if compiled.vectorized is not None:
+                fp = self._footprint_lowered(kind, compiled)
+            if fp is not None:
+                st.footprints[kind] = fp
+            else:
+                st.footprints.pop(kind, None)
+            st.sweep_cache.pop(kind, None)
         st.templates[kind] = compiled
         st.bump(kind)
+
+    def _footprint_lowered(self, kind: str, compiled: CompiledTemplate):
+        """Stage-5 dependency analysis (analysis/footprint.py) behind
+        GATEKEEPER_FOOTPRINT=off|on|strict.  on: compute the read-set /
+        row-locality footprint (enables selective invalidation); strict:
+        additionally perturbation-validate it and FAIL the install on
+        any violation — a violation means the analysis itself is wrong,
+        and serving selective sweeps from a wrong read-set would skip
+        real re-evaluations."""
+        from gatekeeper_tpu.analysis import footprint
+        if footprint.mode() == "off":
+            return None
+        try:
+            fp = footprint.certify(kind, compiled, compiled.vectorized)
+        except Exception as e:   # noqa: BLE001 — analysis must not take
+            # template install down with it; no footprint just means no
+            # selective reuse for this kind
+            from gatekeeper_tpu.utils.log import logger
+            logger("engine.jax_driver").warning(
+                "footprint analysis errored", kind=kind, err=str(e))
+            self.metrics.counter("footprint_errors").inc()
+            return None
+        bad = footprint.violations_for(kind)
+        if bad:
+            self.metrics.counter("footprint_violations").inc(len(bad))
+            if footprint.mode() == "strict":
+                from gatekeeper_tpu.analysis.diagnostics import Diagnostic
+                from gatekeeper_tpu.errors import VetError
+                raise VetError([Diagnostic(code="footprint_violation",
+                                           severity="error",
+                                           message=v.format())
+                                for v in bad])
+            return None
+        if not fp.row_local:
+            self.metrics.counter("footprint_cross_row").inc()
+        return fp
 
     def _certify_lowered(self, kind: str, compiled: CompiledTemplate):
         """Stage-4 translation validation (analysis/transval.py) behind
@@ -389,8 +463,56 @@ class JaxDriver(LocalDriver):
 
     @locked
     def put_constraint(self, target: str, kind: str, name: str, constraint: dict) -> None:
+        self._footprint_constraint(target, kind, constraint)
         super().put_constraint(target, kind, name, constraint)
         self._state(target).bump(kind)
+
+    def _footprint_constraint(self, target: str, kind: str,
+                              constraint: dict) -> None:
+        """Strict-mode footprint re-validation at constraint install.
+
+        The footprint claims to cover EVERY constraint of the kind, but
+        install order puts templates before constraints, so the
+        template-install validation ran against the empty default
+        parameter document — under which many templates never fire and
+        the perturbation check is vacuous.  The first real parameter
+        document is a new operating point: re-certify against it (the
+        memo/snapshot make the honest case free) and reject the
+        constraint if the claimed read-set fails — a wrong read-set
+        would make selective sweeps skip real re-evaluations."""
+        from gatekeeper_tpu.analysis import footprint
+        if footprint.mode() != "strict":
+            return
+        st = self._state(target)
+        if not isinstance(st, JaxTargetState):
+            return
+        compiled = st.templates.get(kind)
+        if compiled is None or compiled.vectorized is None:
+            return
+        try:
+            fp = footprint.certify(kind, compiled, compiled.vectorized,
+                                   constraints=[constraint])
+        except Exception as e:   # noqa: BLE001 — analysis failure only
+            # disables selective reuse for the kind, never the install
+            from gatekeeper_tpu.utils.log import logger
+            logger("engine.jax_driver").warning(
+                "footprint re-validation errored", kind=kind, err=str(e))
+            self.metrics.counter("footprint_errors").inc()
+            st.footprints.pop(kind, None)
+            st.sweep_cache.pop(kind, None)
+            return
+        bad = footprint.violations_for(kind)
+        if bad:
+            self.metrics.counter("footprint_violations").inc(len(bad))
+            st.footprints.pop(kind, None)
+            st.sweep_cache.pop(kind, None)
+            from gatekeeper_tpu.analysis.diagnostics import Diagnostic
+            from gatekeeper_tpu.errors import VetError
+            raise VetError([Diagnostic(code="footprint_violation",
+                                       severity="error",
+                                       message=v.format())
+                            for v in bad])
+        st.footprints[kind] = fp
 
     @locked
     def delete_constraint(self, target: str, kind: str, name: str) -> None:
@@ -520,6 +642,87 @@ class JaxDriver(LocalDriver):
         st.bindings_retired.pop(kind, None)
         st.bindings_cache[kind] = (key, bindings)
         return bindings
+
+    def _selective_reuse(self, st: JaxTargetState, kind: str,
+                         compiled: CompiledTemplate,
+                         constraints: list[dict], limit):
+        """Footprint-driven selective invalidation: return the cached
+        sweep entry + bindings when this kind's verdicts provably
+        cannot have changed since they were captured — no dirty column
+        path (store.table.dirty_paths_since) intersects the template's
+        validated read-set (footprint object paths + the constraint
+        match criteria paths), the key set / row ids / constraint set
+        are unchanged, and the template is row-local with no external
+        providers or inventory reads (their inputs live outside the
+        table's column diff).  Caller holds ``_prep_lock``."""
+        ent = st.sweep_cache.get(kind)
+        if ent is None:
+            return None
+        fp = st.footprints.get(kind)
+        if fp is None or not fp.row_local or fp.providers \
+                or compiled.uses_inventory:
+            return None
+        table = st.table
+        conver = self.con_version_of(st, kind)
+        if ent["conver"] != conver or ent["limit"] != limit \
+                or ent["kgen"] != table.key_generation \
+                or ent["remap"] != table.remap_generation \
+                or ent["n_rows"] != table.n_rows:
+            return None
+        if table.generation != ent["gen"]:
+            if table.namespaces_dirty_since(ent["gen"]):
+                return None
+            changed = table.dirty_paths_since(ent["gen"])
+            if changed is None:     # window predates the path log
+                return None
+            from gatekeeper_tpu.analysis.footprint import (MATCH_PATHS,
+                                                           paths_intersect)
+            read = set(fp.object_paths()) | set(MATCH_PATHS)
+            for c in changed:
+                if any(paths_intersect(c, r) for r in read):
+                    return None
+        hitb = st.bindings_cache.get(kind)
+        if hitb is None or hitb[1] is not ent["bindings"]:
+            return None
+        # refresh the cache key to the current generation: the dirty
+        # columns provably don't feed this kind, so its bindings are
+        # already current.  Safe for later delta chains — a future
+        # update_bindings derives its dirty window from the bindings'
+        # own delta_state, not from this key.
+        st.bindings_cache[kind] = ((table.generation, conver), hitb[1])
+        ent["gen"] = table.generation
+        self.metrics.counter("footprint_kind_sweeps_skipped").inc()
+        return ent, hitb[1]
+
+    def _capture_sweep(self, st: JaxTargetState, kind: str,
+                       compiled: CompiledTemplate, mode: str, spec,
+                       payload, limit) -> None:
+        """Store one kind's resolved device payload + reuse guards so a
+        later churn sweep whose dirty columns miss this kind's read-set
+        can replay it (_selective_reuse).  Only row-local templates
+        without provider/inventory reads are eligible — everything the
+        payload depends on is then visible to the table's column
+        diff."""
+        fp = st.footprints.get(kind)
+        if fp is None or not fp.row_local or fp.providers \
+                or compiled.uses_inventory:
+            return
+        parts = payload if isinstance(payload, tuple) else (payload,)
+        try:
+            nbytes = sum(int(getattr(a, "nbytes", 0)) for a in parts)
+        except Exception:   # noqa: BLE001 — exotic payload: don't cache
+            return
+        if nbytes > SWEEP_CACHE_MAX_BYTES:
+            return
+        table = st.table
+        with self._prep_lock:
+            st.sweep_cache[kind] = {
+                "mode": mode, "payload": payload, "prog": spec[4],
+                "bindings": spec[5], "mask": spec[6],
+                "gen": table.generation, "kgen": table.key_generation,
+                "remap": table.remap_generation, "n_rows": table.n_rows,
+                "conver": self.con_version_of(st, kind), "limit": limit,
+            }
 
     def _ensure_order(self, st):
         """Sorted-cache-key row order (matches the scalar driver) with
@@ -1098,6 +1301,15 @@ class JaxDriver(LocalDriver):
             dedup_shared_cols: dict = {}
             dedup_applied: dict = {}
             dedup_host_s = 0.0
+            # footprint-driven selective invalidation (analysis/
+            # footprint.py): a non-full sweep replays a kind's cached
+            # device payload when no dirty column path intersects its
+            # validated read-set (_selective_reuse).
+            # GATEKEEPER_FOOTPRINT=off is the bit-identical oracle.
+            from gatekeeper_tpu.analysis.footprint import mode as _fp_mode
+            fp_enabled = not self.scalar_only and _fp_mode() != "off"
+            fp_skipped: list[str] = []
+            fp_saved = 0
             _t_pipe = _time.perf_counter()
             try:
                 with self._prep_lock:
@@ -1127,6 +1339,23 @@ class JaxDriver(LocalDriver):
                         constraints = self._kind_constraints(st, kind)
                         if not constraints:
                             continue
+                        if fp_enabled and not full and trace is None:
+                            reuse = self._selective_reuse(
+                                st, kind, compiled, constraints, limit)
+                            if reuse is not None:
+                                ent, bindings = reuse
+                                spec = (ent["mode"], kind, compiled,
+                                        constraints, ent["prog"], bindings,
+                                        ent["mask"])
+                                _prep_done(kind, _tk)
+                                f = concurrent.futures.Future()
+                                f.set_result(_ResolvedHandle(ent["payload"]))
+                                futures.append(f)
+                                specs.append(spec)
+                                fp_skipped.append(kind)
+                                fp_saved += len(ordered_rows) \
+                                    * len(constraints)
+                                continue
                         mask, mask_dirty, padded = self._kind_mask(
                             st, target, kind, constraints)
                         small = self.scalar_only or \
@@ -1225,6 +1454,15 @@ class JaxDriver(LocalDriver):
                     mode, kind, compiled, constraints, prog, bindings, \
                         mask = spec
                     _tf = _time.perf_counter()
+                    # resolve the device payload once: the format path
+                    # reads it through a pre-resolved handle, and a
+                    # fresh (non-replayed) payload is captured for
+                    # footprint-driven reuse on later churn sweeps
+                    payload = None
+                    fresh = not isinstance(handle, _ResolvedHandle)
+                    if handle is not None and mode in ("topk", "mask"):
+                        payload = handle.get()
+                        handle = _ResolvedHandle(payload)
                     try:
                         if mode == "topk":
                             self._format_topk(st, target, handler, compiled,
@@ -1234,7 +1472,7 @@ class JaxDriver(LocalDriver):
                                               rcache)
                         elif mode == "mask":
                             self._format_pairs(st, target, handler, compiled,
-                                               constraints, handle.get(),
+                                               constraints, payload,
                                                row_order, kind, limit, trace,
                                                tagged, rcache)
                         else:
@@ -1247,6 +1485,10 @@ class JaxDriver(LocalDriver):
                         # provider failure: same per-kind containment as
                         # the prep loop
                         m.counter("external_data_kind_failures").inc()
+                    else:
+                        if fp_enabled and fresh and payload is not None:
+                            self._capture_sweep(st, kind, compiled, mode,
+                                                spec, payload, limit)
                     _tf2 = _time.perf_counter()
                     _tracer.add_complete("kind.format", cat="format",
                                          t0=_tf, t1=_tf2,
@@ -1373,6 +1615,17 @@ class JaxDriver(LocalDriver):
                 ext = self._external_sweep_stats(ext_fut)
                 if ext is not None:
                     self.last_sweep_phases["external"] = ext
+            # selective-invalidation stanza (both sweep shapes): how
+            # many kinds replayed a cached payload vs ran, and the
+            # (constraint x row) evaluations that skipping saved
+            self.last_sweep_phases["footprint"] = {
+                "enabled": fp_enabled,
+                "kinds_skipped": len(fp_skipped),
+                "kinds_evaluated": len(specs) - len(fp_skipped),
+                "evaluations_saved": int(fp_saved),
+            }
+            if fp_saved:
+                m.counter("footprint_evaluations_saved").inc(fp_saved)
             if _sweep_sp is not None:
                 _sweep_sp.args["results"] = len(tagged)
             from gatekeeper_tpu.obs.flightrecorder import \

@@ -14,7 +14,9 @@ directory for the cold run) and the warm process must show
 ``restart_persistent_cache_hits > 0``, ``lowerings == 0`` (no Rego
 re-lowering, no re-verification), ``validations == 0`` (every
 translation-validation Certificate restored from the cert snapshot
-tier instead of re-derived), an identical ``verdict_digest``, and
+tier instead of re-derived), ``footprints == 0`` (every Stage-5
+dependency footprint restored from the fp snapshot tier instead of
+re-analyzed), an identical ``verdict_digest``, and
 a substantially smaller ``serving_seconds`` — ci.sh's restart-smoke
 stage asserts exactly that.  The workload is deterministic
 (seeded RNG), so cold and warm evaluate the same inventory whether it
@@ -57,7 +59,7 @@ def main() -> int:
     # imports before the clock starts: interpreter + jax import cost is
     # identical for cold and warm processes and would only dilute the
     # startup ratio the smoke stage asserts on
-    from gatekeeper_tpu.analysis import transval
+    from gatekeeper_tpu.analysis import footprint, transval
     from gatekeeper_tpu.client.client import Backend
     from gatekeeper_tpu.client.interface import QueryOpts
     from gatekeeper_tpu.engine import jax_driver as jd_mod
@@ -115,6 +117,7 @@ def main() -> int:
         "n_results": len(results),
         "verdict_digest": _verdict_digest(results),
         "validations": transval.validations_run,
+        "footprints": footprint.analyses_run,
     }
     print(json.dumps(out))
     return 0

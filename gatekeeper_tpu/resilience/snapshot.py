@@ -68,7 +68,8 @@ class SnapshotStats:
     _FIELDS = ("ir_hits", "ir_misses", "mod_hits", "mod_misses",
                "plan_hits", "plan_misses",
                "store_hits", "store_misses",
-               "cert_hits", "cert_misses", "corrupt_discarded",
+               "cert_hits", "cert_misses",
+               "fp_hits", "fp_misses", "corrupt_discarded",
                "saves", "save_errors")
 
     def __init__(self):
@@ -380,6 +381,31 @@ def save_cert(digest: str, cert) -> bool:
     return _write_entry("cert", f"cert:{digest}", payload)
 
 
+def load_footprint(digest: str):
+    """Sixth tier: Stage-5 dependency footprints, keyed by the
+    footprint digest (program cache_key + prep-spec signature +
+    analyzer version).  A warm restart that reuses the snapshotted
+    lowered IR also reuses its footprint, so it re-runs zero
+    dependency analyses (analysis/footprint.certify)."""
+    if not enabled():
+        return None
+    got = _read_entry("fp", f"fp:{digest}")
+    stats.bump("fp_hits" if got is not None else "fp_misses")
+    return got
+
+
+def save_footprint(digest: str, fp) -> bool:
+    if not enabled():
+        return False
+    try:
+        payload = dumps(fp)
+    except Exception as e:   # noqa: BLE001
+        stats.bump("save_errors")
+        _log.warning("footprint not snapshottable", error=e)
+        return False
+    return _write_entry("fp", f"fp:{digest}", payload)
+
+
 def load_store(target: str):
     if not enabled():
         return None
@@ -408,9 +434,11 @@ def tier_counts(s: dict) -> tuple[int, int]:
     (works on both ``stats.snapshot()`` absolutes and ``delta_since``
     deltas)."""
     hits = (s["ir_hits"] + s["mod_hits"] + s["plan_hits"]
-            + s["store_hits"] + s.get("cert_hits", 0))
+            + s["store_hits"] + s.get("cert_hits", 0)
+            + s.get("fp_hits", 0))
     misses = (s["ir_misses"] + s["mod_misses"] + s["plan_misses"]
-              + s["store_misses"] + s.get("cert_misses", 0))
+              + s["store_misses"] + s.get("cert_misses", 0)
+              + s.get("fp_misses", 0))
     return hits, misses
 
 

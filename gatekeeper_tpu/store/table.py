@@ -23,6 +23,41 @@ from gatekeeper_tpu.store.interner import Interner, MISSING
 DELTA_MAX_FRAC = 0.125
 """Above this dirty fraction a full rebuild beats the delta path."""
 
+PATH_LOG_CAP = 4096
+"""Dirty-path log entries kept; older windows degrade to "unknown"."""
+
+PATH_DIFF_DEPTH = 6
+"""Replace-diff recursion depth; deeper changes report the subtree."""
+
+
+def _diff_paths(old, new, prefix: tuple = (),
+                depth: int = PATH_DIFF_DEPTH) -> set:
+    """Column paths that differ between two versions of one object.
+    Dicts recurse (key union); lists and scalars compare wholesale —
+    a changed list reports the list's own path, which prefix semantics
+    (analysis/footprint.paths_intersect) match against ``base.*.rel``
+    element reads."""
+    if old is new:
+        return set()
+    if isinstance(old, dict) and isinstance(new, dict) and depth > 0:
+        out: set = set()
+        for k in old.keys() | new.keys():
+            if not isinstance(k, str):
+                continue
+            ov, nv = old.get(k), new.get(k)
+            if ov is nv:
+                continue
+            if isinstance(ov, dict) and isinstance(nv, dict):
+                out |= _diff_paths(ov, nv, prefix + (k,), depth - 1)
+            elif ov != nv:
+                out.add(prefix + (k,))
+        return out
+    try:
+        same = old == new
+    except Exception:   # noqa: BLE001 — exotic values: assume changed
+        same = False
+    return set() if same else {prefix or ("",)}
+
 
 def delta_worthwhile(n_dirty: int, n: int) -> bool:
     return n_dirty <= max(64, int(n * DELTA_MAX_FRAC))
@@ -79,6 +114,13 @@ class ResourceTable:
         self._elem_cache: dict[tuple, tuple] = {}   # base -> (gen, counts, cols)
         self._identity_cache: tuple[int, int, IdentityColumns] | None = None
         self._ns_items_cache: tuple[int, dict] | None = None
+        # dirty COLUMN paths per write generation (replace-upserts
+        # only — inserts/removes bump key_generation, which every
+        # selective consumer guards on).  Feeds dirty_paths_since, the
+        # watch-delta side of footprint-driven selective invalidation.
+        self._path_log: list[tuple[int, frozenset]] = []
+        self._path_floor = 0          # windows starting below: unknown
+        self._pending_paths: set[tuple] = set()
 
     # ------------------------------------------------------------------
 
@@ -111,6 +153,18 @@ class ResourceTable:
             self._rows[key] = row
             self.key_generation += 1
         else:
+            old_obj, old_meta = self._objs[row], self._metas[row]
+            if old_meta != meta:
+                self._pending_paths.add(("$meta",))
+            if old_obj is obj:
+                # the caller mutated the STORED object in place and
+                # re-upserted the same reference: the pre-image is
+                # gone, so no diff is computable — record the wildcard
+                # root, which intersects every read-set (selective
+                # consumers re-evaluate everything, never go stale)
+                self._pending_paths.add(("*",))
+            else:
+                self._pending_paths |= _diff_paths(old_obj, obj)
             self._objs[row] = obj
             self._metas[row] = meta
         if meta.kind == "Namespace" and meta.api_version == "v1":
@@ -121,10 +175,21 @@ class ResourceTable:
             self._ns_touched = True
         return row
 
+    def _flush_paths(self) -> None:
+        if self._pending_paths:
+            self._path_log.append((self.generation,
+                                   frozenset(self._pending_paths)))
+            self._pending_paths = set()
+            if len(self._path_log) > PATH_LOG_CAP:
+                drop = len(self._path_log) // 2
+                self._path_floor = self._path_log[drop - 1][0]
+                del self._path_log[:drop]
+
     def upsert(self, key: str, obj: dict, meta: ResourceMeta) -> int:
         row = self._place(key, obj, meta)
         self.generation += 1
         self._ver[row] = self.generation
+        self._flush_paths()
         if self._ns_touched:
             self.ns_generation = self.generation
             self._ns_touched = False
@@ -136,6 +201,7 @@ class ResourceTable:
             dirty.append(self._place(key, obj, meta))
         self.generation += 1
         self._ver[dirty] = self.generation
+        self._flush_paths()
         if self._ns_touched:
             self.ns_generation = self.generation
             self._ns_touched = False
@@ -168,9 +234,12 @@ class ResourceTable:
         self._elem_cache.clear()
         self._identity_cache = None
         self._ns_items_cache = None
+        self._path_log.clear()
+        self._pending_paths.clear()
         self.generation += 1
         self.remap_generation += 1
         self.key_generation += 1
+        self._path_floor = self.generation
         self.ns_generation = self.generation
 
     def compact(self) -> None:
@@ -182,9 +251,12 @@ class ResourceTable:
             new_metas.append(self._metas[row])
         self._objs, self._metas, self._rows = new_objs, new_metas, new_rows
         self._free = []
+        self._path_log.clear()
+        self._pending_paths.clear()
         self.generation += 1
         self.remap_generation += 1
         self.key_generation += 1
+        self._path_floor = self.generation
         self.ns_generation = self.generation
         self._ns_rows = {row for row, m in enumerate(new_metas)
                          if m is not None and m.kind == "Namespace"
@@ -232,6 +304,21 @@ class ResourceTable:
         while remap_generation is unchanged (row ids stable)."""
         n = len(self._objs)
         return np.nonzero(self._ver[:n] > gen)[0]
+
+    def dirty_paths_since(self, gen: int) -> frozenset | None:
+        """Union of column paths changed by replace-upserts after
+        generation ``gen``, or None when the window predates the log
+        (caller must assume everything changed).  Inserts and removes
+        are NOT logged — they bump ``key_generation``, which selective
+        consumers must guard on separately."""
+        if gen < self._path_floor:
+            return None
+        out: set = set()
+        for g, paths in reversed(self._path_log):
+            if g <= gen:
+                break
+            out |= paths
+        return frozenset(out)
 
     # ------------------------------------------------------------------
 

@@ -307,9 +307,11 @@ class TestAttribution:
         text = jd.metrics.render_prometheus()
         assert ('gatekeeper_template_device_seconds'
                 '{template="K8sRequiredLabels"}') in text
-        # memoized follow-up sweeps keep the lean phases dict
+        # memoized follow-up sweeps keep the lean phases dict (plus the
+        # Stage-5 selective-invalidation stanza)
         _audit(jd, full=False)
-        assert jd.last_sweep_phases == {"full": False}
+        assert jd.last_sweep_phases["full"] is False
+        assert set(jd.last_sweep_phases) <= {"full", "footprint"}
 
 
 # ----------------------------------------------------------------------
