@@ -210,6 +210,15 @@ def _slim_headline() -> dict:
                              "templates_certified", "counterexamples",
                              "models_checked")
                             if tv.get(k) is not None}
+    sh = DETAIL.get("shard_sim")
+    if isinstance(sh, dict):
+        ss = {k: sh.get(k) for k in ("parity", "parity_digest")
+              if sh.get(k) is not None}
+        s2 = sh.get("shards_2")
+        if isinstance(s2, dict):
+            ss["kinds_sharded"] = s2.get("kinds_sharded")
+            ss["collectives"] = s2.get("collectives")
+        slim["shard_sim"] = ss
     if DETAIL.get("aborted"):
         slim["aborted"] = DETAIL["aborted"]
     return slim
@@ -1210,6 +1219,125 @@ def bench_churn_selective(detail):
             f"oracle={len(v_oracle)} selective={len(v_sel)}")
 
 
+_SHARD_SIM_CHILD = r"""
+import copy, hashlib, json, os, random, sys, time
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+try:
+    jax.config.update("jax_num_cpu_devices", 4)
+except Exception:
+    pass    # XLA_FLAGS fallback came in via the environment
+sys.path.insert(0, os.environ["SHARD_SIM_REPO"])
+from gatekeeper_tpu.engine import jax_driver as jd_mod
+jd_mod.SMALL_WORKLOAD_EVALS = 0
+from gatekeeper_tpu.client.client import Backend
+from gatekeeper_tpu.client.interface import QueryOpts
+from gatekeeper_tpu.library import all_docs, make_mixed
+from gatekeeper_tpu.target.k8s import K8sValidationTarget, TARGET_NAME
+
+n = int(os.environ["SHARD_SIM_N"])
+resources = make_mixed(random.Random(17), n)
+opts = QueryOpts(limit_per_constraint=20, full=True)
+
+def digest_of(results):
+    verdicts = sorted(
+        ((r.constraint or {}).get("kind", ""),
+         ((r.constraint or {}).get("metadata") or {}).get("name", ""),
+         ((r.resource or {}).get("metadata") or {}).get("name", ""),
+         r.msg)
+        for r in results)
+    return hashlib.sha256(repr(verdicts).encode()).hexdigest()[:16]
+
+out = {"n": n}
+for ns in (1, 2, 4):
+    os.environ["GATEKEEPER_SHARDS"] = str(ns)
+    jd = jd_mod.JaxDriver()
+    c = Backend(jd).new_client([K8sValidationTarget()])
+    for tdoc, cdoc in all_docs():
+        c.add_template(tdoc)
+        c.add_constraint(cdoc)
+    c.add_data_batch(copy.deepcopy(resources))
+    jd.query_audit(TARGET_NAME, opts)           # compile warm
+    t0 = time.perf_counter()
+    results, _ = jd.query_audit(TARGET_NAME, opts)
+    wall = time.perf_counter() - t0
+    out[str(ns)] = {"digest": digest_of(results),
+                    "n_results": len(results),
+                    "wall_seconds": round(wall, 4),
+                    "stanza": jd.last_sweep_phases.get("shard") or {}}
+print(json.dumps(out))
+"""
+
+
+def bench_shard_sim(detail):
+    """Stage-6 plan-driven simulated-mesh sweep at library scale: the
+    full library over a mixed inventory on 2- and 4-shard simulated
+    CPU meshes (GATEKEEPER_SHARDS=N) vs the unsharded oracle
+    (GATEKEEPER_SHARDS=1), in ONE subprocess pinned to 4 CPU devices
+    (the device count is frozen at first backend use, so the parent
+    process cannot host this).  A PARITY row per the ROADMAP caveat —
+    simulated shards on cpu measure correctness and collective
+    plumbing, not device speed.  Verdicts must be bit-identical
+    (sha256 digest) across all three sweeps."""
+    import subprocess
+
+    from gatekeeper_tpu.utils.device_probe import child_env
+
+    n = sized(BASELINE_N, 400, 1_000)
+    log(f"[shard-sim] n={n}, shards 2 and 4 vs unsharded oracle "
+        "(subprocess, 4 cpu devices)")
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env = child_env(dict(os.environ))
+    env["JAX_PLATFORMS"] = "cpu"
+    env["SHARD_SIM_REPO"] = repo
+    env["SHARD_SIM_N"] = str(n)
+    env.pop("GATEKEEPER_SHARDS", None)
+    flags = env.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=4").strip()
+    proc = subprocess.run(
+        [sys.executable, "-c", _SHARD_SIM_CHILD], env=env, cwd=repo,
+        capture_output=True, text=True, timeout=280)
+    if proc.returncode != 0:
+        raise AssertionError(
+            f"shard_sim child failed rc={proc.returncode}: "
+            f"{proc.stderr[-800:]}")
+    data = json.loads(proc.stdout.strip().splitlines()[-1])
+    oracle = data["1"]["digest"]
+    row = {"n_resources": data["n"], "oracle_digest": oracle,
+           "oracle_seconds": data["1"]["wall_seconds"]}
+    parity = True
+    for ns in ("2", "4"):
+        d = data[ns]
+        stanza = d["stanza"]
+        ok = d["digest"] == oracle
+        parity = parity and ok
+        row[f"shards_{ns}"] = {
+            "parity": ok,
+            "digest": d["digest"],
+            "wall_seconds": d["wall_seconds"],
+            "mesh_shards": stanza.get("shards", 0),
+            "kinds_sharded": stanza.get("kinds_sharded", 0),
+            "kinds_replicated": stanza.get("kinds_replicated", 0),
+            "per_shard_evals": stanza.get("per_shard_evals", 0),
+            "collectives": stanza.get("collectives", 0),
+        }
+        log(f"[shard-sim] {ns} shards: parity={ok} "
+            f"digest={d['digest']} "
+            f"sharded={stanza.get('kinds_sharded', 0)} "
+            f"replicated={stanza.get('kinds_replicated', 0)} "
+            f"per_shard_evals={stanza.get('per_shard_evals', 0)} "
+            f"collectives={stanza.get('collectives', 0)}")
+    row["parity"] = parity
+    row["parity_digest"] = oracle
+    detail["shard_sim"] = row
+    if not parity:
+        raise AssertionError(
+            f"shard_sim parity mismatch vs oracle {oracle}: "
+            + ", ".join(f"{ns}={data[ns]['digest']}" for ns in ("2", "4")))
+
+
 def bench_transval(detail):
     """Stage-4 translation validation at library scale: certify every
     device-lowered built-in template against the interpreter on its
@@ -1738,6 +1866,8 @@ def main():
     run_phase("churn_selective", bench_churn_selective, 300)
     quiesce_upgrades()
     run_phase("transval", bench_transval, 240)
+    quiesce_upgrades()
+    run_phase("shard_sim", bench_shard_sim, 300)
     quiesce_upgrades()
     run_phase("regex_heavy", bench_regex_heavy, 300)
     run_phase("selector_heavy", bench_selector_heavy, 300)

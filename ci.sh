@@ -19,6 +19,10 @@ python -m gatekeeper_tpu.analysis.selflint gatekeeper_tpu/engine gatekeeper_tpu/
 # time.sleep, future .result()) while holding a *_lock in host
 # control-plane code
 python -m gatekeeper_tpu.analysis.selflint --locks gatekeeper_tpu/watch gatekeeper_tpu/controllers gatekeeper_tpu/externaldata
+# lock-order self-lint: the lock-acquisition graph (lexical nesting +
+# calls made while holding a lock) must stay acyclic, or two threads
+# taking the same pair in opposite order can deadlock
+python -m gatekeeper_tpu.analysis.selflint --lockorder gatekeeper_tpu/engine gatekeeper_tpu/watch gatekeeper_tpu/externaldata
 # rebind-only self-lint: Bindings.arrays / base_dirty are shared with
 # the sweep cache and in-flight futures — engine code must rebind a
 # fresh dict, never mutate in place
@@ -53,6 +57,25 @@ echo "$FP" | grep -q " 0 violation(s)" \
   || { echo "footprint stage found violations" >&2; exit 1; }
 echo "$FP" | grep -Eq "[1-9][0-9]* row-local" \
   || { echo "footprint stage analyzed nothing" >&2; exit 1; }
+
+echo "== shardplan (Stage-6 partition plans over the library) =="
+# Stage-6 sharding certifier: every device-lowered template gets a
+# resource-axis partition plan (collectives + padding + per-shard
+# layout) validated on a 2-shard simulated mesh against the unsharded
+# oracle.  rc=1 is the expected warning tier (the cross-row template
+# plus the scalar pin); rc=2 (a parity violation) fails the build, and
+# the library must keep >= 40 of its templates shard-eligible.
+SP_RC=0
+SP=$(JAX_PLATFORMS=cpu GATEKEEPER_SHARDPLAN=strict timeout -k 10 240 \
+     python -m gatekeeper_tpu.client.probe --shardplan --library \
+     | tail -3) || SP_RC=$?
+echo "$SP"
+[ "$SP_RC" -le 1 ] \
+  || { echo "shardplan stage failed (rc=$SP_RC)" >&2; exit 1; }
+echo "$SP" | grep -q " 0 violation(s)" \
+  || { echo "shardplan stage found violations" >&2; exit 1; }
+echo "$SP" | grep -Eq "(4[0-9]|[5-9][0-9]|[0-9]{3,}) shard-eligible" \
+  || { echo "shardplan stage certified < 40 shard-eligible" >&2; exit 1; }
 
 echo "== tests (virtual 8-device CPU mesh) =="
 python -m pytest tests/ -q
@@ -125,6 +148,10 @@ assert warm["footprints"] == 0, \
     f"warm run re-ran Stage-5 dependency analysis: {warm}"
 assert cold["footprints"] > 0, \
     f"cold run never analyzed footprints (footprint off?): {cold}"
+assert warm["shardplans"] == 0, \
+    f"warm run re-ran Stage-6 partition-plan analysis: {warm}"
+assert cold["shardplans"] > 0, \
+    f"cold run never planned shards (shardplan off?): {cold}"
 assert warm["store_restored"] is True, f"store not restored: {warm}"
 assert warm["verdict_digest"] == cold["verdict_digest"], \
     f"verdicts diverged: cold {cold['verdict_digest']} " \
@@ -186,12 +213,19 @@ assert isinstance(cs, dict) and cs.get("parity") is True \
     and cs.get("kinds_skipped", 0) > 0 \
     and cs.get("evaluations_saved", 0) > 0, \
     f"no churn_selective row (with oracle parity) in the headline: {d}"
+# the shard_sim row must survive the window: the plan-driven 2/4-shard
+# simulated-mesh sweep must be bit-identical to the unsharded oracle
+sh = d.get("shard_sim")
+assert isinstance(sh, dict) and sh.get("parity") is True \
+    and sh.get("kinds_sharded", 0) >= 40, \
+    f"no shard_sim parity row in the trailing headline: {d}"
 print("bench ok:", d["metric"], round(d["value"], 1), d["unit"],
       f"({len(line)} headline chars; external_data warm "
       f"{xd['warm_seconds']}s vs baseline {xd['baseline_seconds']}s; "
       f"dedup saved {an['evaluations_saved']} evals; tracer overhead "
       f"{to.get('overhead_fraction')}; churn skipped "
       f"{cs['kinds_skipped']} kinds, saved "
-      f"{cs['evaluations_saved']} evals)")
+      f"{cs['evaluations_saved']} evals; shard_sim parity "
+      f"{sh['parity_digest']} with {sh['kinds_sharded']} kinds sharded)")
 EOF
 echo "CI PASS"

@@ -25,6 +25,14 @@ classes, row-locality certificates gating shard_map eligibility, and
 perturbation validation of the claimed read-set — footprints persist
 in the snapshot ``fp`` tier and drive the engine's sweep-time
 selective invalidation against the store's dirty-path log.
+
+Stage 6 (:mod:`.shardplan`) is the sharding certifier: an abstract
+interpreter propagates a row-sharded/replicated state through every
+SSA value under a resource-axis partition and emits per-template
+PartitionPlan certificates (required collectives, padding constraints,
+per-shard H2D layout), validated on a 2-shard simulated mesh and
+persisted in the snapshot ``sp`` tier — the engine's plan-driven
+sweep behind ``GATEKEEPER_SHARDS=N`` consumes them.
 """
 
 from gatekeeper_tpu.analysis.diagnostics import (   # noqa: F401

@@ -45,6 +45,12 @@ and ``externaldata/``: a blocking call under a lock serializes every
 reader behind one slow provider.  Nested function definitions inside
 the ``with`` body are skipped (they run later, not under the lock).
 
+``--lockorder`` builds the lock-ACQUISITION-ORDER graph over the whole
+fileset (an edge A -> B when some path acquires B while holding A,
+lexically or through statically-resolvable calls) and fails on any
+cycle — the deadlock-capable ordering two threads can interleave.  See
+:func:`lint_lockorder_paths` for the over-approximation rules.
+
 ``--rebind`` switches to the REBIND-ONLY checker for engine code:
 ``Bindings.arrays`` and ``Bindings.base_dirty`` are shared between the
 sweep cache, the per-kind bindings cache, and in-flight executor
@@ -323,6 +329,143 @@ def _lint_lock_tree(tree: ast.Module, path: str) -> list[str]:
     return findings
 
 
+def _callee_name(call: ast.Call) -> str | None:
+    """Statically resolvable callee for the lock-order call graph:
+    plain names (module functions) and ``self.<method>`` calls; other
+    attribute calls cannot be resolved and are skipped."""
+    if isinstance(call.func, ast.Name):
+        return call.func.id
+    if isinstance(call.func, ast.Attribute) \
+            and isinstance(call.func.value, ast.Name) \
+            and call.func.value.id == "self":
+        return call.func.attr
+    return None
+
+
+def lint_lockorder_paths(paths: list[str]) -> list[str]:
+    """``--lockorder``: whole-fileset lock-ACQUISITION-ORDER checker.
+
+    Builds the acquisition graph from the AST: an edge ``A -> B``
+    means some code path acquires lock ``B`` (by its final attribute
+    name, e.g. ``_prep_lock``) while holding ``A`` — either lexically
+    (a nested ``with``) or interprocedurally (a call made under ``A``
+    into a function whose transitive closure acquires ``B``).  A cycle
+    in that graph is a deadlock-capable ordering (thread 1 holds A
+    wanting B, thread 2 holds B wanting A) and is reported as a
+    finding.  Names merge per final segment and per bare callee name
+    across the fileset — a deliberate over-approximation, like the
+    rest of this lint; self-loops are skipped (same-name locks on
+    distinct instances, and RLock re-entry, would drown the signal)."""
+    fn_acquires: dict[str, set[str]] = {}
+    fn_calls: dict[str, set[str]] = {}
+    edges: dict[tuple[str, str], str] = {}   # (held, acquired) -> witness
+    call_under: list[tuple[str, str, str]] = []   # (held, callee, site)
+
+    def harvest(fn_node: ast.AST, path: str) -> None:
+        acquires = fn_acquires.setdefault(fn_node.name, set())
+        calls = fn_calls.setdefault(fn_node.name, set())
+
+        def walk(node: ast.AST, held: tuple[str, ...]) -> None:
+            if node is not fn_node and isinstance(
+                    node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.Lambda)):
+                return      # runs later, not under the held locks
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                got: list[str] = []
+                for item in node.items:
+                    walk(item.context_expr, held)
+                    ln = _lock_name(item)
+                    if ln is None:
+                        continue
+                    lk = ln.rsplit(".", 1)[-1]
+                    got.append(lk)
+                    acquires.add(lk)
+                    for h in held:
+                        if h != lk:
+                            edges.setdefault(
+                                (h, lk), f"{path}:{node.lineno}")
+                held2 = held + tuple(got)
+                for stmt in node.body:
+                    walk(stmt, held2)
+                return
+            if isinstance(node, ast.Call):
+                cal = _callee_name(node)
+                if cal is not None:
+                    calls.add(cal)
+                    for h in held:
+                        call_under.append(
+                            (h, cal, f"{path}:{node.lineno}"))
+            for child in ast.iter_child_nodes(node):
+                walk(child, held)
+
+        walk(fn_node, ())
+
+    for f in _iter_files(paths):
+        with open(f, encoding="utf-8") as fh:
+            src = fh.read()
+        try:
+            tree = ast.parse(src, filename=f)
+        except SyntaxError as e:
+            return [f"{f}:{e.lineno}: syntax error: {e.msg}"]
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                harvest(node, f)
+
+    # transitive lock closure per (bare) function name
+    fn_locks: dict[str, set[str]] = {}
+
+    def locks_of(name: str, seen: set[str]) -> set[str]:
+        got = fn_locks.get(name)
+        if got is not None:
+            return got
+        if name in seen:
+            return set()
+        seen.add(name)
+        out = set(fn_acquires.get(name, ()))
+        for cal in fn_calls.get(name, ()):
+            if cal in fn_acquires:
+                out |= locks_of(cal, seen)
+        return out
+
+    for name in fn_acquires:
+        fn_locks[name] = locks_of(name, set())
+
+    for held, cal, site in call_under:
+        for lk in fn_locks.get(cal, ()):
+            if lk != held:
+                edges.setdefault((held, lk), f"{site} (via {cal})")
+
+    adj: dict[str, set[str]] = {}
+    for a, b in edges:
+        adj.setdefault(a, set()).add(b)
+
+    findings: list[str] = []
+    visited: set[str] = set()
+
+    def dfs(n: str, stack: list[str], onstack: set[str]) -> None:
+        visited.add(n)
+        onstack.add(n)
+        stack.append(n)
+        for m in sorted(adj.get(n, ())):
+            if m in onstack:
+                i = stack.index(m)
+                cyc = stack[i:] + [m]
+                wit = "; ".join(
+                    edges.get((cyc[j], cyc[j + 1]), "?")
+                    for j in range(len(cyc) - 1))
+                findings.append(
+                    f"lock-order cycle: {' -> '.join(cyc)} ({wit})")
+            elif m not in visited:
+                dfs(m, stack, onstack)
+        onstack.discard(n)
+        stack.pop()
+
+    for n in sorted(adj):
+        if n not in visited:
+            dfs(n, [], set())
+    return findings
+
+
 def _is_rebind_attr(node: ast.AST) -> bool:
     """`<anything>.arrays` / `<anything>.base_dirty` attribute access."""
     return isinstance(node, ast.Attribute) and node.attr in _REBIND_ATTRS
@@ -396,15 +539,21 @@ def lint_rebind_paths(paths: list[str]) -> list[str]:
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     locks = "--locks" in argv
+    lockorder = "--lockorder" in argv
     rebind = "--rebind" in argv
-    argv = [a for a in argv if a not in ("--locks", "--rebind")]
+    argv = [a for a in argv if a not in ("--locks", "--lockorder",
+                                         "--rebind")]
     if not argv:
         print("usage: python -m gatekeeper_tpu.analysis.selflint "
-              "[--locks|--rebind] <dir-or-file>...", file=sys.stderr)
+              "[--locks|--lockorder|--rebind] <dir-or-file>...",
+              file=sys.stderr)
         return 2
     if locks:
         findings = lint_lock_paths(argv)
         kind_msg = "blocking call(s) under _lock"
+    elif lockorder:
+        findings = lint_lockorder_paths(argv)
+        kind_msg = "lock-acquisition-order cycle(s)"
     elif rebind:
         findings = lint_rebind_paths(argv)
         kind_msg = "in-place mutation(s) of rebind-only state"

@@ -1,0 +1,435 @@
+"""Stage-6 sharding certifier: static partition plans for the sweep.
+
+Stage 5 (:mod:`.footprint`) proves *which* templates are row-local —
+shard-eligible in principle.  This stage proves *how* each lowered
+program partitions under a resource-axis split: an abstract
+interpreter propagates a sharding state (``row-sharded`` |
+``replicated``) through every SSA value of the lowered IR and emits a
+per-template :class:`PartitionPlan` certificate naming
+
+  * the per-node sharding states (elementwise/compare ops stay
+    sharded; gathers into replicated param/provider tables stay
+    sharded because only the *index* is row-partitioned; element-axis
+    reductions stay per-row);
+  * the named collectives the serving reduction needs — the per-shard
+    violation counts are a partial-reduce closed by one
+    ``all_reduce`` over ``r``, and the capped top-k rows/scores need
+    an ``all_gather`` each (exactly the psum + two all_gathers in
+    ``parallel.sharding._topk_local_step``);
+  * the pad-to-multiple-of-shard-count constraints and the per-shard
+    H2D layout: each binding's partition axes per
+    ``ir.prep.binding_axes``.
+
+Anything consuming a CROSS-ROW footprint (the inventory join) is
+certified *ineligible* with the footprint's reason — its verdict
+reads other rows, so a row split changes semantics.
+
+Plans are *validated, not trusted*: ``validate_plan`` executes the
+plan on a 2-shard simulated mesh (``shard_map`` over CPU devices)
+across the Stage-4 small-model worlds and demands a bit-identical
+violation mask plus count/top-k parity vs the unsharded oracle.  Any
+difference is a ShardPlanViolation; under ``GATEKEEPER_SHARDPLAN=
+strict`` the engine pins the template to the replicated path (install
+never fails on this stage).  Validated plans persist in the snapshot
+"sp" tier — the seventh — so a warm restart re-runs zero analyses.
+
+The engine consumes plans for the plan-driven simulated sweep behind
+``GATEKEEPER_SHARDS=N``: eligible kinds run sharded, ineligible ones
+pin to the replicated (single-device) path, bit-identically.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import os
+
+import numpy as np
+
+from gatekeeper_tpu.utils.log import logger
+
+log = logger("shardplan")
+
+SHARDPLAN_VERSION = "sp-1"
+
+# fresh analyses this process (mirrors footprint.analyses_run): the
+# restart smoke asserts a warm process re-analyzes nothing
+analyses_run = 0
+
+_memo: dict[str, "PartitionPlan"] = {}
+
+# kind -> most recently published plan (memoized or not)
+plans: dict[str, "PartitionPlan"] = {}
+
+# kind -> human reason, for templates whose plans are shard-ineligible.
+# Consumed by the reconciler (status.byPod[] finding) and the probe.
+ineligible: dict[str, str] = {}
+
+# kind -> violations from the most recent strict-mode validation
+violations: dict[str, list["ShardPlanViolation"]] = {}
+
+SHARDED = "row-sharded"
+REPLICATED = "replicated"
+
+# the serving reduction over a row-sharded verdict matrix: per-shard
+# counts are a partial-reduce closed by one all_reduce; the capped
+# top-k needs its rows and scores gathered (see _topk_local_step)
+_SERVING_COLLECTIVES: tuple[tuple[str, str, str], ...] = (
+    ("all_reduce", "r", "violation_counts"),
+    ("all_gather", "r", "topk_rows"),
+    ("all_gather", "r", "topk_scores"),
+)
+
+# pad_bindings_for_mesh's contract, stated as certificate constraints
+_PAD_CONSTRAINTS: tuple[str, ...] = (
+    "r_pad % r_shards == 0",
+    "c_pad % c_shards == 0",
+    "fill:int32=-1",
+    "fill:other=0",
+)
+
+# framework bindings the prepped arrays always carry alongside the
+# spec-derived ones (engine/veval gating + rank order)
+_FRAMEWORK_BINDINGS: tuple[str, ...] = (
+    "__match__", "__alive__", "__rank__", "__cvalid__",
+)
+
+
+def mode() -> str:
+    """off | warn | strict.  ``warn`` (default) runs the static
+    analysis at install and lets the sharded sweep consume plans;
+    ``strict`` additionally executes every eligible plan on a 2-shard
+    simulated mesh at install and pins any invalid plan to the
+    replicated path; ``off`` disables analysis and plan gating (the
+    oracle: everything shards exactly as before this stage)."""
+    return os.environ.get("GATEKEEPER_SHARDPLAN", "warn").strip().lower()
+
+
+def validation_budget() -> int:
+    return int(os.environ.get("GATEKEEPER_SHARDPLAN_MODELS", "16"))
+
+
+# ---------------------------------------------------------------------------
+# results
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardPlanViolation:
+    """Simulated-mesh validation found a divergence between the plan's
+    sharded execution and the unsharded oracle — an analysis bug (or a
+    deliberately broken plan via the TEST_BREAK seam)."""
+
+    kind: str
+    note: str = ""
+
+    def format(self) -> str:
+        return f"{self.kind}: sharded execution diverged ({self.note})"
+
+
+@dataclasses.dataclass(frozen=True)
+class PartitionPlan:
+    """Per-template sharding certificate under a resource-axis split.
+
+    ``node_shardings`` records the abstract state of every reachable
+    IR node; ``collectives`` the (op, axis, operand) reductions the
+    serving step needs; ``padding`` the divisibility/fill constraints
+    each shard's H2D layout must satisfy; ``layout`` the per-binding
+    partition axes (None = replicated dim)."""
+
+    kind: str
+    digest: str
+    eligible: bool
+    reason: str = ""
+    node_shardings: tuple[tuple[int, str], ...] = ()
+    collectives: tuple[tuple[str, str, str], ...] = ()
+    padding: tuple[str, ...] = ()
+    layout: tuple[tuple[str, tuple], ...] = ()
+    validated: bool = False
+    shards_validated: int = 0
+    version: str = SHARDPLAN_VERSION
+
+
+# ---------------------------------------------------------------------------
+# digest (snapshot key)
+
+
+def shardplan_digest(lowered) -> str:
+    from gatekeeper_tpu.analysis.footprint import _spec_sig
+    parts = (SHARDPLAN_VERSION, repr(lowered.program.cache_key()),
+             repr(_spec_sig(lowered.spec)))
+    return hashlib.sha256("\x1f".join(parts).encode()).hexdigest()
+
+
+# ---------------------------------------------------------------------------
+# the abstract interpreter
+
+
+def _cross_row_reason(kind: str, name: str, ij) -> str:
+    """Prefer the footprint's published reason (put_template runs
+    Stage 5 first); self-derive the identical text otherwise."""
+    from gatekeeper_tpu.analysis import footprint
+    got = footprint.locality_for(kind)
+    if got:
+        return got
+    return (f"inventory join {name}: ∃ other {ij.kind} with "
+            f"{'.'.join(ij.inv_path)} == this {'.'.join(ij.src_path)}")
+
+
+def analyze(kind: str, lowered) -> PartitionPlan:
+    """Propagate the sharding lattice through the nodes reachable from
+    the rule conjuncts and derive the partition plan.  The analysis is
+    per-op: inputs take their binding's partition axes (``r`` present
+    → row-sharded); table/ptable gathers follow their index operand
+    (the table itself is replicated); element-axis reductions stay
+    per-row; everything else joins its args (any sharded operand makes
+    the result sharded).  No IR op reduces over ``r`` — the only
+    cross-shard dependency inside a program is the inventory join,
+    which makes the whole template ineligible."""
+    from gatekeeper_tpu.analysis.costmodel import reachable_nodes
+    from gatekeeper_tpu.ir.prep import binding_axes
+
+    spec = lowered.spec
+    prog = lowered.program
+    by_ij = {j.name: j for j in spec.inv_joins}
+    digest = shardplan_digest(lowered)
+
+    def ineligible_plan(reason: str) -> PartitionPlan:
+        return PartitionPlan(kind=kind, digest=digest, eligible=False,
+                             reason=reason, padding=_PAD_CONSTRAINTS)
+
+    # every H2D binding must resolve to partition axes, or the shard
+    # layout is undefined for it
+    binding_names = set(_FRAMEWORK_BINDINGS)
+    for group in (spec.r_cols, spec.e_cols, spec.tables, spec.ptables,
+                  spec.membs, spec.keyed_vals, spec.elem_keys,
+                  spec.inv_joins):
+        binding_names.update(x.name for x in group)
+    layout: list[tuple[str, tuple]] = []
+    for name in sorted(binding_names):
+        try:
+            layout.append((name, tuple(binding_axes(name))))
+        except ValueError:
+            return ineligible_plan(f"unpartitionable binding {name}: "
+                                   f"no known shard layout")
+
+    sharded_by_name = {nm: "r" in axes for nm, axes in layout}
+    states: dict[int, str] = {}
+    shardings: list[tuple[int, str]] = []
+    for i in sorted(reachable_nodes(prog)):
+        n = prog.nodes[i]
+        op = n.op
+        if op == "const":
+            st = REPLICATED
+        elif op == "input":
+            name, _ikind = n.meta
+            ij = by_ij.get(name)
+            if ij is not None:
+                # the inv-join column is computed from OTHER rows: a
+                # row split would hide matches living on other shards
+                return ineligible_plan(_cross_row_reason(kind, name, ij))
+            st = SHARDED if sharded_by_name.get(name, True) else REPLICATED
+        elif op in ("keyed_val", "elem_keys_missing",
+                    "cset_not_subset_memb", "cset_subset_memb"):
+            # per-(constraint, row) lookups/matrices: row-partitioned
+            st = SHARDED
+        else:
+            # table/ptable gathers follow their (row-sharded) index;
+            # any_e/all_e/count_e reduce the ELEMENT axis, not r;
+            # cmp/in_cset/and/or/not/arith are elementwise — all join
+            st = REPLICATED
+            for a in n.args:
+                if states.get(a) == SHARDED:
+                    st = SHARDED
+                    break
+        states[i] = st
+        shardings.append((i, st))
+
+    return PartitionPlan(kind=kind, digest=digest, eligible=True,
+                         node_shardings=tuple(shardings),
+                         collectives=_SERVING_COLLECTIVES,
+                         padding=_PAD_CONSTRAINTS,
+                         layout=tuple(layout))
+
+
+# ---------------------------------------------------------------------------
+# simulated-mesh validation (plans are validated, not trusted)
+
+
+def make_sim_mesh(n_shards: int):
+    """Row-only (1, n) simulated mesh — a pure resource-axis partition
+    matching the plan semantics — over the first ``n_shards`` local
+    devices.  Lives in parallel.sharding; re-exported here for the
+    probe/tests."""
+    from gatekeeper_tpu.parallel.sharding import make_sim_mesh as _m
+    return _m(n_shards)
+
+
+def _break_kinds() -> set[str]:
+    raw = os.environ.get("GATEKEEPER_SHARDPLAN_TEST_BREAK", "")
+    return {t.strip() for t in raw.split(",") if t.strip()}
+
+
+_skip_logged = False
+
+
+def validate_plan(kind: str, compiled, lowered, plan: PartitionPlan,
+                  constraints: list[dict] | None = None,
+                  budget: int | None = None
+                  ) -> tuple[PartitionPlan, list[ShardPlanViolation]]:
+    """Execute the plan on a 2-shard simulated mesh over the smallmodel
+    worlds and demand (a) a bit-identical violation mask and (b)
+    count/top-k parity vs the unsharded oracle.  Returns the plan
+    (stamped validated on success) plus any violations.  With fewer
+    than 2 local devices the validation soft-skips: the plan stays
+    unvalidated but is NOT a violation (a 1-device strict process must
+    not pin the whole library)."""
+    global _skip_logged
+    import jax
+
+    from gatekeeper_tpu.analysis import transval
+    from gatekeeper_tpu.analysis.smallmodel import (derive_plan,
+                                                    enumerate_models)
+
+    if not plan.eligible:
+        return plan, []
+    if len(jax.devices()) < 2:
+        if not _skip_logged:
+            _skip_logged = True
+            log.warning("shardplan validation skipped: fewer than 2 "
+                        "devices (set jax_num_cpu_devices=2 for the "
+                        "simulated mesh)")
+        return plan, []
+
+    from gatekeeper_tpu.parallel.sharding import (binding_spec,
+                                                  make_sharded_mask_fn,
+                                                  make_sim_mesh,
+                                                  pad_bindings_for_mesh,
+                                                  run_sharded_audit)
+
+    cons = transval.expand_constraints(kind, constraints)
+    plan_m = derive_plan(lowered, cons, module=compiled.module)
+    models = enumerate_models(plan_m, budget or validation_budget())
+    all_res = [obj for m in models for obj in m.resources]
+    if not all_res:
+        return plan, []
+    st, _rows, _handler = transval._world_state(all_res)
+    base_mask, bindings = transval._device_mask(lowered, st, cons)
+
+    mesh = make_sim_mesh(2)
+    b = pad_bindings_for_mesh(bindings, mesh.shape["c"], mesh.shape["r"])
+    names = tuple(sorted(b.arrays))
+    specs = {nm: binding_spec(nm, b.arrays[nm]) for nm in names}
+    fn = make_sharded_mask_fn(lowered.program, names, specs, mesh)
+    with mesh:
+        m = fn(tuple(b.arrays[nm] for nm in names))
+    mask2 = np.asarray(m)[:base_mask.shape[0], :base_mask.shape[1]]
+    if kind in _break_kinds() and mask2.size:
+        # fault-injection seam: flip one cell of the sharded mask so
+        # the validator provably catches a divergent plan end-to-end
+        mask2 = mask2.copy()
+        mask2.flat[0] = ~mask2.flat[0]
+        log.warning("shardplan deliberately broken (test seam)",
+                    kind=kind)
+
+    out: list[ShardPlanViolation] = []
+    if mask2.shape != base_mask.shape \
+            or not np.array_equal(mask2, base_mask):
+        diff = int(np.sum(mask2 != base_mask)) \
+            if mask2.shape == base_mask.shape else -1
+        out.append(ShardPlanViolation(
+            kind=kind,
+            note=f"2-shard mask mismatch vs oracle over "
+                 f"{len(models)} model world(s), {diff} cell(s)"))
+    else:
+        counts, rows, valid = run_sharded_audit(
+            lowered.program, bindings, mesh, k=20)
+        for ci in range(base_mask.shape[0]):
+            want = int(base_mask[ci].sum())
+            got_rows = {int(r) for r, v in zip(rows[ci], valid[ci]) if v}
+            viol_rows = set(np.nonzero(base_mask[ci])[0].tolist())
+            if int(counts[ci]) != want or not got_rows <= viol_rows:
+                out.append(ShardPlanViolation(
+                    kind=kind,
+                    note=f"top-k parity: constraint {ci} counts "
+                         f"{int(counts[ci])} vs {want}"))
+                break
+    if out:
+        return dataclasses.replace(plan, validated=False), out
+    return dataclasses.replace(plan, validated=True,
+                               shards_validated=2), []
+
+
+# ---------------------------------------------------------------------------
+# memoized entry point
+
+
+def certify(kind: str, compiled, lowered,
+            constraints: list[dict] | None = None) -> PartitionPlan:
+    """Memoized/snapshot-backed entry point the engine and probe use.
+
+    The static analysis always runs (mode "warn"); under "strict" the
+    plan is additionally executed on the 2-shard simulated mesh and
+    any violation is recorded in ``violations[kind]`` (the engine then
+    pins the kind to the replicated path — install never fails on this
+    stage).  Validated plans persist in the snapshot "sp" tier, so a
+    warm restart re-runs zero analyses.  The TEST_BREAK seam bypasses
+    both memo and snapshot — a broken plan must reach the validator,
+    not a cached honest one."""
+    global analyses_run
+    digest = shardplan_digest(lowered)
+    seam = kind in _break_kinds()
+    if not seam:
+        cached = _memo.get(digest)
+        if cached is not None:
+            _publish(kind, cached)
+            return cached
+        from gatekeeper_tpu.resilience import snapshot as _snap
+        hit = _snap.load_shardplan(digest)     # 1-tuple or None (miss)
+        if hit is not None and isinstance(hit[0], PartitionPlan) \
+                and hit[0].version == SHARDPLAN_VERSION:
+            _memo[digest] = hit[0]
+            _publish(kind, hit[0])
+            return hit[0]
+
+    plan = analyze(kind, lowered)
+    analyses_run += 1
+    found: list[ShardPlanViolation] = []
+    if mode() == "strict":
+        plan, found = validate_plan(kind, compiled, lowered, plan,
+                                    constraints=constraints)
+    if found:
+        violations[kind] = found
+        for v in found:
+            log.warning("shardplan violation", kind=kind, note=v.note)
+    else:
+        violations.pop(kind, None)
+    if not seam and not found:
+        _memo[digest] = plan
+        from gatekeeper_tpu.resilience import snapshot as _snap
+        _snap.save_shardplan(digest, plan)
+    _publish(kind, plan)
+    return plan
+
+
+def _publish(kind: str, plan: PartitionPlan) -> None:
+    plans[kind] = plan
+    if plan.eligible:
+        ineligible.pop(kind, None)
+    else:
+        ineligible[kind] = plan.reason or "shard-ineligible"
+
+
+def plan_for(kind: str) -> PartitionPlan | None:
+    """The most recently published plan for a kind, or None when not
+    yet analyzed."""
+    return plans.get(kind)
+
+
+def ineligible_for(kind: str) -> str | None:
+    """The shard-ineligibility reason for a kind, or None when
+    eligible (or not yet analyzed)."""
+    return ineligible.get(kind)
+
+
+def violations_for(kind: str) -> list[ShardPlanViolation]:
+    return violations.get(kind, [])

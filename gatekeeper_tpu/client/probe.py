@@ -355,6 +355,63 @@ def _severity_rc(n_err: int, n_warn: int) -> int:
     return 2 if n_err else (1 if n_warn else 0)
 
 
+def _load_work(paths: list[str], use_library: bool):
+    """Shared --certify/--footprint/--shardplan work-list builder:
+    ConstraintTemplate docs from yaml files plus (optionally) the
+    built-in library with one example constraint each.  Returns None
+    when any input is unreadable (the caller exits 2)."""
+    import sys
+
+    import yaml
+    work: list[tuple[str, dict, list]] = []
+    for p in paths:
+        try:
+            with open(p, encoding="utf-8") as fh:
+                loaded = list(yaml.safe_load_all(fh))
+        except (OSError, yaml.YAMLError) as e:
+            print(f"{p}: cannot load: {e}", file=sys.stderr)
+            return None
+        work.extend((p, d, []) for d in loaded
+                    if isinstance(d, dict)
+                    and d.get("kind") == "ConstraintTemplate")
+    if use_library:
+        from gatekeeper_tpu.library import all_docs
+        work.extend(("<library>", tdoc, [cdoc])
+                    for tdoc, cdoc in all_docs())
+    return work
+
+
+def _compile_work(work, errs: dict):
+    """Shared per-template compile+lower loop for the analysis
+    subcommands: yields (kind, compiled, lowered-or-None,
+    example-constraints).  Parse/compile failures print a FAIL line
+    and bump ``errs["n"]``; scalar-fallback templates yield with
+    ``lowered=None`` so each subcommand can word its own pin line."""
+    import sys
+
+    from gatekeeper_tpu.api.templates import compile_target_rego
+    from gatekeeper_tpu.ir.lower import CannotLower, lower_template
+    for _label, tdoc, cdocs in work:
+        kind = _doc_kind(tdoc)
+        compiled = lowered = None
+        for tt in ((tdoc.get("spec") or {}).get("targets") or ()):
+            try:
+                compiled = compile_target_rego(
+                    kind, tt.get("target") or "", tt.get("rego") or "")
+                lowered = lower_template(compiled.module, compiled.interp)
+            except CannotLower:
+                lowered = None
+            except Exception as e:      # noqa: BLE001 — parse/compile
+                errs["n"] += 1
+                print(f"  FAIL {kind}: {type(e).__name__}: {e}",
+                      file=sys.stderr)
+                compiled = None
+            break
+        if compiled is None:
+            continue
+        yield kind, compiled, lowered, cdocs
+
+
 def run_lint(paths: list[str], use_library: bool = False,
              strict: bool = False) -> int:
     """``--lint``: print diagnostics with locations.  Exit contract
@@ -616,48 +673,16 @@ def run_certify(paths: list[str], use_library: bool = False) -> int:
     import sys
     import time as _time
 
-    import yaml
-
     from gatekeeper_tpu.analysis import transval
-    from gatekeeper_tpu.api.templates import compile_target_rego
-    from gatekeeper_tpu.ir.lower import CannotLower, lower_template
 
-    work: list[tuple[str, dict, list]] = []
-    for p in paths:
-        try:
-            with open(p, encoding="utf-8") as fh:
-                loaded = list(yaml.safe_load_all(fh))
-        except (OSError, yaml.YAMLError) as e:
-            print(f"{p}: cannot load: {e}", file=sys.stderr)
-            return 2
-        work.extend((p, d, []) for d in loaded
-                    if isinstance(d, dict)
-                    and d.get("kind") == "ConstraintTemplate")
-    if use_library:
-        from gatekeeper_tpu.library import all_docs
-        work.extend(("<library>", tdoc, [cdoc])
-                    for tdoc, cdoc in all_docs())
+    work = _load_work(paths, use_library)
+    if work is None:
+        return 2
     corpus_dir = _os.environ.get("GATEKEEPER_TRANSVAL_CORPUS")
     t0 = _time.perf_counter()
-    n_cert = n_pin = n_ce = n_err = n_trunc = models = 0
-    for label, tdoc, cdocs in work:
-        kind = _doc_kind(tdoc)
-        compiled = lowered = None
-        for tt in ((tdoc.get("spec") or {}).get("targets") or ()):
-            try:
-                compiled = compile_target_rego(
-                    kind, tt.get("target") or "", tt.get("rego") or "")
-                lowered = lower_template(compiled.module, compiled.interp)
-            except CannotLower:
-                lowered = None
-            except Exception as e:      # noqa: BLE001 — parse/compile
-                n_err += 1
-                print(f"  FAIL {kind}: {type(e).__name__}: {e}",
-                      file=sys.stderr)
-                compiled = None
-            break
-        if compiled is None:
-            continue
+    errs = {"n": 0}
+    n_cert = n_pin = n_ce = n_trunc = models = 0
+    for kind, compiled, lowered, cdocs in _compile_work(work, errs):
         if lowered is None:
             n_pin += 1
             print(f"  pin  {kind}: scalar fallback (no device program)")
@@ -668,7 +693,7 @@ def run_certify(paths: list[str], use_library: bool = False) -> int:
                 kind, compiled, lowered=lowered,
                 constraints=cdocs or None)
         except Exception as e:          # noqa: BLE001
-            n_err += 1
+            errs["n"] += 1
             print(f"  FAIL {kind}: validator error: {e}", file=sys.stderr)
             continue
         if isinstance(result, transval.Certificate):
@@ -691,7 +716,7 @@ def run_certify(paths: list[str], use_library: bool = False) -> int:
     print(f"certify: {len(work)} template(s), {n_cert} certified, "
           f"{n_pin} pinned, {n_ce} counterexample(s), "
           f"{models} models in {wall:.1f}s")
-    return _severity_rc(n_ce + n_err, n_trunc)
+    return _severity_rc(n_ce + errs["n"], n_trunc)
 
 
 def run_footprint(paths: list[str], use_library: bool = False) -> int:
@@ -709,47 +734,15 @@ def run_footprint(paths: list[str], use_library: bool = False) -> int:
     import sys
     import time as _time
 
-    import yaml
-
     from gatekeeper_tpu.analysis import footprint
-    from gatekeeper_tpu.api.templates import compile_target_rego
-    from gatekeeper_tpu.ir.lower import CannotLower, lower_template
 
-    work: list[tuple[str, dict, list]] = []
-    for p in paths:
-        try:
-            with open(p, encoding="utf-8") as fh:
-                loaded = list(yaml.safe_load_all(fh))
-        except (OSError, yaml.YAMLError) as e:
-            print(f"{p}: cannot load: {e}", file=sys.stderr)
-            return 2
-        work.extend((p, d, []) for d in loaded
-                    if isinstance(d, dict)
-                    and d.get("kind") == "ConstraintTemplate")
-    if use_library:
-        from gatekeeper_tpu.library import all_docs
-        work.extend(("<library>", tdoc, [cdoc])
-                    for tdoc, cdoc in all_docs())
+    work = _load_work(paths, use_library)
+    if work is None:
+        return 2
     t0 = _time.perf_counter()
-    n_ok = n_pin = n_cross = n_viol = n_err = 0
-    for label, tdoc, cdocs in work:
-        kind = _doc_kind(tdoc)
-        compiled = lowered = None
-        for tt in ((tdoc.get("spec") or {}).get("targets") or ()):
-            try:
-                compiled = compile_target_rego(
-                    kind, tt.get("target") or "", tt.get("rego") or "")
-                lowered = lower_template(compiled.module, compiled.interp)
-            except CannotLower:
-                lowered = None
-            except Exception as e:      # noqa: BLE001 — parse/compile
-                n_err += 1
-                print(f"  FAIL {kind}: {type(e).__name__}: {e}",
-                      file=sys.stderr)
-                compiled = None
-            break
-        if compiled is None:
-            continue
+    errs = {"n": 0}
+    n_ok = n_pin = n_cross = n_viol = 0
+    for kind, compiled, lowered, cdocs in _compile_work(work, errs):
         if lowered is None:
             n_pin += 1
             print(f"  pin  {kind}: scalar fallback (whole-kind "
@@ -761,7 +754,7 @@ def run_footprint(paths: list[str], use_library: bool = False) -> int:
             found = footprint.validate_footprint(
                 kind, compiled, lowered, fp, constraints=cdocs or None)
         except Exception as e:          # noqa: BLE001
-            n_err += 1
+            errs["n"] += 1
             print(f"  FAIL {kind}: analyzer error: {e}", file=sys.stderr)
             continue
         verdict = "row-local" if fp.row_local else "CROSS-ROW"
@@ -785,7 +778,92 @@ def run_footprint(paths: list[str], use_library: bool = False) -> int:
     print(f"footprint: {len(work)} template(s), {n_ok} row-local, "
           f"{n_cross} cross-row, {n_pin} pinned, "
           f"{n_viol} violation(s) in {wall:.1f}s")
-    return _severity_rc(n_viol + n_err, n_cross)
+    return _severity_rc(n_viol + errs["n"], n_cross)
+
+
+def _ensure_sim_devices(n: int) -> None:
+    """Give this process at least ``n`` CPU devices for the simulated
+    mesh, BEFORE first backend contact (after that the count is
+    frozen; the config update then raises and we leave whatever the
+    environment provided)."""
+    import os
+
+    try:
+        import jax
+        jax.config.update("jax_num_cpu_devices", n)
+    except Exception:   # noqa: BLE001 — older jax / backend already up
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={n}"
+            ).strip()
+
+
+def run_shardplan(paths: list[str], use_library: bool = False) -> int:
+    """``--shardplan``: Stage-6 partition-plan certification
+    (analysis/shardplan.py) over template files and/or the built-in
+    library.  For each device-lowered template, derive the
+    resource-axis partition plan (per-node sharding states, required
+    collectives, padding constraints, per-shard H2D layout) and
+    execute it on a 2-shard simulated mesh against the unsharded
+    oracle; CROSS-ROW templates are certified shard-ineligible with
+    the footprint's reason and scalar-fallback templates are reported
+    as pinned (no device program, replicated path).  Exit contract
+    (:func:`_severity_rc`): 2 on any plan violation or unloadable
+    input, 1 when every eligible plan validated but some template is
+    ineligible or pinned, 0 fully shard-eligible."""
+    import sys
+    import time as _time
+
+    _ensure_sim_devices(2)
+    from gatekeeper_tpu.analysis import shardplan
+
+    work = _load_work(paths, use_library)
+    if work is None:
+        return 2
+    t0 = _time.perf_counter()
+    errs = {"n": 0}
+    n_elig = n_inelig = n_pin = n_viol = 0
+    for kind, compiled, lowered, cdocs in _compile_work(work, errs):
+        if lowered is None:
+            n_pin += 1
+            print(f"  pin  {kind}: scalar fallback (no device program, "
+                  "replicated path)")
+            continue
+        try:
+            plan = shardplan.analyze(kind, lowered)
+            found: list = []
+            if plan.eligible:
+                plan, found = shardplan.validate_plan(
+                    kind, compiled, lowered, plan,
+                    constraints=cdocs or None)
+        except Exception as e:          # noqa: BLE001
+            errs["n"] += 1
+            print(f"  FAIL {kind}: analyzer error: {e}", file=sys.stderr)
+            continue
+        if plan.eligible:
+            n_elig += 1
+            n_shard = sum(1 for _i, s in plan.node_shardings
+                          if s == shardplan.SHARDED)
+            cols = ", ".join(f"{op}[{ax}]:{operand}"
+                             for op, ax, operand in plan.collectives)
+            val = (f", validated@{plan.shards_validated}"
+                   if plan.validated else "")
+            print(f"  ok   {kind}: shard-eligible, {n_shard}/"
+                  f"{len(plan.node_shardings)} sharded node(s){val}")
+            print(f"         collectives: {cols}")
+            print(f"         padding: {'; '.join(plan.padding)}")
+        else:
+            n_inelig += 1
+            print(f"  warn {kind}: shard-ineligible — {plan.reason}")
+        for v in found:
+            n_viol += 1
+            print(f"  FAIL {v.format()}", file=sys.stderr)
+    wall = _time.perf_counter() - t0
+    print(f"shardplan: {len(work)} template(s), {n_elig} shard-eligible, "
+          f"{n_inelig} ineligible, {n_pin} pinned, "
+          f"{n_viol} violation(s) in {wall:.1f}s")
+    return _severity_rc(n_viol + errs["n"], n_inelig + n_pin)
 
 
 def run_health() -> int:
@@ -828,6 +906,40 @@ def run_health() -> int:
     return 0
 
 
+def _run_subcommand(argv: list[str]) -> int | None:
+    """One dispatcher for every analysis subcommand: flag matching,
+    ``--library``/``--strict``/``--out`` extraction and positional
+    (yaml path) splitting live here instead of one copy per flag; the
+    shared 0/1/2 exit contract is :func:`_severity_rc` inside each
+    runner.  Returns None when no analysis flag is present (the caller
+    falls through to the engine probe)."""
+    use_library = "--library" in argv
+    strict = "--strict" in argv
+    pos = [a for a in argv if a not in ("--library", "--strict")]
+    out = None
+    if "--out" in pos:
+        i = pos.index("--out")
+        out = pos[i + 1] if i + 1 < len(pos) else None
+        del pos[i:i + 2]
+    table = (
+        ("--policyset", lambda rest: run_policyset()),
+        ("--cost", lambda rest: run_cost()),
+        ("--trace", lambda rest: run_trace(out)),
+        ("--certify", lambda rest: run_certify(
+            rest, use_library=use_library)),
+        ("--footprint", lambda rest: run_footprint(
+            rest, use_library=use_library)),
+        ("--shardplan", lambda rest: run_shardplan(
+            rest, use_library=use_library)),
+        ("--lint", lambda rest: run_lint(
+            rest, use_library=use_library, strict=strict)),
+    )
+    for flag, fn in table:
+        if flag in argv:
+            return fn([a for a in pos if a != flag])
+    return None
+
+
 def main(argv=None) -> int:
     """``python -m gatekeeper_tpu.client.probe``: self-validate both
     engines (the readiness wiring the reference's Probe exists for).
@@ -851,27 +963,9 @@ def main(argv=None) -> int:
         return 0
     if "--health" in argv:
         return run_health()
-    if "--policyset" in argv:
-        return run_policyset()
-    if "--cost" in argv:
-        return run_cost()
-    if "--trace" in argv:
-        out = None
-        if "--out" in argv:
-            i = argv.index("--out")
-            out = argv[i + 1] if i + 1 < len(argv) else None
-        return run_trace(out)
-    if "--certify" in argv:
-        rest = [a for a in argv if a not in ("--certify", "--library")]
-        return run_certify(rest, use_library="--library" in argv)
-    if "--footprint" in argv:
-        rest = [a for a in argv if a not in ("--footprint", "--library")]
-        return run_footprint(rest, use_library="--library" in argv)
-    if "--lint" in argv:
-        rest = [a for a in argv
-                if a not in ("--lint", "--library", "--strict")]
-        return run_lint(rest, use_library="--library" in argv,
-                        strict="--strict" in argv)
+    rc = _run_subcommand(argv)
+    if rc is not None:
+        return rc
 
     from gatekeeper_tpu.client.local_driver import LocalDriver
     from gatekeeper_tpu.engine.jax_driver import JaxDriver

@@ -189,6 +189,7 @@ class ReconcileConstraintTemplate(Reconciler):
             return DONE
         self._transval_status(instance)
         self._footprint_status(instance)
+        self._shardplan_status(instance)
         self.watcher.add_watch(make_constraint_gvk(_template_kind(instance)))
         try:
             crd_create(self.cluster, crd)
@@ -206,6 +207,7 @@ class ReconcileConstraintTemplate(Reconciler):
             return DONE
         self._transval_status(instance)
         self._footprint_status(instance)
+        self._shardplan_status(instance)
         self.watcher.add_watch(make_constraint_gvk(_template_kind(instance)))
         if found.get("apiVersion") == "apiextensions.k8s.io/v1":
             # compare/update in the stored object's shape, not ours
@@ -358,6 +360,28 @@ class ReconcileConstraintTemplate(Reconciler):
              "message": (f"verdict is not row-local ({reason}); "
                          "shard_map ineligible, selective invalidation "
                          "disabled for this template")})
+        set_ha_status(instance, status)
+
+    def _shardplan_status(self, instance: dict) -> None:
+        """Stage-6 surface (analysis/shardplan.py): templates whose
+        partition plan is shard-INELIGIBLE (cross-row verdicts, or a
+        binding with no known shard layout) get a ``shard_ineligible``
+        warning in ``status.byPod[].warnings``: under
+        ``GATEKEEPER_SHARDS=N`` they pin to the replicated path.
+        Informational, never rejects — the replicated path is always
+        correct, sharding is a performance contract."""
+        from gatekeeper_tpu.analysis import shardplan
+        if shardplan.mode() == "off":
+            return
+        reason = shardplan.ineligible_for(_template_kind(instance))
+        if reason is None:
+            return
+        status = get_ha_status(instance)
+        status.setdefault("warnings", []).append(
+            {"code": "shard_ineligible",
+             "message": (f"no resource-axis partition plan ({reason}); "
+                         "pinned to the replicated path under "
+                         "GATEKEEPER_SHARDS")})
         set_ha_status(instance, status)
 
     @staticmethod

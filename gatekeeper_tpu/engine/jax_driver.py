@@ -189,6 +189,8 @@ class JaxTargetState(TargetState):
         self.match_engine = None
         # kind -> Stage-5 dependency footprint (analysis/footprint.py)
         self.footprints: dict[str, object] = {}
+        # kind -> Stage-6 partition plan (analysis/shardplan.py)
+        self.shardplans: dict[str, object] = {}
         # kind -> last device sweep payload + guards, for
         # footprint-driven selective invalidation (_selective_reuse)
         self.sweep_cache: dict[str, dict] = {}
@@ -222,9 +224,19 @@ class JaxDriver(LocalDriver):
             logger("engine").warning(
                 "device backend unavailable; scalar-only engine",
                 reason=res.reason)
-        elif res.n_devices > 1:
-            from gatekeeper_tpu.parallel.sharding import make_mesh
-            mesh = make_mesh()          # a real failure here should raise
+        else:
+            # GATEKEEPER_SHARDS selects the mesh: 0/unset keeps the
+            # legacy all-device mesh when multiple devices exist; 1
+            # forces the unsharded oracle (no mesh even multi-device);
+            # N >= 2 builds the Stage-6 row-only simulated mesh the
+            # partition plans are certified against.
+            n_shards = _env_int("GATEKEEPER_SHARDS", 0)
+            if n_shards >= 2:
+                from gatekeeper_tpu.parallel.sharding import make_sim_mesh
+                mesh = make_sim_mesh(n_shards)
+            elif n_shards == 0 and res.n_devices > 1:
+                from gatekeeper_tpu.parallel.sharding import make_mesh
+                mesh = make_mesh()      # a real failure here should raise
         self.supervisor.add_recovery_listener(self, "_on_backend_recovered")
         self.executor = ProgramExecutor(mesh=mesh)
         self.metrics = Metrics()
@@ -353,6 +365,16 @@ class JaxDriver(LocalDriver):
                 st.footprints[kind] = fp
             else:
                 st.footprints.pop(kind, None)
+            # stage 6 (partition plan): certifies HOW the lowered
+            # program shards along the resource axis; the sp snapshot
+            # tier keeps warm restarts at zero re-analyses
+            sp = None
+            if compiled.vectorized is not None:
+                sp = self._shardplan_lowered(kind, compiled)
+            if sp is not None:
+                st.shardplans[kind] = sp
+            else:
+                st.shardplans.pop(kind, None)
             st.sweep_cache.pop(kind, None)
         st.templates[kind] = compiled
         st.bump(kind)
@@ -392,6 +414,42 @@ class JaxDriver(LocalDriver):
         if not fp.row_local:
             self.metrics.counter("footprint_cross_row").inc()
         return fp
+
+    def _shardplan_lowered(self, kind: str, compiled: CompiledTemplate):
+        """Stage-6 partition-plan certification (analysis/shardplan.py)
+        behind GATEKEEPER_SHARDPLAN=off|warn|strict.  Unlike the other
+        stages this one NEVER fails an install: a missing/invalid plan
+        only pins the kind to the replicated path (sharding is a
+        performance contract, not a semantic one — the replicated path
+        is always correct).  strict: the plan is executed on a 2-shard
+        simulated mesh at install; any divergence is recorded and the
+        kind pins replicated.  Ineligible plans (cross-row templates)
+        ARE returned — the sweep reads plan.eligible."""
+        from gatekeeper_tpu.analysis import shardplan
+        if shardplan.mode() == "off":
+            return None
+        try:
+            plan = shardplan.certify(kind, compiled, compiled.vectorized)
+        except Exception as e:   # noqa: BLE001 — analysis must not take
+            # template install down with it; no plan just means the
+            # kind stays on the replicated path
+            from gatekeeper_tpu.utils.log import logger
+            logger("engine.jax_driver").warning(
+                "shardplan analysis errored", kind=kind, err=str(e))
+            self.metrics.counter("shardplan_errors").inc()
+            return None
+        bad = shardplan.violations_for(kind)
+        if bad:
+            self.metrics.counter("shardplan_violations").inc(len(bad))
+            from gatekeeper_tpu.utils.log import logger
+            for v in bad:
+                logger("engine.jax_driver").warning(
+                    "shardplan invalid; kind pinned to replicated path",
+                    kind=kind, note=v.note)
+            return None
+        if not plan.eligible:
+            self.metrics.counter("shardplan_ineligible").inc()
+        return plan
 
     def _certify_lowered(self, kind: str, compiled: CompiledTemplate):
         """Stage-4 translation validation (analysis/transval.py) behind
@@ -1310,6 +1368,19 @@ class JaxDriver(LocalDriver):
             fp_enabled = not self.scalar_only and _fp_mode() != "off"
             fp_skipped: list[str] = []
             fp_saved = 0
+            # Stage-6 plan gating (analysis/shardplan.py): on a mesh,
+            # a kind's bindings shard only when its partition plan
+            # certifies eligibility; uncertified/ineligible kinds pin
+            # to the replicated (single-device) path.
+            # GATEKEEPER_SHARDPLAN=off is the oracle: everything
+            # shards exactly as before this stage.
+            from gatekeeper_tpu.analysis.shardplan import mode as _sp_mode
+            sp_gate = self.executor.mesh is not None and \
+                _sp_mode() != "off"
+            sp_sharded: list[str] = []
+            sp_replicated: list[str] = []
+            sp_evals = 0
+            sp_collectives = 0
             _t_pipe = _time.perf_counter()
             try:
                 with self._prep_lock:
@@ -1389,6 +1460,25 @@ class JaxDriver(LocalDriver):
                                 futures.append(None)
                                 specs.append(spec)
                                 continue
+                            if self.executor.mesh is not None:
+                                plan = st.shardplans.get(kind)
+                                if sp_gate:
+                                    self.executor.set_sharding_allowed(
+                                        bindings,
+                                        plan is not None and
+                                        getattr(plan, "eligible", False))
+                                if self.executor._sharded_for(bindings):
+                                    sp_sharded.append(kind)
+                                    _ms = self.executor.mesh.shape
+                                    sp_evals += bindings.c_pad * \
+                                        bindings.r_pad // \
+                                        (_ms["c"] * _ms["r"])
+                                    if plan is not None:
+                                        sp_collectives += len(
+                                            getattr(plan, "collectives",
+                                                    ()))
+                                else:
+                                    sp_replicated.append(kind)
                             self._install_gates(st, kind, bindings, mask,
                                                 mask_dirty, rank, padded)
                             prog = compiled.vectorized.program
@@ -1626,6 +1716,21 @@ class JaxDriver(LocalDriver):
             }
             if fp_saved:
                 m.counter("footprint_evaluations_saved").inc(fp_saved)
+            # plan-driven sharding stanza (both sweep shapes): mesh
+            # size, which kinds ran sharded vs pinned replicated, the
+            # per-shard evaluation slice and the collective count the
+            # consumed plans declared
+            _mesh = self.executor.mesh
+            self.last_sweep_phases["shard"] = {
+                "enabled": _mesh is not None,
+                "shards": int(_mesh.devices.size) if _mesh is not None
+                else 0,
+                "plan_gated": sp_gate,
+                "kinds_sharded": len(sp_sharded),
+                "kinds_replicated": len(sp_replicated),
+                "per_shard_evals": int(sp_evals),
+                "collectives": int(sp_collectives),
+            }
             if _sweep_sp is not None:
                 _sweep_sp.args["results"] = len(tagged)
             from gatekeeper_tpu.obs.flightrecorder import \

@@ -871,6 +871,17 @@ class ProgramExecutor:
                 self._mesh_divides(bindings.arrays)
         return hit
 
+    def set_sharding_allowed(self, bindings: Bindings,
+                             allowed: bool) -> None:
+        """Pre-seed the per-(executor, Bindings) sharding decision: the
+        Stage-6 plan gate.  ``allowed=False`` pins this bindings set to
+        the replicated (single-device) path even on a mesh; ``True``
+        defers to the usual mesh-divisibility check.  Must run before
+        the first ``_sharded_for`` for the pin to take effect."""
+        d = bindings.__dict__.setdefault("_sharded_by", {})
+        d[id(self)] = bool(allowed) and self.mesh is not None and \
+            self._mesh_divides(bindings.arrays)
+
     def _put(self, name: str, host: np.ndarray, sharded: bool) -> jax.Array:
         if sharded:
             return jax.device_put(host, self._sharding_of(name))

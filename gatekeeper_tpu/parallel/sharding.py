@@ -106,6 +106,26 @@ def make_mesh(n_devices: int | None = None) -> Mesh:
     return Mesh(devices.reshape(c, n // c), axis_names=("c", "r"))
 
 
+def make_sim_mesh(n_shards: int) -> Mesh:
+    """Row-only (1, n_shards) simulated mesh: a pure resource-axis
+    partition, matching the Stage-6 partition-plan semantics (plans
+    reason about the ``r`` split only; ``c`` stays whole).  Used by
+    the plan validator and the ``GATEKEEPER_SHARDS=N`` simulated
+    sweep."""
+    devices = jax.devices()
+    if n_shards < 1:
+        raise ValueError(f"make_sim_mesh needs n_shards >= 1, "
+                         f"got {n_shards}")
+    if len(devices) < n_shards:
+        raise ValueError(
+            f"make_sim_mesh needs {n_shards} devices but jax.devices() "
+            f"has only {len(devices)} ({devices[0].platform}); for a "
+            f"simulated mesh set jax_platforms=cpu + "
+            f"jax_num_cpu_devices={n_shards} before any jax use")
+    devices = np.asarray(devices[:n_shards]).reshape(1, n_shards)
+    return Mesh(devices, axis_names=("c", "r"))
+
+
 def _topk_local_step(program: Program, names: tuple[str, ...], k: int,
                      r_pad: int, r_shards: int):
     """Per-shard body of the sharded capped audit."""

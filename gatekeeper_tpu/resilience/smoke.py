@@ -16,7 +16,9 @@ re-lowering, no re-verification), ``validations == 0`` (every
 translation-validation Certificate restored from the cert snapshot
 tier instead of re-derived), ``footprints == 0`` (every Stage-5
 dependency footprint restored from the fp snapshot tier instead of
-re-analyzed), an identical ``verdict_digest``, and
+re-analyzed), ``shardplans == 0`` (every Stage-6 partition plan
+restored from the sp snapshot tier), an identical
+``verdict_digest``, and
 a substantially smaller ``serving_seconds`` — ci.sh's restart-smoke
 stage asserts exactly that.  The workload is deterministic
 (seeded RNG), so cold and warm evaluate the same inventory whether it
@@ -55,11 +57,14 @@ def main() -> int:
     # load every Certificate from the cert snapshot tier instead of
     # re-running the small-model check ("validations" == 0 warm)
     os.environ.setdefault("GATEKEEPER_TRANSVAL", "warn")
+    # same contract for the Stage-6 partition plans: the warm process
+    # must load every plan from the sp snapshot tier ("shardplans" == 0)
+    os.environ.setdefault("GATEKEEPER_SHARDPLAN", "warn")
 
     # imports before the clock starts: interpreter + jax import cost is
     # identical for cold and warm processes and would only dilute the
     # startup ratio the smoke stage asserts on
-    from gatekeeper_tpu.analysis import footprint, transval
+    from gatekeeper_tpu.analysis import footprint, shardplan, transval
     from gatekeeper_tpu.client.client import Backend
     from gatekeeper_tpu.client.interface import QueryOpts
     from gatekeeper_tpu.engine import jax_driver as jd_mod
@@ -118,6 +123,7 @@ def main() -> int:
         "verdict_digest": _verdict_digest(results),
         "validations": transval.validations_run,
         "footprints": footprint.analyses_run,
+        "shardplans": shardplan.analyses_run,
     }
     print(json.dumps(out))
     return 0

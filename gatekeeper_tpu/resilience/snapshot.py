@@ -69,7 +69,8 @@ class SnapshotStats:
                "plan_hits", "plan_misses",
                "store_hits", "store_misses",
                "cert_hits", "cert_misses",
-               "fp_hits", "fp_misses", "corrupt_discarded",
+               "fp_hits", "fp_misses",
+               "sp_hits", "sp_misses", "corrupt_discarded",
                "saves", "save_errors")
 
     def __init__(self):
@@ -406,6 +407,31 @@ def save_footprint(digest: str, fp) -> bool:
     return _write_entry("fp", f"fp:{digest}", payload)
 
 
+def load_shardplan(digest: str):
+    """Seventh tier: Stage-6 partition plans, keyed by the shardplan
+    digest (program cache_key + prep-spec signature + analyzer
+    version).  A warm restart that reuses the snapshotted lowered IR
+    also reuses its partition plan, so it re-runs zero sharding
+    analyses (analysis/shardplan.certify)."""
+    if not enabled():
+        return None
+    got = _read_entry("sp", f"sp:{digest}")
+    stats.bump("sp_hits" if got is not None else "sp_misses")
+    return got
+
+
+def save_shardplan(digest: str, plan) -> bool:
+    if not enabled():
+        return False
+    try:
+        payload = dumps(plan)
+    except Exception as e:   # noqa: BLE001
+        stats.bump("save_errors")
+        _log.warning("shardplan not snapshottable", error=e)
+        return False
+    return _write_entry("sp", f"sp:{digest}", payload)
+
+
 def load_store(target: str):
     if not enabled():
         return None
@@ -435,10 +461,10 @@ def tier_counts(s: dict) -> tuple[int, int]:
     deltas)."""
     hits = (s["ir_hits"] + s["mod_hits"] + s["plan_hits"]
             + s["store_hits"] + s.get("cert_hits", 0)
-            + s.get("fp_hits", 0))
+            + s.get("fp_hits", 0) + s.get("sp_hits", 0))
     misses = (s["ir_misses"] + s["mod_misses"] + s["plan_misses"]
               + s["store_misses"] + s.get("cert_misses", 0)
-              + s.get("fp_misses", 0))
+              + s.get("fp_misses", 0) + s.get("sp_misses", 0))
     return hits, misses
 
 

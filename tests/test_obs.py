@@ -308,10 +308,10 @@ class TestAttribution:
         assert ('gatekeeper_template_device_seconds'
                 '{template="K8sRequiredLabels"}') in text
         # memoized follow-up sweeps keep the lean phases dict (plus the
-        # Stage-5 selective-invalidation stanza)
+        # Stage-5/-6 selective-invalidation and sharding stanzas)
         _audit(jd, full=False)
         assert jd.last_sweep_phases["full"] is False
-        assert set(jd.last_sweep_phases) <= {"full", "footprint"}
+        assert set(jd.last_sweep_phases) <= {"full", "footprint", "shard"}
 
 
 # ----------------------------------------------------------------------
