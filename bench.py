@@ -54,10 +54,13 @@ from __future__ import annotations
 import copy
 import hashlib
 import json
+import math
 import os
 import random
+import shutil
 import statistics
 import sys
+import tempfile
 import threading
 import time
 
@@ -219,6 +222,24 @@ def _slim_headline() -> dict:
             ss["kinds_sharded"] = s2.get("kinds_sharded")
             ss["collectives"] = s2.get("collectives")
         slim["shard_sim"] = ss
+    sw = DETAIL.get("shadow_sweep")
+    if isinstance(sw, dict):
+        slim["shadow_sweep"] = {k: sw.get(k) for k in
+                                ("ratio", "within_budget", "parity",
+                                 "parity_digest",
+                                 "dedup_groups_cross_version")
+                                if sw.get(k) is not None}
+    rp = DETAIL.get("replay")
+    if isinstance(rp, dict):
+        slim["replay"] = {k: rp.get(k) for k in
+                          ("parity", "parity_digest", "stream_match")
+                          if rp.get(k) is not None}
+    fs2 = DETAIL.get("fleet_stack")
+    if isinstance(fs2, dict):
+        slim["fleet_stack"] = {k: fs2.get(k) for k in
+                               ("clusters", "parity", "kinds_stacked",
+                                "device_dispatches")
+                               if fs2.get(k) is not None}
     if DETAIL.get("aborted"):
         slim["aborted"] = DETAIL["aborted"]
     return slim
@@ -1338,6 +1359,171 @@ def bench_shard_sim(detail):
             + ", ".join(f"{ns}={data[ns]['digest']}" for ns in ("2", "4")))
 
 
+def bench_whatif(detail):
+    """What-if engine rows (ROADMAP item 5), one phase, three rows:
+
+    - ``shadow_sweep``: stage a library-scale candidate set beside the
+      live one and audit BOTH in one sweep; the acceptance gate is the
+      combined wall at < 1.5x a single-set sweep (damped) with the
+      candidate half bit-identical (sha256) to a standalone install;
+    - ``replay``: re-audit the live store snapshot in a fresh driver —
+      digest parity with the live sweep — plus a recorded admission
+      stream replayed exactly;
+    - ``fleet_stack``: 4 clusters stacked along a leading cluster axis,
+      one vmapped mega-sweep, bit-identical to the per-cluster loop
+      oracle.  In-process: the vmap needs one device, no subprocess."""
+    from gatekeeper_tpu.whatif import (ShadowSession, fleet_audit,
+                                       fleet_loop_oracle, make_cluster,
+                                       normalize_results, replay_admissions,
+                                       replay_snapshot,
+                                       standalone_candidate_verdicts,
+                                       verdict_digest)
+
+    # quick mode keeps the full 20k rows: below SMALL_WORKLOAD_EVALS the
+    # sweep routes to the scalar oracle and the <1.5x combined-wall gate
+    # would be measuring the wrong engine
+    n = sized(20_000, 1_000, 20_000)
+    log(f"[whatif] n={n}, library shadow sweep / replay / 4-cluster stack")
+    templates = [t for t, _c in all_docs()]
+    constraints = [c for _t, c in all_docs()]
+    jd = JaxDriver()
+    handler = K8sValidationTarget()
+    c = Backend(jd).new_client([handler])
+    for tdoc, cdoc in all_docs():
+        c.add_template(tdoc)
+        c.add_constraint(cdoc)
+    c.add_data_batch(make_mixed(random.Random(7), n))
+    state = jd._state(TARGET_NAME).table.snapshot_state()
+
+    # single-set wall (warm best-of-2) and the live verdict baseline
+    c.audit(limit_per_constraint=CAP, full=True)
+    single_s = math.inf
+    for _ in range(2):
+        t0 = time.perf_counter()
+        resp = c.audit(limit_per_constraint=CAP, full=True)
+        single_s = min(single_s, time.perf_counter() - t0)
+    baseline = normalize_results(resp.results())
+    live_digest = verdict_digest(baseline)
+
+    # --- shadow_sweep ---------------------------------------------------
+    candidate = constraints[1:]
+    with ShadowSession(c, tag="candidate") as sess:
+        sess.stage(templates, candidate)
+        sess.sweep(limit_per_constraint=CAP)         # compile/warm
+        t0 = time.perf_counter()
+        rep = sess.sweep(limit_per_constraint=CAP)
+        combined_s = time.perf_counter() - t0
+    oracle = standalone_candidate_verdicts(templates, candidate, state, CAP)
+    parity = rep.shadow == oracle and rep.live == baseline
+    within = combined_s <= single_s * 1.5 + 0.25
+    twin = (jd.last_sweep_phases.get("whatif") or {})
+    detail["shadow_sweep"] = {
+        "n_resources": n,
+        "single_set_seconds": round(single_s, 3),
+        "combined_seconds": round(combined_s, 3),
+        "ratio": round(combined_s / single_s, 3) if single_s else None,
+        "within_budget": within,
+        "parity": parity,
+        "parity_digest": rep.shadow_digest,
+        "added": len(rep.added), "cleared": len(rep.cleared),
+        "twin_shared_kinds": twin.get("twin_shared_kinds", 0),
+        "dedup_groups_cross_version": rep.dedup["groups_cross_version"],
+        "dedup_sites_cross_version": rep.dedup["sites_cross_version"],
+    }
+    log(f"[whatif] shadow: single {single_s:.2f}s combined "
+        f"{combined_s:.2f}s ({combined_s / max(single_s, 1e-9):.2f}x) "
+        f"parity={parity} twin_shared={twin.get('twin_shared_kinds', 0)} "
+        f"shared_groups={rep.dedup['groups_cross_version']}")
+
+    # --- replay ---------------------------------------------------------
+    rrep = replay_snapshot(templates, constraints, state, CAP)
+    snap_parity = rrep.verdicts == baseline
+    stream_match = None
+    saved_env = {k: os.environ.get(k) for k in
+                 ("GATEKEEPER_FLIGHT_DIR", "GATEKEEPER_FLIGHT_ADMISSION")}
+    corpus_dir = tempfile.mkdtemp(prefix="gk-whatif-corpus-")
+    try:
+        from gatekeeper_tpu.obs import flightrecorder as fr
+        from gatekeeper_tpu.webhook.policy import ValidationHandler
+        os.environ["GATEKEEPER_FLIGHT_DIR"] = corpus_dir
+        os.environ["GATEKEEPER_FLIGHT_ADMISSION"] = "1"
+        wh = ValidationHandler(c)
+        rec = fr.FlightRecorder(ring=64)
+        saved_rec, fr._recorder = fr._recorder, rec
+        try:
+            for obj in make_mixed(random.Random(11), 32):
+                wh.handle({"uid": "u", "operation": "CREATE",
+                           "kind": {"group": "", "version": "v1",
+                                    "kind": obj.get("kind", "")},
+                           "userInfo": {"username": "bench", "groups": []},
+                           "object": obj})
+        finally:
+            fr._recorder = saved_rec
+        events = fr.load_admission_corpus(corpus_dir)
+        srep = replay_admissions(events, c)
+        stream_match = srep.exact
+    finally:
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        shutil.rmtree(corpus_dir, ignore_errors=True)
+    detail["replay"] = {
+        "n_resources": n,
+        "wall_seconds": round(rrep.wall_s, 3),
+        "parity": snap_parity,
+        "parity_digest": rrep.digest,
+        "live_digest": live_digest,
+        "stream_replayed": srep.replayed,
+        "stream_match": stream_match,
+    }
+    log(f"[whatif] replay: snapshot parity={snap_parity} "
+        f"({rrep.wall_s:.2f}s), stream {srep.replayed} events "
+        f"exact={stream_match}")
+    del wh, resp, c, jd
+    import gc as _gc
+    _gc.collect()
+
+    # --- fleet_stack ----------------------------------------------------
+    n_clusters = 4
+    per = max(n // (n_clusters * 2), 50)
+    fleet = [make_cluster(f"c{i}", templates, constraints,
+                          objs=make_mixed(random.Random(100 + i), per))
+             for i in range(n_clusters)]
+    fleet_audit(fleet, CAP)                          # compile/warm
+    t0 = time.perf_counter()
+    frep = fleet_audit(fleet, CAP)
+    stacked_s = time.perf_counter() - t0
+    _v, digests, loop_s = fleet_loop_oracle(fleet, CAP)
+    fparity = frep.digests == digests
+    detail["fleet_stack"] = {
+        "clusters": n_clusters,
+        "rows_per_cluster": per,
+        "parity": fparity,
+        "digests": frep.digests,
+        "kinds_stacked": len(frep.kinds_stacked),
+        "kinds_replicated": len(frep.kinds_replicated),
+        "device_dispatches": frep.device_dispatches,
+        "stacked_seconds": round(stacked_s, 3),
+        "loop_seconds": round(loop_s, 3),
+    }
+    log(f"[whatif] fleet: {n_clusters}x{per} rows parity={fparity} "
+        f"stacked {stacked_s:.2f}s vs loop {loop_s:.2f}s "
+        f"({len(frep.kinds_stacked)} stacked / "
+        f"{len(frep.kinds_replicated)} replicated kinds)")
+    if not parity:
+        raise AssertionError(
+            f"shadow parity mismatch: sweep {rep.shadow_digest} vs "
+            f"standalone {verdict_digest(oracle)}")
+    if not snap_parity:
+        raise AssertionError(
+            f"replay parity mismatch: {rrep.digest} vs {live_digest}")
+    if not fparity:
+        raise AssertionError(
+            f"fleet parity mismatch: {frep.digests} vs {digests}")
+
+
 def bench_transval(detail):
     """Stage-4 translation validation at library scale: certify every
     device-lowered built-in template against the interpreter on its
@@ -1868,6 +2054,8 @@ def main():
     run_phase("transval", bench_transval, 240)
     quiesce_upgrades()
     run_phase("shard_sim", bench_shard_sim, 300)
+    quiesce_upgrades()
+    run_phase("whatif", bench_whatif, 400)
     quiesce_upgrades()
     run_phase("regex_heavy", bench_regex_heavy, 300)
     run_phase("selector_heavy", bench_selector_heavy, 300)

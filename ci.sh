@@ -77,6 +77,22 @@ echo "$SP" | grep -q " 0 violation(s)" \
 echo "$SP" | grep -Eq "(4[0-9]|[5-9][0-9]|[0-9]{3,}) shard-eligible" \
   || { echo "shardplan stage certified < 40 shard-eligible" >&2; exit 1; }
 
+echo "== whatif (shadow / replay / fleet parity probe) =="
+# What-if engine self-check: a shadow (live ∪ candidate) sweep must be
+# bit-identical to a standalone candidate install, snapshot replay must
+# reproduce the live digest, and a 2-cluster stacked sweep must match
+# the per-cluster loop oracle.  rc=1 is the warning tier (scalar
+# fallback — parity still holds); rc=2 (any parity break) fails the
+# build.
+WI_RC=0
+WI=$(JAX_PLATFORMS=cpu timeout -k 10 180 \
+     python -m gatekeeper_tpu.client.probe --whatif | tail -3) || WI_RC=$?
+echo "$WI"
+[ "$WI_RC" -le 1 ] \
+  || { echo "whatif stage failed (rc=$WI_RC)" >&2; exit 1; }
+echo "$WI" | grep -q " 0 parity failure(s)" \
+  || { echo "whatif stage found parity failures" >&2; exit 1; }
+
 echo "== tests (virtual 8-device CPU mesh) =="
 python -m pytest tests/ -q
 
@@ -219,6 +235,23 @@ sh = d.get("shard_sim")
 assert isinstance(sh, dict) and sh.get("parity") is True \
     and sh.get("kinds_sharded", 0) >= 40, \
     f"no shard_sim parity row in the trailing headline: {d}"
+# the what-if rows must survive the window: the combined live+shadow
+# sweep must be bit-identical to a standalone candidate install at
+# < 1.5x the single-set wall, snapshot + stream replay must reproduce
+# the recorded verdicts, and the 4-cluster stacked sweep must match
+# the per-cluster loop oracle
+ss = d.get("shadow_sweep")
+assert isinstance(ss, dict) and ss.get("parity") is True \
+    and ss.get("within_budget") is True, \
+    f"no within-budget shadow_sweep parity row in the headline: {d}"
+rp = d.get("replay")
+assert isinstance(rp, dict) and rp.get("parity") is True \
+    and rp.get("stream_match") is True, \
+    f"no replay parity row in the trailing headline: {d}"
+fs = d.get("fleet_stack")
+assert isinstance(fs, dict) and fs.get("parity") is True \
+    and fs.get("clusters", 0) >= 4, \
+    f"no 4-cluster fleet_stack parity row in the headline: {d}"
 print("bench ok:", d["metric"], round(d["value"], 1), d["unit"],
       f"({len(line)} headline chars; external_data warm "
       f"{xd['warm_seconds']}s vs baseline {xd['baseline_seconds']}s; "
@@ -226,6 +259,8 @@ print("bench ok:", d["metric"], round(d["value"], 1), d["unit"],
       f"{to.get('overhead_fraction')}; churn skipped "
       f"{cs['kinds_skipped']} kinds, saved "
       f"{cs['evaluations_saved']} evals; shard_sim parity "
-      f"{sh['parity_digest']} with {sh['kinds_sharded']} kinds sharded)")
+      f"{sh['parity_digest']} with {sh['kinds_sharded']} kinds sharded; "
+      f"shadow {ss.get('ratio')}x parity {ss.get('parity_digest')}; "
+      f"fleet {fs.get('clusters')} clusters parity ok)")
 EOF
 echo "CI PASS"

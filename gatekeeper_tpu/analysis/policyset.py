@@ -293,6 +293,60 @@ def shared_binding(digest: str, ekind: str) -> str:
             else f"__shared__:{digest}")
 
 
+# ---------------------------------------------------------------------------
+# shadow policy-set version tags (whatif/shadow.py)
+#
+# A shadow install stages a candidate set BESIDE the live one in the
+# same client, under constraint kinds mangled with a version tag.  The
+# canonical conjunct digests above are computed from program structure
+# and folded params only — never from the kind name — so identical
+# conjuncts in the live and candidate versions of a template land in
+# the same SharedGroup automatically: cross-version sharing is the
+# cross-template mechanism, verbatim.
+
+SHADOW_SEP = "__WHATIF__"
+"""Kind-name separator for shadow policy-set versions.  Double
+underscore + caps keeps it out of the CamelCase namespace real
+template kinds use."""
+
+
+def shadow_kind(kind: str, tag: str) -> str:
+    """Mangle a template/constraint kind into its shadow-version name."""
+    if SHADOW_SEP in kind:
+        raise ValueError(f"already a shadow kind: {kind}")
+    if not tag or not tag.replace("-", "").replace("_", "").isalnum():
+        raise ValueError(f"bad shadow tag: {tag!r}")
+    return f"{kind}{SHADOW_SEP}{tag}"
+
+
+def split_shadow_kind(kind: str) -> tuple[str, str | None]:
+    """(logical kind, version tag or None for the live set)."""
+    base, sep, tag = kind.partition(SHADOW_SEP)
+    return (base, tag) if sep else (base, None)
+
+
+def is_shadow_kind(kind: str) -> bool:
+    return SHADOW_SEP in kind
+
+
+def cross_version_groups(plan: DedupPlan) -> dict:
+    """Accounting for the shadow report: of the plan's shared groups,
+    how many span policy-set versions (live + at least one shadow tag,
+    or two tags), vs. sharing within one version only."""
+    cross = 0
+    within = 0
+    sites_cross = 0
+    for g in plan.groups.values():
+        versions = {split_shadow_kind(k)[1] for k in g.members}
+        if len(versions) > 1:
+            cross += 1
+            sites_cross += g.total_sites
+        else:
+            within += 1
+    return {"groups_cross_version": cross, "groups_within_version": within,
+            "sites_cross_version": sites_cross}
+
+
 def build_dedup_plan(kinds: dict) -> DedupPlan:
     """kinds: kind -> (LoweredProgram, constraints).  Groups every
     shareable conjunct digest with >= 2 sites across the set and

@@ -19,7 +19,7 @@ from typing import Any, Iterator
 from gatekeeper_tpu.api.templates import CompiledTemplate
 from gatekeeper_tpu.client.interface import Driver, QueryOpts
 from gatekeeper_tpu.client.targets import TargetHandler
-from gatekeeper_tpu.client.types import Result
+from gatekeeper_tpu.client.types import Result, enforcement_action_of
 from gatekeeper_tpu.errors import ClientError
 from gatekeeper_tpu.rego.values import Obj, freeze, thaw
 from gatekeeper_tpu.store.table import ResourceMeta, ResourceTable
@@ -291,6 +291,7 @@ class LocalDriver(Driver):
                 metadata={"details": thaw(details)},
                 constraint=constraint,
                 review=review,
+                enforcement_action=enforcement_action_of(constraint),
             )
         if trace is not None:
             cname = (constraint.get("metadata") or {}).get("name")
@@ -314,7 +315,8 @@ class LocalDriver(Driver):
         # autoreject (regolib src.go:7-17)
         for c, msg, details in handler.autoreject_review(review, constraints, st.table):
             results.append(Result(msg=msg, metadata={"details": details},
-                                  constraint=c, review=review))
+                                  constraint=c, review=review,
+                                  enforcement_action=enforcement_action_of(c)))
         frozen_review = freeze(review)
         shared: dict = {}    # one review, many constraints: share
         #                      review-pure comprehension results

@@ -866,6 +866,92 @@ def run_shardplan(paths: list[str], use_library: bool = False) -> int:
     return _severity_rc(n_viol + errs["n"], n_inelig + n_pin)
 
 
+def run_whatif() -> int:
+    """``--whatif``: self-validate the what-if engine's three parity
+    contracts over the built-in library (ROADMAP item 5) —
+
+    - shadow: one combined live ∪ candidate sweep, candidate half
+      bit-identical to a standalone candidate install;
+    - replay: a store-snapshot re-audit reproduces the live verdicts;
+    - fleet: a 2-cluster stacked mega-sweep matches the per-cluster
+      loop oracle.
+
+    Exit contract (:func:`_severity_rc`): 2 on any parity break, 1 when
+    parity held but only on the scalar fallback (semantics validated,
+    device NOT — same distinction as the engine probe verdict line),
+    0 clean on the device path."""
+    import os as _os
+    import random
+
+    from gatekeeper_tpu.client.client import Backend
+    from gatekeeper_tpu.engine.jax_driver import JaxDriver
+    from gatekeeper_tpu.library import all_docs, make_mixed
+    from gatekeeper_tpu.target.k8s import K8sValidationTarget
+    from gatekeeper_tpu.whatif import (ShadowSession, fleet_audit,
+                                       fleet_loop_oracle, make_cluster,
+                                       normalize_results, replay_snapshot,
+                                       standalone_candidate_verdicts,
+                                       verdict_digest)
+
+    n = int(_os.environ.get("GATEKEEPER_WHATIF_PROBE_N", "300"))
+    pairs = all_docs()
+    templates = [t for t, _c in pairs]
+    constraints = [c for _t, c in pairs]
+    driver = JaxDriver()
+    handler = K8sValidationTarget()
+    client = Backend(driver).new_client([handler])
+    for d in templates:
+        client.add_template(d)
+    for d in constraints:
+        client.add_constraint(d)
+    client.add_data_batch(make_mixed(random.Random(7), n))
+    state = driver._state(handler.name).table.snapshot_state()
+    baseline = normalize_results(
+        client.audit(limit_per_constraint=20, full=True).results())
+    n_err = 0
+
+    candidate = constraints[1:]
+    with ShadowSession(client, tag="candidate") as sess:
+        sess.stage(templates, candidate)
+        rep = sess.sweep(limit_per_constraint=20)
+    oracle = standalone_candidate_verdicts(templates, candidate, state, 20)
+    ok = rep.shadow == oracle and rep.live == baseline
+    n_err += 0 if ok else 1
+    print(f"  {'ok  ' if ok else 'FAIL'} shadow: live={len(rep.live)} "
+          f"candidate={len(rep.shadow)} added={len(rep.added)} "
+          f"cleared={len(rep.cleared)} digest={rep.shadow_digest} "
+          f"oracle={verdict_digest(oracle)} "
+          f"shared_groups={rep.dedup['groups_cross_version']}")
+
+    rrep = replay_snapshot(templates, constraints, state, 20)
+    ok = rrep.verdicts == baseline
+    n_err += 0 if ok else 1
+    print(f"  {'ok  ' if ok else 'FAIL'} replay: "
+          f"{rrep.n_resources} resource(s) -> {len(rrep.verdicts)} "
+          f"verdict(s) digest={rrep.digest} in {rrep.wall_s:.2f}s")
+
+    fleet = [make_cluster(f"c{i}", templates, constraints,
+                          objs=make_mixed(random.Random(100 + i), n // 3))
+             for i in range(2)]
+    frep = fleet_audit(fleet, 20)
+    _v, digests, _w = fleet_loop_oracle(fleet, 20)
+    ok = frep.digests == digests
+    n_err += 0 if ok else 1
+    print(f"  {'ok  ' if ok else 'FAIL'} fleet: {frep.n_clusters} "
+          f"cluster(s), {len(frep.kinds_stacked)} stacked / "
+          f"{len(frep.kinds_replicated)} replicated kind(s), "
+          f"{frep.device_dispatches} dispatch(es), digests="
+          f"{','.join(frep.digests)}")
+
+    scalar = bool(getattr(driver, "scalar_only", False))
+    if scalar:
+        print("  warn scalar-only backend: parity validated on the "
+              "oracle path, device NOT")
+    print(f"whatif: {n_err} parity failure(s) "
+          f"({'scalar-fallback' if scalar else 'device'})")
+    return _severity_rc(n_err, 1 if scalar else 0)
+
+
 def run_health() -> int:
     """``probe --health``: the k8s liveness/readiness consumer.  One
     JSON line with the backend supervisor's serving posture (state,
@@ -922,6 +1008,7 @@ def _run_subcommand(argv: list[str]) -> int | None:
         out = pos[i + 1] if i + 1 < len(pos) else None
         del pos[i:i + 2]
     table = (
+        ("--whatif", lambda rest: run_whatif()),
         ("--policyset", lambda rest: run_policyset()),
         ("--cost", lambda rest: run_cost()),
         ("--trace", lambda rest: run_trace(out)),

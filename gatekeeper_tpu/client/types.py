@@ -22,6 +22,20 @@ class Result:
     enforcement_action: str = "deny"
 
 
+ENFORCEMENT_ACTIONS = ("deny", "dryrun", "warn")
+"""Recognized ``spec.enforcementAction`` values (reference:
+apis/constraints ValidActions).  Anything else is treated as deny —
+fail closed on typos."""
+
+
+def enforcement_action_of(constraint: dict | None) -> str:
+    """A constraint's effective enforcement action, normalized."""
+    action = ((constraint or {}).get("spec") or {}).get("enforcementAction")
+    if isinstance(action, str) and action in ENFORCEMENT_ACTIONS:
+        return action
+    return "deny"
+
+
 @dataclasses.dataclass
 class Response:
     target: str

@@ -72,6 +72,18 @@ class ReconcileConstraint(Reconciler):
                          "location": str(d.location)})
             except Exception:
                 pass        # set analysis must never block enforcement
+            # unknown enforcementAction values fail closed to deny in
+            # the webhook (client/types.enforcement_action_of); surface
+            # the typo here so the author learns before a rollout does
+            action = (instance.get("spec") or {}).get("enforcementAction")
+            if action is not None:
+                from gatekeeper_tpu.client.types import ENFORCEMENT_ACTIONS
+                if action not in ENFORCEMENT_ACTIONS:
+                    status.setdefault("warnings", []).append(
+                        {"code": "unknown_enforcement_action",
+                         "message": f"unknown enforcementAction "
+                                    f"{action!r}; treating as deny",
+                         "location": "spec.enforcementAction"})
             status["enforced"] = True
             set_ha_status(instance, status)
             _, result = self._update(instance)

@@ -45,7 +45,7 @@ from gatekeeper_tpu.api.templates import CompiledTemplate
 from gatekeeper_tpu.client.interface import QueryOpts
 from gatekeeper_tpu.client.local_driver import (LocalDriver, TargetState,
                                                 locked, locked_read)
-from gatekeeper_tpu.client.types import Result
+from gatekeeper_tpu.client.types import Result, enforcement_action_of
 from gatekeeper_tpu.engine.veval import ProgramExecutor
 from gatekeeper_tpu.errors import ExternalDataError
 from gatekeeper_tpu.ir.lower import CannotLower, lower_template
@@ -327,6 +327,32 @@ class JaxDriver(LocalDriver):
             return False
         st.table.restore_state(hit[0])
         return True
+
+    @locked
+    def adopt_store(self, target: str, state: dict) -> None:
+        """Swap the target's columnar store for a fresh table built
+        from a ``snapshot_state()`` payload — the
+        load-snapshot-as-secondary-store path (whatif/replay.py).
+        Unlike restore_store_snapshot this is valid on a non-empty
+        driver: every table-derived cache layer is dropped, because the
+        new table's generation counters restart and would otherwise
+        collide with cached keys from the old table."""
+        from gatekeeper_tpu.store.table import ResourceTable
+        st = self._state(target)
+        st.table = ResourceTable.from_state(state)
+        st._inv_cache = None
+        if isinstance(st, JaxTargetState):
+            st.bindings_cache = {}
+            st.bindings_retired = {}
+            st.mask_cache = {}
+            st.installed_match = {}
+            st.rank_cache = None
+            st.order_cache = None
+            st.fmt_cache = {}
+            st.match_engine = None
+            st.sweep_cache = {}
+            for kind in list(st.templates):
+                st.bump(kind)
 
     @locked
     def put_template(self, target: str, kind: str, compiled: CompiledTemplate) -> None:
@@ -1172,6 +1198,88 @@ class JaxDriver(LocalDriver):
                 + plan.groups[digest].members[kind].sites
         return plan.rewritten[kind]
 
+    @staticmethod
+    def _twin_bindings_equal(a, b) -> bool:
+        """True when two kinds' bound arrays are bit-identical — same
+        names, shapes, dtypes, contents.  Shared dedup columns are the
+        same objects in both dicts, so identity short-circuits the
+        common case; everything else pays one host memcmp."""
+        if a is None or b is None:
+            return False
+        if a.c_pad != b.c_pad or a.r_pad != b.r_pad:
+            return False
+        if set(a.arrays) != set(b.arrays):
+            return False
+        for name, x in a.arrays.items():
+            y = b.arrays[name]
+            if x is y:
+                continue
+            try:
+                xa, ya = np.asarray(x), np.asarray(y)
+            except Exception:
+                return False
+            if xa.shape != ya.shape or xa.dtype != ya.dtype \
+                    or not np.array_equal(xa, ya):
+                return False
+        return True
+
+    def _twin_future(self, twin_src: dict, mode: str, kind: str,
+                     prog, bindings, specs: list, futures: list):
+        """Whole-kind dispatch sharing for what-if (shadow) sweeps.
+
+        A shadow install stages the candidate set's kinds beside the
+        live set under mangled names (analysis/policyset.shadow_kind).
+        For every template the candidate did NOT change, the shadow
+        twin lowers to the same program (cache keys match — kind names
+        never reach the IR) over bit-identical bound arrays, so its
+        device dispatch would recompute the live kind's payload
+        exactly.  This seam detects that case after gate install and
+        dedup rewrite, and aliases the shadow kind to the live twin's
+        in-flight future instead of dispatching — the combined
+        live+shadow sweep then pays device time only for kinds the
+        candidate actually changed.  Handles resolve idempotently
+        (PendingTopK/PendingMask.get is a pure D2H read), so both
+        slots format from the one payload.  Each alias gets a fresh
+        chained Future: phase 2 keys its completion map by future
+        object, and a shared object would collapse two slots into one.
+
+        Live (unmangled) kinds register; shadow kinds return a chained
+        Future when their twin matches, else None (normal dispatch).
+        Any comparison failure falls back to dispatching — sharing is
+        an optimization, never a correctness dependency."""
+        from gatekeeper_tpu.analysis.policyset import split_shadow_kind
+        base, tag = split_shadow_kind(kind)
+        if tag is None:
+            twin_src[(kind, mode)] = len(futures)
+            return None
+        si = twin_src.get((base, mode))
+        if si is None:
+            return None
+        src_fut = futures[si]
+        if src_fut is None:
+            return None
+        s_prog, s_bind = specs[si][4], specs[si][5]
+        try:
+            if s_prog is None or s_prog.cache_key() != prog.cache_key():
+                return None
+        except Exception:
+            return None
+        if not self._twin_bindings_equal(s_bind, bindings):
+            return None
+        import concurrent.futures
+        self.metrics.counter("whatif_twin_dispatches_shared").inc()
+        out: "concurrent.futures.Future" = concurrent.futures.Future()
+
+        def _chain(src, out=out):
+            exc = src.exception()
+            if exc is not None:
+                out.set_exception(exc)
+            else:
+                out.set_result(src.result())
+
+        src_fut.add_done_callback(_chain)
+        return out
+
     # ------------------------------------------------------------------
 
     @locked_read
@@ -1368,6 +1476,17 @@ class JaxDriver(LocalDriver):
             fp_enabled = not self.scalar_only and _fp_mode() != "off"
             fp_skipped: list[str] = []
             fp_saved = 0
+            # what-if twin sharing (whatif/shadow.py): when shadow
+            # kinds are staged, an unchanged twin aliases the live
+            # kind's dispatch instead of re-running it on device.
+            # GATEKEEPER_WHATIF_SHARE=off is the parity oracle.
+            _twin_src: dict | None = None
+            twin_shared: list[str] = []
+            if full and not self.scalar_only and \
+                    os.environ.get("GATEKEEPER_WHATIF_SHARE", "on") != "off":
+                from gatekeeper_tpu.analysis.policyset import is_shadow_kind
+                if any(is_shadow_kind(k) for k in st.templates):
+                    _twin_src = {}
             # Stage-6 plan gating (analysis/shardplan.py): on a mesh,
             # a kind's bindings shard only when its partition plan
             # certifies eligibility; uncertified/ineligible kinds pin
@@ -1500,6 +1619,15 @@ class JaxDriver(LocalDriver):
                             spec = (mode, kind, compiled, constraints, prog,
                                     bindings, mask)
                             _prep_done(kind, _tk)
+                            if _twin_src is not None:
+                                tf = self._twin_future(
+                                    _twin_src, mode, kind, prog, bindings,
+                                    specs, futures)
+                                if tf is not None:
+                                    twin_shared.append(kind)
+                                    futures.append(tf)
+                                    specs.append(spec)
+                                    continue
                             # serial_full: the no-overlap diagnostic
                             # baseline — dispatch inline and (because
                             # dispatch blocks on full sweeps) finish
@@ -1693,6 +1821,13 @@ class JaxDriver(LocalDriver):
                     m.counter("dedup_evaluations_saved").inc(saved)
                 else:
                     self.last_sweep_phases["dedup"] = {"enabled": False}
+                if _twin_src is not None:
+                    self.last_sweep_phases["whatif"] = {
+                        "twin_shared_kinds": len(twin_shared),
+                        "twin_dispatched_kinds": sum(
+                            1 for s in specs
+                            if s[0] in ("topk", "mask")) - len(twin_shared),
+                    }
                 m.counter("full_sweeps").inc()
                 m.timer("full_sweep_host_prep").observe(ph["host_prep_s"])
                 m.timer("full_sweep_h2d").observe(ph["h2d_s"])
@@ -1840,7 +1975,9 @@ class JaxDriver(LocalDriver):
                         rv, ns_sel_cons, st.table):
                     results.append(Result(msg=msg,
                                           metadata={"details": details},
-                                          constraint=c, review=rv))
+                                          constraint=c, review=rv,
+                                          enforcement_action=
+                                          enforcement_action_of(c)))
             frozen = freeze(rv)
             for kind, compiled, cons, gate in gates:
                 for ci, c in enumerate(cons):

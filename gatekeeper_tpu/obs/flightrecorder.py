@@ -13,6 +13,15 @@ artifact under ``GATEKEEPER_FLIGHT_DIR`` (default
 ``GATEKEEPER_FLIGHT_KEEP`` (default 20) files.  It is invoked
 automatically on supervisor degradation, ``GATEKEEPER_FAULT=*`` trips,
 and bench rc-3 exits — PR-7's "fail loudly" with evidence attached.
+
+Admission corpus (whatif/replay.py): with
+``GATEKEEPER_FLIGHT_ADMISSION=1`` the webhook also persists one JSONL
+line per AdmissionReview — payload capped at
+``GATEKEEPER_FLIGHT_PAYLOAD_BYTES`` (default 8192) and redacted
+(``metadata.managedFields`` stripped, secret-shaped values replaced)
+BEFORE anything touches disk — as ``admission-*.jsonl`` files under
+the flight dir, pruned by the same ``GATEKEEPER_FLIGHT_KEEP`` policy.
+``load_admission_corpus`` reads them back for replay.
 """
 
 from __future__ import annotations
@@ -36,6 +45,88 @@ def _flight_dir() -> str:
         os.path.join(tempfile.gettempdir(), "gatekeeper-flight"))
 
 
+# ---------------------------------------------------------------------------
+# admission corpus hygiene: redact, then cap, then persist
+
+REDACTED = "[REDACTED]"
+
+_SECRET_KEY_HINTS = ("password", "passwd", "token", "secret", "credential",
+                     "apikey", "api_key", "authorization", "private_key",
+                     "privatekey", "client_key")
+
+
+def _secret_shaped_key(key: str) -> bool:
+    k = key.lower()
+    return any(h in k for h in _SECRET_KEY_HINTS)
+
+
+def redact_payload(obj: Any, _secretish: bool = False) -> Any:
+    """Deep-copying redaction for a to-be-persisted k8s object:
+    ``metadata.managedFields`` is dropped outright, string values under
+    secret-shaped keys (and every string of a Secret's ``data`` /
+    ``stringData`` maps) are replaced with a marker.  Only strings are
+    secret material — booleans/numbers under a matching key (e.g. the
+    ``automountServiceAccountToken`` flag) pass through, so replaying a
+    redacted corpus still evaluates them faithfully.  The input is
+    never mutated — the webhook still evaluates the original."""
+    if isinstance(obj, dict):
+        is_secret = obj.get("kind") == "Secret"
+        out = {}
+        for k, v in obj.items():
+            if not isinstance(k, str):
+                out[k] = redact_payload(v, _secretish)
+                continue
+            if k == "metadata" and isinstance(v, dict):
+                out[k] = {mk: redact_payload(mv, _secretish)
+                          for mk, mv in v.items() if mk != "managedFields"}
+                continue
+            blob = (_secretish or _secret_shaped_key(k)
+                    or (is_secret and k in ("data", "stringData")))
+            out[k] = redact_payload(v, blob)
+        return out
+    if isinstance(obj, list):
+        return [redact_payload(v, _secretish) for v in obj]
+    if _secretish and isinstance(obj, str):
+        return REDACTED
+    return obj
+
+
+def payload_byte_cap() -> int:
+    try:
+        return int(os.environ.get("GATEKEEPER_FLIGHT_PAYLOAD_BYTES", "8192"))
+    except ValueError:
+        return 8192
+
+
+def cap_payload(obj: Any, cap: Optional[int] = None) -> Any:
+    """Bound one persisted object to ``cap`` serialized bytes.  An
+    oversize object is deterministically reduced to its identifying
+    envelope (apiVersion/kind/name/namespace/labels) plus a truncation
+    marker carrying the original size — replay treats truncated events
+    as unreplayable rather than silently evaluating a partial object."""
+    if cap is None:
+        cap = payload_byte_cap()
+    try:
+        size = len(json.dumps(obj, sort_keys=True, default=str))
+    except Exception:
+        return {"__truncated__": True, "__bytes__": -1}
+    if size <= cap or not isinstance(obj, dict):
+        return obj
+    meta = obj.get("metadata") or {}
+    return {
+        "apiVersion": obj.get("apiVersion"),
+        "kind": obj.get("kind"),
+        "metadata": {k: meta.get(k) for k in ("name", "namespace", "labels")
+                     if k in meta},
+        "__truncated__": True,
+        "__bytes__": size,
+    }
+
+
+def admission_corpus_enabled() -> bool:
+    return os.environ.get("GATEKEEPER_FLIGHT_ADMISSION", "") not in ("", "0")
+
+
 class FlightRecorder:
     def __init__(self, ring: Optional[int] = None):
         if ring is None:
@@ -43,6 +134,7 @@ class FlightRecorder:
         self._lock = threading.Lock()
         self._events: collections.deque[dict] = collections.deque(maxlen=ring)
         self._dump_seq = 0
+        self._corpus_path: Optional[str] = None
 
     def record(self, etype: str, **fields: Any) -> None:
         """Append one event; never raises."""
@@ -115,18 +207,71 @@ class FlightRecorder:
             return None
 
     @staticmethod
-    def _prune(d: str) -> None:
+    def _prune(d: str, prefix: str = "flight-",
+               suffix: str = ".json") -> None:
         keep = int(os.environ.get("GATEKEEPER_FLIGHT_KEEP", "20"))
         try:
             files = sorted(
                 f for f in os.listdir(d)
-                if f.startswith("flight-") and f.endswith(".json"))
+                if f.startswith(prefix) and f.endswith(suffix))
             for stale in files[:-keep] if keep > 0 else files:
                 try:
                     os.unlink(os.path.join(d, stale))
                 except OSError:
                     pass
         except OSError:
+            pass
+
+    def record_admission(self, request: dict, allowed: bool,
+                         verdicts: Optional[list] = None,
+                         warnings: Optional[list] = None) -> None:
+        """Record one AdmissionReview as a replayable corpus event.
+
+        The ring always gets a small summary event.  When the corpus is
+        enabled (GATEKEEPER_FLIGHT_ADMISSION=1) the full — redacted,
+        byte-capped — request is appended as one JSONL line to this
+        recorder's ``admission-*.jsonl`` file, pruned under the same
+        GATEKEEPER_FLIGHT_KEEP policy as the dump artifacts.  Never
+        raises: recording must not become an admission failure mode."""
+        try:
+            obj = (request.get("object") or {})
+            self.record("admission",
+                        operation=request.get("operation"),
+                        kind=((request.get("kind") or {}).get("kind")),
+                        name=(obj.get("metadata") or {}).get("name"),
+                        allowed=allowed, verdicts=len(verdicts or ()))
+            if not admission_corpus_enabled():
+                return
+            cap = payload_byte_cap()
+            req = dict(request)
+            for f in ("object", "oldObject"):
+                if isinstance(req.get(f), dict):
+                    req[f] = cap_payload(redact_payload(req[f]), cap)
+            event = {
+                "ts": round(time.time(), 6),
+                "request": req,
+                "allowed": bool(allowed),
+                "warnings": list(warnings or ()),
+                "verdicts": [
+                    {"kind": (v.constraint or {}).get("kind"),
+                     "name": ((v.constraint or {}).get("metadata") or {})
+                     .get("name"),
+                     "action": v.enforcement_action,
+                     "msg": v.msg}
+                    for v in (verdicts or ())],
+            }
+            d = _flight_dir()
+            os.makedirs(d, exist_ok=True)
+            with self._lock:
+                if self._corpus_path is None:
+                    stamp = time.strftime("%Y%m%dT%H%M%S", time.gmtime())
+                    self._corpus_path = os.path.join(
+                        d, f"admission-{stamp}-{os.getpid()}.jsonl")
+                    self._prune(d, prefix="admission-", suffix=".jsonl")
+                with open(self._corpus_path, "a") as f:
+                    f.write(json.dumps(event, sort_keys=True,
+                                       default=str) + "\n")
+        except Exception:  # pragma: no cover - best effort
             pass
 
 
@@ -146,3 +291,33 @@ def get_flight_recorder() -> FlightRecorder:
 def record_event(etype: str, **fields: Any) -> None:
     """Module-level convenience for instrumentation seams."""
     get_flight_recorder().record(etype, **fields)
+
+
+def load_admission_corpus(directory: Optional[str] = None) -> list[dict]:
+    """Read every ``admission-*.jsonl`` corpus file (oldest file first,
+    append order within a file) back into replayable events.  Unparsable
+    lines are skipped — a torn final line from a crashed writer must not
+    sink the rest of the corpus."""
+    d = directory or _flight_dir()
+    events: list[dict] = []
+    try:
+        names = sorted(f for f in os.listdir(d)
+                       if f.startswith("admission-") and f.endswith(".jsonl"))
+    except OSError:
+        return events
+    for name in names:
+        try:
+            with open(os.path.join(d, name)) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        ev = json.loads(line)
+                    except ValueError:
+                        continue
+                    if isinstance(ev, dict) and "request" in ev:
+                        events.append(ev)
+        except OSError:
+            continue
+    return events

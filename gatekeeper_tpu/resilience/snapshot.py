@@ -175,10 +175,11 @@ def _python_tag() -> str:
     return f"cpython-{sys.version_info[0]}.{sys.version_info[1]}"
 
 
-def snapshot_dir(create: bool = False) -> str | None:
+def snapshot_dir(create: bool = False, root: str | None = None) -> str | None:
     """Per-(host, python, format-version) subdirectory — marshalled
-    code never crosses an interpreter or format boundary."""
-    root = os.environ.get("GATEKEEPER_SNAPSHOT_DIR")
+    code never crosses an interpreter or format boundary.  ``root``
+    overrides the env var (historical-snapshot reads, whatif/replay.py)."""
+    root = root or os.environ.get("GATEKEEPER_SNAPSHOT_DIR")
     if not root:
         return None
     from gatekeeper_tpu.utils.compile_cache import host_fingerprint
@@ -189,8 +190,9 @@ def snapshot_dir(create: bool = False) -> str | None:
     return d
 
 
-def _entry_path(category: str, key: str) -> str | None:
-    d = snapshot_dir()
+def _entry_path(category: str, key: str,
+                root: str | None = None) -> str | None:
+    d = snapshot_dir(root=root)
     if d is None:
         return None
     h = hashlib.sha256(key.encode()).hexdigest()[:24]
@@ -230,11 +232,11 @@ def _write_entry(category: str, key: str, payload: bytes) -> bool:
         return False
 
 
-def _read_entry(category: str, key: str):
+def _read_entry(category: str, key: str, root: str | None = None):
     """Returns the unpickled payload in a 1-tuple, or None on miss.
     Any validation or unpickle failure deletes the entry (rebuild on
     the cold path) — corruption must never crash startup."""
-    path = _entry_path(category, key)
+    path = _entry_path(category, key, root=root)
     if path is None or not os.path.exists(path):
         return None
     from gatekeeper_tpu.resilience import faults
@@ -432,10 +434,13 @@ def save_shardplan(digest: str, plan) -> bool:
     return _write_entry("sp", f"sp:{digest}", payload)
 
 
-def load_store(target: str):
-    if not enabled():
+def load_store(target: str, root: str | None = None):
+    """Load the store tier.  With ``root``, read from that snapshot
+    root explicitly (a *historical* snapshot directory, independent of
+    GATEKEEPER_SNAPSHOT_DIR) — the replay path's time machine."""
+    if root is None and not enabled():
         return None
-    got = _read_entry("store", f"store:{target}")
+    got = _read_entry("store", f"store:{target}", root=root)
     stats.bump("store_hits" if got is not None else "store_misses")
     return got
 

@@ -298,6 +298,17 @@ class ResourceTable:
         if entries:
             self.bulk_upsert(entries)
 
+    @classmethod
+    def from_state(cls, state: dict) -> "ResourceTable":
+        """A fresh secondary table built from a ``snapshot_state()``
+        payload — the load-snapshot-as-secondary-store path
+        (whatif/replay.py).  The live table is untouched; the copy gets
+        its own interner seeded in saved order, so its encoded columns
+        are bit-identical to the snapshotting process."""
+        t = cls()
+        t.restore_state(state)
+        return t
+
     def dirty_rows_since(self, gen: int) -> np.ndarray:
         """Row indices modified (upserted/tombstoned) after generation
         `gen` — the delta set for every incremental consumer.  Only valid

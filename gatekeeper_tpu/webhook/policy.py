@@ -106,12 +106,45 @@ class ValidationHandler:
         except GatekeeperError as e:
             return deny(500, str(e))
         results = resp.results()
-        if results:
+        # enforcementAction routing (reference webhook validateGatekeeper
+        # resources + getValidationMessages): deny blocks the request;
+        # warn admits it with AdmissionResponse warnings; dryrun admits
+        # silently — all three still report the violation (metrics +
+        # admission corpus), which is what makes dryrun a shadow rollout
+        # primitive (see whatif/shadow.py for the sweep-scale version).
+        denials = [r for r in results if r.enforcement_action
+                   not in ("warn", "dryrun")]
+        warns = [r for r in results if r.enforcement_action == "warn"]
+        dryruns = [r for r in results if r.enforcement_action == "dryrun"]
+        if warns:
+            self.metrics.counter("admission_warn_violations").inc(len(warns))
+        if dryruns:
+            self.metrics.counter("admission_dryrun_violations").inc(
+                len(dryruns))
+        warnings = [f"[warn by {(r.constraint.get('metadata') or {}).get('name', '')}] "
+                    f"{r.msg}" for r in warns]
+        if denials:
             msgs = [f"[denied by {(r.constraint.get('metadata') or {}).get('name', '')}] "
-                    f"{r.msg}" for r in results]
+                    f"{r.msg}" for r in denials]
             self.metrics.counter("admission_denied").inc()
-            return deny(403, "\n".join(msgs))
-        return allow()
+            out = deny(403, "\n".join(msgs))
+        else:
+            out = allow()
+        if warnings:
+            out["warnings"] = warnings
+        self._record_admission(request, out, results, warnings)
+        return out
+
+    def _record_admission(self, request, out, results, warnings) -> None:
+        """Feed the flight recorder's replayable admission corpus
+        (opt-in, GATEKEEPER_FLIGHT_ADMISSION=1); never raises."""
+        try:
+            from gatekeeper_tpu.obs.flightrecorder import get_flight_recorder
+            get_flight_recorder().record_admission(
+                request, bool(out.get("allowed")), verdicts=results,
+                warnings=warnings)
+        except Exception:
+            pass
 
     # ------------------------------------------------------------------
 

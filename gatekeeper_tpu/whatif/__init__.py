@@ -1,0 +1,74 @@
+"""What-if engine: policy simulation on the audit kernels.
+
+Three entry points over one engine path (ROADMAP item 5):
+
+- ``shadow`` — stage a candidate policy set *beside* the live one under
+  a version tag and evaluate both in a single device sweep; the PR-5
+  dedup plan shares canonical conjuncts across the versions, and the
+  report is a would-be-denied diff (``added`` / ``cleared`` violations
+  per constraint) plus a parity digest bit-identical to installing the
+  candidate standalone.
+- ``replay`` — re-audit a historical versioned store snapshot, or
+  re-review a recorded admission-stream corpus (obs/flightrecorder),
+  against either policy set: "what would this change have rejected
+  last week?".
+- ``fleet`` — stack N clusters' columnar stores along a leading
+  cluster axis and evaluate the whole fleet as one vmapped mega-sweep
+  with per-cluster capped top-k, reusing the Stage-6 partition-plan /
+  footprint eligibility gates; a per-cluster loop is the bit-identical
+  oracle.
+
+All three report verdicts in one normalized form (`normalize_results`)
+whose sha256 digest (`verdict_digest`) is the parity currency across
+this package, the bench rows, and the tests.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+from gatekeeper_tpu.analysis.policyset import split_shadow_kind
+
+
+def normalize_result(r) -> tuple:
+    """One Result -> a driver-independent verdict tuple.  Shadow kinds
+    collapse to their logical kind, so a shadow sweep's candidate
+    verdicts compare bit-identically against a standalone install of
+    the candidate set."""
+    con = r.constraint or {}
+    kind, _tag = split_shadow_kind(con.get("kind", ""))
+    cname = (con.get("metadata") or {}).get("name", "")
+    review = r.review if isinstance(r.review, dict) else {}
+    rk = review.get("kind") or {}
+    return (kind, cname,
+            rk.get("group", ""), rk.get("version", ""), rk.get("kind", ""),
+            review.get("namespace") or "", review.get("name", ""),
+            r.msg)
+
+
+def normalize_results(results) -> list[tuple]:
+    return sorted(normalize_result(r) for r in results)
+
+
+def verdict_digest(verdicts: list[tuple]) -> str:
+    """Order-independent sha256 over normalized verdicts — 16 hex
+    chars, same idiom as the bench parity digests."""
+    return hashlib.sha256(
+        repr(sorted(verdicts)).encode()).hexdigest()[:16]
+
+
+from gatekeeper_tpu.whatif.shadow import (ShadowReport, ShadowSession,  # noqa: E402
+                                          standalone_candidate_verdicts)
+from gatekeeper_tpu.whatif.replay import (ReplayReport, StreamReplayReport,  # noqa: E402
+                                          load_historical_store,
+                                          replay_admissions, replay_snapshot)
+from gatekeeper_tpu.whatif.fleet import (FleetReport, fleet_audit,  # noqa: E402
+                                         fleet_loop_oracle, make_cluster)
+
+__all__ = [
+    "normalize_result", "normalize_results", "verdict_digest",
+    "ShadowSession", "ShadowReport", "standalone_candidate_verdicts",
+    "ReplayReport", "StreamReplayReport", "load_historical_store",
+    "replay_snapshot", "replay_admissions",
+    "FleetReport", "fleet_audit", "fleet_loop_oracle", "make_cluster",
+]

@@ -1,0 +1,155 @@
+"""Historical replay: audit a past store, re-review a recorded stream.
+
+Two time machines over the same engine path:
+
+- `replay_snapshot` loads a versioned columnar-store snapshot
+  (resilience/snapshot store tier — optionally from an explicit
+  historical snapshot root, independent of the live
+  GATEKEEPER_SNAPSHOT_DIR) as a *secondary* store under a fresh driver
+  and audits it with whatever policy set you hand it: the live set for
+  "what was violating last week", a candidate set for "what would this
+  change have rejected last week".
+- `replay_admissions` feeds a recorded AdmissionReview corpus
+  (obs/flightrecorder, GATEKEEPER_FLIGHT_ADMISSION=1) back through a
+  client's review path and compares verdicts against what was
+  recorded.  Under the same policy set the reproduction must be exact;
+  under a candidate set the mismatch list IS the what-if answer.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+
+def load_historical_store(target: str, root: str | None = None) -> dict | None:
+    """The store-tier snapshot payload for ``target``, from the live
+    snapshot dir or an explicit historical ``root``; None on miss."""
+    from gatekeeper_tpu.resilience import snapshot as _snap
+    hit = _snap.load_store(target, root=root)
+    return hit[0] if hit is not None else None
+
+
+@dataclasses.dataclass
+class ReplayReport:
+    verdicts: list[tuple]        # normalized (whatif.normalize_results)
+    digest: str
+    n_resources: int
+    wall_s: float
+
+
+def replay_snapshot(templates: list[dict], constraints: list[dict],
+                    store_state: dict,
+                    limit_per_constraint: int = 20) -> ReplayReport:
+    """Audit a historical store state under the given policy docs, in
+    a fresh driver (the live client and its caches are untouched)."""
+    from gatekeeper_tpu.client.client import Backend
+    from gatekeeper_tpu.engine.jax_driver import JaxDriver
+    from gatekeeper_tpu.target.k8s import K8sValidationTarget
+    from gatekeeper_tpu.whatif import normalize_results, verdict_digest
+    t0 = time.perf_counter()
+    driver = JaxDriver()
+    handler = K8sValidationTarget()
+    client = Backend(driver).new_client([handler])
+    for doc in templates:
+        client.add_template(doc)
+    for doc in constraints:
+        client.add_constraint(doc)
+    driver.adopt_store(handler.name, store_state)
+    resp = client.audit(limit_per_constraint=limit_per_constraint, full=True)
+    verdicts = normalize_results(resp.results())
+    return ReplayReport(
+        verdicts=verdicts, digest=verdict_digest(verdicts),
+        n_resources=len(store_state.get("entries", ())),
+        wall_s=time.perf_counter() - t0)
+
+
+# ---------------------------------------------------------------------------
+# recorded admission streams
+
+
+@dataclasses.dataclass
+class StreamReplayReport:
+    replayed: int
+    skipped: int                 # truncated/unreplayable corpus events
+    matched: int
+    mismatches: list[dict]       # per-event recorded-vs-replayed delta
+    wall_s: float
+
+    @property
+    def exact(self) -> bool:
+        return self.replayed > 0 and not self.mismatches
+
+
+def _verdict_rows(results) -> list[tuple]:
+    from gatekeeper_tpu.analysis.policyset import split_shadow_kind
+    rows = []
+    for r in results:
+        con = r.constraint or {}
+        rows.append((split_shadow_kind(con.get("kind", ""))[0],
+                     (con.get("metadata") or {}).get("name", ""),
+                     r.enforcement_action, r.msg))
+    return sorted(rows)
+
+
+def _recorded_rows(event: dict) -> list[tuple]:
+    from gatekeeper_tpu.analysis.policyset import split_shadow_kind
+    rows = []
+    for v in event.get("verdicts", ()):
+        rows.append((split_shadow_kind(v.get("kind") or "")[0],
+                     v.get("name") or "", v.get("action") or "deny",
+                     v.get("msg") or ""))
+    return sorted(rows)
+
+
+def _truncated(request: dict) -> bool:
+    for f in ("object", "oldObject"):
+        o = request.get(f)
+        if isinstance(o, dict) and o.get("__truncated__"):
+            return True
+    return False
+
+
+def replay_admissions(events: list[dict], client,
+                      compare: bool = True) -> StreamReplayReport:
+    """Re-review each corpus event through ``client`` and (optionally)
+    compare against the recorded outcome.  Allowed/denied is recomputed
+    with the webhook's enforcementAction partition (deny blocks, warn/
+    dryrun admit), so a corpus recorded by the webhook reproduces
+    exactly under the same policy set.  Events whose payload was
+    byte-capped at record time are skipped, not guessed at."""
+    t0 = time.perf_counter()
+    replayed = skipped = matched = 0
+    mismatches: list[dict] = []
+    for event in events:
+        request = event.get("request") or {}
+        if _truncated(request):
+            skipped += 1
+            continue
+        try:
+            resp = client.review(request)
+        except Exception as e:  # noqa: BLE001 — count, keep replaying
+            skipped += 1
+            mismatches.append({"request": request.get("name"),
+                               "error": str(e)})
+            continue
+        results = resp.results()
+        allowed = not any(r.enforcement_action not in ("warn", "dryrun")
+                          for r in results)
+        replayed += 1
+        if not compare:
+            continue
+        got = _verdict_rows(results)
+        want = _recorded_rows(event)
+        if allowed == bool(event.get("allowed")) and got == want:
+            matched += 1
+        else:
+            obj = (request.get("object") or {})
+            mismatches.append({
+                "name": (obj.get("metadata") or {}).get("name"),
+                "recorded_allowed": bool(event.get("allowed")),
+                "replayed_allowed": allowed,
+                "recorded": want, "replayed": got})
+    return StreamReplayReport(
+        replayed=replayed, skipped=skipped, matched=matched,
+        mismatches=mismatches, wall_s=time.perf_counter() - t0)

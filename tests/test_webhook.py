@@ -120,6 +120,82 @@ class TestHandle:
         assert logs == []  # other users don't trace
 
 
+class TestEnforcementAction:
+    """spec.enforcementAction routing (reference webhook semantics):
+    deny blocks, warn admits with AdmissionResponse warnings, dryrun
+    admits silently — all three still count the violation."""
+
+    @pytest.fixture(params=["local", "jax"])
+    def make_handler(self, request):
+        driver_cls = LocalDriver if request.param == "local" else JaxDriver
+        def make(action=None):
+            client = Backend(driver_cls()).new_client([K8sValidationTarget()])
+            client.add_template(template_obj())
+            con = constraint_obj()
+            if action is not None:
+                con["spec"]["enforcementAction"] = action
+            client.add_constraint(con)
+            return ValidationHandler(client)
+        return make
+
+    def test_deny_blocks(self, make_handler):
+        resp = make_handler("deny").handle(review_request(ns_obj("bad")))
+        assert resp["allowed"] is False
+        assert resp["status"]["code"] == 403
+        assert "warnings" not in resp
+
+    def test_warn_admits_with_warnings(self, make_handler):
+        handler = make_handler("warn")
+        resp = handler.handle(review_request(ns_obj("bad")))
+        assert resp["allowed"] is True
+        assert resp["warnings"] == \
+            ["[warn by ns-must-have-gk] you must provide labels: "
+             "{\"gatekeeper\"}"]
+        assert handler.metrics.counter(
+            "admission_warn_violations").value == 1
+        # a compliant object stays warning-free
+        resp = handler.handle(review_request(
+            ns_obj("good", {"gatekeeper": "on"})))
+        assert resp["allowed"] is True and "warnings" not in resp
+
+    def test_dryrun_admits_silently(self, make_handler):
+        handler = make_handler("dryrun")
+        resp = handler.handle(review_request(ns_obj("bad")))
+        assert resp["allowed"] is True
+        assert "warnings" not in resp
+        assert handler.metrics.counter(
+            "admission_dryrun_violations").value == 1
+        assert handler.metrics.counter("admission_denied").value == 0
+
+    def test_unknown_action_fails_closed(self, make_handler):
+        resp = make_handler("audit-only").handle(
+            review_request(ns_obj("bad")))
+        assert resp["allowed"] is False
+        assert resp["status"]["code"] == 403
+
+    def test_warn_rides_the_http_envelope(self, make_handler):
+        """The warnings list must survive the AdmissionReview envelope
+        (k8s surfaces response.warnings to kubectl users)."""
+        server = WebhookServer(make_handler("warn"), port=0)
+        server.start()
+        try:
+            body = {"apiVersion": "admission.k8s.io/v1beta1",
+                    "kind": "AdmissionReview",
+                    "request": review_request(ns_obj("bad"))}
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{server.port}/v1/admit",
+                data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req) as resp:
+                out = json.loads(resp.read())
+            assert out["response"]["allowed"] is True
+            assert out["response"]["warnings"], out["response"]
+            assert "[warn by ns-must-have-gk]" in \
+                out["response"]["warnings"][0]
+        finally:
+            server.stop()
+
+
 class TestBatcher:
     def test_batches_coalesce(self, handler):
         batcher = MicroBatcher(
