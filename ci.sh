@@ -181,6 +181,30 @@ print(f"restart smoke ok: startup cold {cold['startup_seconds']}s -> "
       f"0 re-lowerings, verdict digest {warm['verdict_digest']}")
 EOF
 
+echo "== chaos (seeded 30s soak, admission + audit under faults) =="
+# Seeded schedule-driven chaos soak (resilience/chaos.py): sustained
+# concurrent admission + audit load while probe_hang / device_lost /
+# snapshot_corrupt / slow_provider / queue_storm fire on a schedule
+# that is a pure function of the seed.  Invariants: no deadlock, deny
+# verdicts bit-identical to the scalar oracle or explicitly rejected
+# (never silently admitted), p99 bounded, queue depth <= its bound,
+# supervisor recovers + re-jits.  rc=1 is the warning tier (e.g. a
+# quiet run where brownout never engaged); rc=2 (any invariant
+# violation) fails the build.  The last line is the headline — grep it
+# from the trailing window like the bench gate does.
+CH_RC=0
+CH=$(JAX_PLATFORMS=cpu GATEKEEPER_SUPERVISOR_BACKOFF_S=0.5 \
+     timeout -k 10 300 \
+     python -m gatekeeper_tpu.resilience.chaos --seed 7 --duration 30 \
+     | tail -3) || CH_RC=$?
+echo "$CH"
+[ "$CH_RC" -le 1 ] \
+  || { echo "chaos soak failed (rc=$CH_RC)" >&2; exit 1; }
+echo "$CH" | grep -q " 0 invariant violation(s)" \
+  || { echo "chaos soak reported invariant violations" >&2; exit 1; }
+echo "$CH" | grep -Eq "completed=[1-9][0-9]*" \
+  || { echo "chaos soak completed no admissions" >&2; exit 1; }
+
 echo "== bench smoke (quick shapes) =="
 GATEKEEPER_BENCH_QUICK=1 GATEKEEPER_BENCH_N=20000 python bench.py > /tmp/bench.json
 python - <<'EOF'
@@ -252,6 +276,12 @@ fs = d.get("fleet_stack")
 assert isinstance(fs, dict) and fs.get("parity") is True \
     and fs.get("clusters", 0) >= 4, \
     f"no 4-cluster fleet_stack parity row in the headline: {d}"
+# the overload row must survive the window: open-loop replay at 2x the
+# measured saturation rate must degrade gracefully — the deny-path p99
+# stays under 5x the healthy (1x) p99, with sheds explicit
+ov = d.get("overload")
+assert isinstance(ov, dict) and ov.get("within_budget") is True, \
+    f"no within-budget overload row in the trailing headline: {d}"
 print("bench ok:", d["metric"], round(d["value"], 1), d["unit"],
       f"({len(line)} headline chars; external_data warm "
       f"{xd['warm_seconds']}s vs baseline {xd['baseline_seconds']}s; "
@@ -261,6 +291,7 @@ print("bench ok:", d["metric"], round(d["value"], 1), d["unit"],
       f"{cs['evaluations_saved']} evals; shard_sim parity "
       f"{sh['parity_digest']} with {sh['kinds_sharded']} kinds sharded; "
       f"shadow {ss.get('ratio')}x parity {ss.get('parity_digest')}; "
-      f"fleet {fs.get('clusters')} clusters parity ok)")
+      f"fleet {fs.get('clusters')} clusters parity ok; overload 2x p99 "
+      f"{ov.get('p99_2x_ms')}ms within budget)")
 EOF
 echo "CI PASS"

@@ -282,14 +282,17 @@ class Client:
     # ------------------------------------------------------------------
     # queries (client.go:545-612)
 
-    def review(self, obj: Any, tracing: bool = False) -> Responses:
+    def review(self, obj: Any, tracing: bool = False,
+               shed_actions: frozenset[str] | None = None) -> Responses:
         # queries take the READ side (client.go:545 RLock): concurrent
         # admission reviews proceed in parallel, excluded only by
         # mutations
         with self._lock.read():
-            return self._review_locked(obj, tracing)
+            return self._review_locked(obj, tracing, shed_actions)
 
-    def _review_locked(self, obj: Any, tracing: bool) -> Responses:
+    def _review_locked(self, obj: Any, tracing: bool,
+                       shed_actions: frozenset[str] | None = None
+                       ) -> Responses:
         responses = Responses()
         for name, handler in self.targets.items():
             try:
@@ -297,7 +300,8 @@ class Client:
             except UnhandledData:
                 continue
             results, trace = self.driver.query_review(
-                name, review, QueryOpts(tracing=tracing))
+                name, review, QueryOpts(tracing=tracing,
+                                        shed_actions=shed_actions))
             for r in results:
                 handler.handle_violation(r)
             responses.by_target[name] = Response(
@@ -306,17 +310,22 @@ class Client:
             responses.handled[name] = True
         return responses
 
-    def review_batch(self, objs: list, tracing: bool = False) -> list[Responses]:
+    def review_batch(self, objs: list, tracing: bool = False,
+                     shed_actions: frozenset[str] | None = None
+                     ) -> list[Responses]:
         """Review a micro-batch under one read-lock acquisition /
         constraint snapshot (the webhook batcher's engine pass).
 
         When the driver exposes ``query_review_batch`` (the jax driver's
         [B, C] device pass, SURVEY §7 step 7) the whole batch is
         evaluated as one matrix per target; otherwise per-review scalar
-        queries run under the shared snapshot."""
+        queries run under the shared snapshot.  ``shed_actions`` is the
+        brownout controller's shed set — those enforcement actions are
+        skipped before any evaluation (webhook/overload.py)."""
         with self._lock.read():
             if tracing:
-                return [self._review_locked(obj, tracing) for obj in objs]
+                return [self._review_locked(obj, tracing, shed_actions)
+                        for obj in objs]
             batched = self.driver.query_review_batch
             responses = [Responses() for _ in objs]
             for name, handler in self.targets.items():
@@ -330,7 +339,9 @@ class Client:
                         continue
                 if not reviews:
                     continue
-                outs = batched(name, reviews, QueryOpts(tracing=False))
+                outs = batched(name, reviews,
+                               QueryOpts(tracing=False,
+                                         shed_actions=shed_actions))
                 for i, (results, trace) in zip(idx, outs):
                     for r in results:
                         handler.handle_violation(r)
@@ -338,6 +349,21 @@ class Client:
                         target=name, results=results, trace=trace)
                     responses[i].handled[name] = True
             return responses
+
+    def predict_review_seconds(self, n_reviews: int) -> float | None:
+        """Cost-model-predicted seconds to evaluate a review batch of
+        ``n_reviews`` (summed over targets).  None when the driver has
+        no predictor or the model is uncalibrated — the batcher treats
+        None as "no opinion" and never sheds on it."""
+        fn = getattr(self.driver, "predict_review_batch_seconds", None)
+        if fn is None:
+            return None
+        total: float | None = None
+        for name in self.targets:
+            pred = fn(name, n_reviews)
+            if pred is not None:
+                total = pred if total is None else total + pred
+        return total
 
     def prefetch_external(self, objs: list) -> None:
         """Warm the external-data provider caches for a micro-batch

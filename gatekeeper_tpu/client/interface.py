@@ -50,6 +50,13 @@ class QueryOpts:
     # metered numbers; the scalar oracle is always full, so it ignores
     # this flag)
     full: bool = False
+    # overload brownout (webhook/overload.py): enforcement actions to
+    # SKIP entirely this query — e.g. frozenset({"dryrun"}) or
+    # frozenset({"dryrun", "warn"}).  Constraints with a shed action are
+    # filtered out before any evaluation (scalar or device); "deny" is
+    # never a legal member — deny constraints are never shed, only the
+    # failurePolicy path may reject them wholesale.
+    shed_actions: frozenset[str] | None = None
 
 
 class Driver(abc.ABC):

@@ -312,6 +312,12 @@ class LocalDriver(Driver):
         results: list[Result] = []
 
         constraints = list(st.all_constraints())
+        shed = opts.shed_actions if opts is not None else None
+        if shed:
+            # brownout: shed-action constraints skipped wholesale — no
+            # matching, no autoreject, no evaluation (overload.py)
+            constraints = [c for c in constraints
+                           if enforcement_action_of(c) not in shed]
         # autoreject (regolib src.go:7-17)
         for c, msg, details in handler.autoreject_review(review, constraints, st.table):
             results.append(Result(msg=msg, metadata={"details": details},

@@ -65,14 +65,18 @@ def _opts_to_wire(opts: QueryOpts | None) -> dict | None:
     if opts is None:
         return None
     return {"tracing": opts.tracing,
-            "limit_per_constraint": opts.limit_per_constraint}
+            "limit_per_constraint": opts.limit_per_constraint,
+            "shed_actions": sorted(opts.shed_actions)
+            if opts.shed_actions else None}
 
 
 def _opts_from_wire(d: dict | None) -> QueryOpts | None:
     if d is None:
         return None
+    shed = d.get("shed_actions")
     return QueryOpts(tracing=bool(d.get("tracing")),
-                     limit_per_constraint=d.get("limit_per_constraint"))
+                     limit_per_constraint=d.get("limit_per_constraint"),
+                     shed_actions=frozenset(shed) if shed else None)
 
 
 class WorkerUnreachableError(ClientError):

@@ -114,19 +114,29 @@ class Manager:
         set_runtime(self.external_data)
         self.plane: ControlPlane = add_to_manager(
             self.cluster, self.client, external_data=self.external_data)
+        from gatekeeper_tpu.webhook.overload import OverloadController
         from gatekeeper_tpu.webhook.server import REQUEST_TIMEOUT_S
         self.batcher = MicroBatcher(
-            lambda reqs: self.client.review_batch(reqs),
+            # shed_actions is consulted at evaluation time (not submit
+            # time): a batch formed while healthy but evaluated under
+            # brownout still sheds dryrun/warn work
+            lambda reqs: self.client.review_batch(
+                reqs, shed_actions=self.overload.shed_actions() or None),
             max_batch=args.max_batch, max_wait=args.batch_window_ms / 1000.0,
             metrics=self.metrics,
             # a submit must give up before the server's own request
             # deadline so the caller still gets a clean 500, not a
             # severed connection
             submit_timeout=REQUEST_TIMEOUT_S * 0.9,
-            prefetch=self.client.prefetch_external)
+            prefetch=self.client.prefetch_external,
+            predict_seconds=self.client.predict_review_seconds)
+        self.overload = OverloadController(self.batcher.depth,
+                                           self.batcher.capacity,
+                                           metrics=self.metrics)
         self.handler = ValidationHandler(self.client, cluster=self.cluster,
                                          batcher=self.batcher,
                                          metrics=self.metrics,
+                                         overload=self.overload,
                                          log=lambda m: _log.info("admission trace", dump=m))
         # TLS engages when the cert dir exists (reference /certs,
         # policy.go:76-79); otherwise plain HTTP (tests/demo)

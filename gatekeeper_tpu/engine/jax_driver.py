@@ -247,6 +247,9 @@ class JaxDriver(LocalDriver):
         # assumes.  Execution and host formatting stay concurrent.
         import threading as _threading
         self._prep_lock = _threading.Lock()
+        # predict_review_batch_seconds memo: (n, #templates, #cons) ->
+        # summed cost units (scale applied fresh each call)
+        self._predict_cache: dict[tuple, float] = {}
         # one-shot background churn-delta prewarm after the first sweep
         # (shape changes later recompile lazily on the sweep, as before)
         self._delta_warmed = False
@@ -1902,7 +1905,13 @@ class JaxDriver(LocalDriver):
         st = self._state(target)
         handler = self.targets[target]
         tracing = opts.tracing if opts is not None else self.default_tracing
+        shed = (opts.shed_actions if opts is not None else None) or None
         constraints_all = list(st.all_constraints())
+        if shed:
+            # brownout (overload.py): shed-action constraints excluded
+            # before any evaluation — device mask, host verify, all of it
+            constraints_all = [c for c in constraints_all
+                               if enforcement_action_of(c) not in shed]
         B = len(reviews)
         if tracing or self.scalar_only or not isinstance(st, JaxTargetState) \
                 or not B or \
@@ -1930,6 +1939,9 @@ class JaxDriver(LocalDriver):
         for kind in sorted(st.templates):
             compiled = st.templates[kind]
             cons = self._kind_constraints(st, kind)
+            if shed:
+                cons = [c for c in cons
+                        if enforcement_action_of(c) not in shed]
             if not cons:
                 continue
             cmask = mini.mask(cons, overapprox_ns=True)
@@ -1999,6 +2011,41 @@ class JaxDriver(LocalDriver):
             "admission.device_batch", cat="device", t0=_t_batch,
             t1=_time.perf_counter(), n_reviews=B, kinds=len(gates))
         return out
+
+    @locked_read
+    def predict_review_batch_seconds(self, target: str,
+                                     n_reviews: int) -> float | None:
+        """Cost-model-predicted wall seconds for a review batch of size
+        ``n_reviews`` against the installed constraint set — the PR-5
+        static cost vector priced by the PR-9 calibrated seconds-per-unit
+        scale.  None while uncalibrated (no attribution samples yet) —
+        callers (deadline-aware batch sizing, overload ladder) must treat
+        None as "no opinion", never as zero."""
+        from gatekeeper_tpu.analysis import costmodel
+        scale = costmodel.current_scale()
+        if scale <= 0.0 or n_reviews <= 0:
+            return None
+        st = self._state(target)
+        key = (n_reviews, len(st.templates),
+               sum(len(v) for v in st.constraints.values()))
+        cached = self._predict_cache.get(key)
+        units = cached
+        if units is None:
+            units = 0.0
+            for kind in st.templates:
+                compiled = st.templates[kind]
+                lowered = compiled.vectorized
+                if lowered is None:
+                    continue
+                n_cons = len(st.constraints.get(kind, {}))
+                if not n_cons:
+                    continue
+                units += costmodel.estimate(
+                    lowered, n_reviews, n_cons).units()
+            if len(self._predict_cache) > 64:
+                self._predict_cache.clear()
+            self._predict_cache[key] = units
+        return costmodel.predict_seconds(units, scale)
 
     @locked_read
     def explain_pair(self, target: str, kind: str, constraint_name: str,

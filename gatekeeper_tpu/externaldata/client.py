@@ -100,6 +100,14 @@ class ProviderClient:
         if not br.allow():
             raise BreakerOpenError(
                 f"provider {provider.name!r}: circuit breaker open")
+        # fault seam: slow_provider stalls every fetch while armed — a
+        # saturated provider, not a broken one (no breaker trip): the
+        # latency surfaces as deadline pressure on the admission path
+        from gatekeeper_tpu.resilience import faults
+        if faults.active("slow_provider"):
+            import os as _os
+            self._sleep(float(_os.environ.get(
+                "GATEKEEPER_FAULT_STALL_S", "0.25")))
         last: Exception | None = None
         for attempt in range(provider.retries + 1):
             if attempt:

@@ -1,0 +1,554 @@
+"""Seeded chaos soak: sustained admission + audit load under injected
+faults, with invariants checked after every event.
+
+PR-7 gave the stack fault seams (``probe_hang``, ``device_lost``,
+``snapshot_corrupt``) and a supervisor that survives them one at a
+time, under a test that injects exactly one fault into a quiet process.
+This module is the adversarial version: a deterministic, seeded
+schedule arms faults — including the overload-specific
+``slow_provider`` and ``queue_storm`` — while concurrent admission
+workers and an audit loop keep the engine busy, and a monitor enforces
+the invariants that define "degrades, never lies":
+
+1. **No deadlock** — a watchdog trips if admission completions stop
+   progressing.
+2. **Deny verdicts are bit-identical to the oracle or rejected** — an
+   expected-deny request may come back 403 with exactly the oracle's
+   messages, or be rejected outright (429 fail-closed / 500 / timeout);
+   it is NEVER silently admitted.  Symmetrically, an expected-allow
+   request is never spuriously denied 403.
+3. **The bounded queue stays bounded** — sampled depth never exceeds
+   capacity.
+4. **p99 stays bounded during brownout** — requests either complete
+   within a multiple of their deadline or are rejected; they don't
+   hang.
+5. **The supervisor recovers** — after the schedule disarms, a
+   degraded backend returns to healthy (and the driver re-jits) within
+   the backoff budget.
+
+Everything is seeded: ``build_schedule(seed, duration)`` is a pure
+function of its arguments (the determinism test in
+``tests/test_chaos.py`` pins this), so a failing soak replays with the
+same fault timeline.  Chaos events are mirrored into the PR-9 flight
+recorder; any invariant violation dumps the ring.
+
+CLI::
+
+    python -m gatekeeper_tpu.resilience.chaos --seed 7 --duration 30
+
+rc 0 = clean, rc 1 = warnings only (e.g. brownout never engaged),
+rc 2 = invariant violation(s).  The final line always reads
+``... N invariant violation(s)`` for CI's trailing-window grep.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import random
+import threading
+import time
+
+FAULTS = ("probe_hang", "device_lost", "snapshot_corrupt",
+          "slow_provider", "queue_storm")
+
+# one-shot (``faults.take``) seams the scheduler re-arms between events
+ONE_SHOT = ("device_lost", "snapshot_corrupt", "queue_storm")
+
+
+@dataclasses.dataclass(frozen=True)
+class ChaosEvent:
+    t: float          # seconds from soak start
+    fault: str
+    duration: float   # how long the fault stays armed
+
+
+def build_schedule(seed: int, duration_s: float,
+                   warmup_s: float = 2.0) -> list[ChaosEvent]:
+    """Deterministic fault timeline: a pure function of (seed,
+    duration, warmup) — no wall clock, no global RNG — so a soak
+    replays event-for-event.  Faults are drawn round-robin-ish from a
+    seeded shuffle (every fault class appears before any repeats) with
+    seeded durations and gaps."""
+    rng = random.Random(seed)
+    events: list[ChaosEvent] = []
+    t = warmup_s
+    pool: list[str] = []
+    while t < duration_s - 1.0:
+        if not pool:
+            pool = list(FAULTS)
+            rng.shuffle(pool)
+        fault = pool.pop()
+        dur = round(rng.uniform(0.5, 1.5), 3)
+        events.append(ChaosEvent(t=round(t, 3), fault=fault, duration=dur))
+        t += dur + rng.uniform(0.5, 2.0)
+    return events
+
+
+# ---------------------------------------------------------------------------
+# workload fixture: a policy set spanning every enforcement action
+
+
+_DENY_LABELS_REGO = """package k8srequiredlabels
+violation[{"msg": msg, "details": {"missing_labels": missing}}] {
+  provided := {label | input.review.object.metadata.labels[label]}
+  required := {label | label := input.constraint.spec.parameters.labels[_]}
+  missing := required - provided
+  count(missing) > 0
+  msg := sprintf("you must provide labels: %v", [missing])
+}
+"""
+
+_WARN_TEAM_REGO = """package k8swarnteam
+violation[{"msg": "namespace should declare a team label"}] {
+  not input.review.object.metadata.labels.team
+}
+"""
+
+_DRYRUN_COST_REGO = """package k8sdryruncost
+violation[{"msg": "namespace has no cost-center label"}] {
+  not input.review.object.metadata.labels["cost-center"]
+}
+"""
+
+_EXT_SIG_REGO = """package k8schaossig
+violation[{"msg": msg}] {
+  image := input.review.object.spec.image
+  verdict := object.get(external_data({"provider": "chaos-sig", "keys": [image]}), ["responses", image], "missing")
+  verdict == "invalid"
+  msg := sprintf("image %v rejected: %v", [image, verdict])
+}
+"""
+
+
+def _template_doc(kind: str, rego: str) -> dict:
+    return {"apiVersion": "templates.gatekeeper.sh/v1alpha1",
+            "kind": "ConstraintTemplate",
+            "metadata": {"name": kind.lower()},
+            "spec": {"crd": {"spec": {"names": {"kind": kind}}},
+                     "targets": [{"target": "admission.k8s.gatekeeper.sh",
+                                  "rego": rego}]}}
+
+
+def _constraint_doc(kind: str, name: str, action: str | None = None,
+                    params: dict | None = None,
+                    kinds: tuple[str, ...] = ("Namespace",)) -> dict:
+    spec: dict = {"match": {"kinds": [{"apiGroups": [""],
+                                       "kinds": list(kinds)}]}}
+    if params:
+        spec["parameters"] = params
+    if action:
+        spec["enforcementAction"] = action
+    return {"apiVersion": "constraints.gatekeeper.sh/v1alpha1",
+            "kind": kind, "metadata": {"name": name}, "spec": spec}
+
+
+def _install_policy_set(client) -> None:
+    client.add_template(_template_doc("K8sChaosLabels", _DENY_LABELS_REGO))
+    client.add_constraint(_constraint_doc(
+        "K8sChaosLabels", "ns-must-have-gk",
+        params={"labels": ["gatekeeper"]}))
+    client.add_template(_template_doc("K8sChaosWarnTeam", _WARN_TEAM_REGO))
+    client.add_constraint(_constraint_doc(
+        "K8sChaosWarnTeam", "ns-team-warn", action="warn"))
+    client.add_template(_template_doc("K8sChaosDryrunCost",
+                                      _DRYRUN_COST_REGO))
+    client.add_constraint(_constraint_doc(
+        "K8sChaosDryrunCost", "ns-cost-dryrun", action="dryrun"))
+    client.add_template(_template_doc("K8sChaosSig", _EXT_SIG_REGO))
+    client.add_constraint(_constraint_doc(
+        "K8sChaosSig", "sig-check", kinds=("Pod",)))
+
+
+def _ns_obj(name: str, labels: dict | None = None) -> dict:
+    obj = {"apiVersion": "v1", "kind": "Namespace",
+           "metadata": {"name": name}}
+    if labels:
+        obj["metadata"]["labels"] = labels
+    return obj
+
+
+def _pod_obj(name: str, image: str) -> dict:
+    return {"apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": name, "namespace": "default"},
+            "spec": {"image": image}}
+
+
+def _review_request(obj: dict, uid: str) -> dict:
+    return {"uid": uid,
+            "kind": {"group": "", "version": "v1",
+                     "kind": obj.get("kind", "")},
+            "operation": "CREATE",
+            "name": (obj.get("metadata") or {}).get("name", ""),
+            "userInfo": {"username": "chaos", "groups": []},
+            "object": obj}
+
+
+def _build_corpus(n: int) -> list[dict]:
+    """Deterministic request mix: namespaces that pass / trip the deny
+    constraint (with/without warn+dryrun labels riding along) and pods
+    that pass / trip the external-data signature check."""
+    reqs: list[dict] = []
+    for i in range(n):
+        j = i % 6
+        if j == 0:
+            obj = _ns_obj(f"ok-{i}", {"gatekeeper": "on", "team": "a",
+                                      "cost-center": "cc1"})
+        elif j == 1:
+            obj = _ns_obj(f"bad-{i}")                  # deny + warn + dryrun
+        elif j == 2:
+            obj = _ns_obj(f"warned-{i}", {"gatekeeper": "on"})  # warn only
+        elif j == 3:
+            obj = _pod_obj(f"pod-ok-{i}", "img-a")     # sig valid
+        elif j == 4:
+            obj = _pod_obj(f"pod-bad-{i}", "img-b")    # sig invalid -> deny
+        else:
+            obj = _ns_obj(f"bad2-{i}", {"team": "a"})  # deny
+        reqs.append(_review_request(obj, uid=f"chaos-{i}"))
+    return reqs
+
+
+def _deny_lines(resp: dict) -> list[str]:
+    if resp.get("allowed") or (resp.get("status") or {}).get("code") != 403:
+        return []
+    return sorted((resp["status"].get("message") or "").splitlines())
+
+
+# ---------------------------------------------------------------------------
+# the soak
+
+
+@dataclasses.dataclass
+class SoakReport:
+    seed: int
+    duration_s: float
+    events: list
+    completed: int = 0
+    rejected: int = 0            # 429/500/timeouts — acceptable under load
+    denied_exact: int = 0        # 403 bit-identical to the oracle
+    allowed: int = 0
+    shed_total: int = 0
+    max_rung: int = 0
+    max_depth: int = 0
+    queue_capacity: int = 0
+    p99_s: float = 0.0
+    p50_s: float = 0.0
+    backend_degradations: int = 0
+    backend_recoveries: int = 0
+    backend_rejits: int = 0
+    violations: list = dataclasses.field(default_factory=list)
+    warnings: list = dataclasses.field(default_factory=list)
+
+    def headline(self) -> str:
+        return (f"CHAOS seed={self.seed} dur={self.duration_s:.0f}s "
+                f"events={len(self.events)} completed={self.completed} "
+                f"rejected={self.rejected} denied={self.denied_exact} "
+                f"max_rung={self.max_rung} max_depth={self.max_depth}"
+                f"/{self.queue_capacity} p99={self.p99_s * 1e3:.1f}ms "
+                f"recoveries={self.backend_recoveries} "
+                f"rejits={self.backend_rejits} "
+                f"{len(self.warnings)} warning(s) "
+                f"{len(self.violations)} invariant violation(s)")
+
+
+def _percentile(xs: list[float], q: float) -> float:
+    if not xs:
+        return 0.0
+    xs = sorted(xs)
+    return xs[min(len(xs) - 1, int(q * (len(xs) - 1)))]
+
+
+def run_soak(seed: int = 7, duration_s: float = 30.0, rps: float = 150.0,
+             n_workers: int = 8, deadline_s: float = 0.75,
+             queue_capacity: int = 64, max_batch: int = 16,
+             schedule: list[ChaosEvent] | None = None,
+             recovery_budget_s: float = 20.0,
+             watchdog_s: float = 10.0) -> SoakReport:
+    """Run the seeded soak and return the invariant report.  Builds a
+    JaxDriver serving stack (bounded batcher + brownout ladder +
+    webhook handler) and a LocalDriver oracle over the same policy set,
+    drives ``n_workers`` admission threads plus an audit loop, and
+    walks the fault schedule while a monitor enforces the invariants.
+    """
+    # fast supervisor cadence so recovery fits the soak window; only
+    # defaults — an operator's explicit settings win
+    os.environ.setdefault("GATEKEEPER_SUPERVISOR_BACKOFF_S", "0.5")
+    os.environ.setdefault("GATEKEEPER_SUPERVISOR_REPROBE_TIMEOUT_S", "2.0")
+    os.environ.setdefault("GATEKEEPER_FAULT_STALL_S", "0.3")
+    prev_fault = os.environ.get("GATEKEEPER_FAULT")
+    os.environ["GATEKEEPER_FAULT"] = ""
+
+    from gatekeeper_tpu.api.externaldata import IGNORE, Provider
+    from gatekeeper_tpu.client.client import Backend
+    from gatekeeper_tpu.client.local_driver import LocalDriver
+    from gatekeeper_tpu.engine.jax_driver import JaxDriver
+    from gatekeeper_tpu.externaldata.fake import FakeProvider, register_fake
+    from gatekeeper_tpu.externaldata.runtime import (ExternalDataRuntime,
+                                                     set_runtime)
+    from gatekeeper_tpu.obs.flightrecorder import (get_flight_recorder,
+                                                   record_event)
+    from gatekeeper_tpu.resilience import faults
+    from gatekeeper_tpu.resilience.supervisor import (HEALTHY,
+                                                      get_supervisor)
+    from gatekeeper_tpu.target.k8s import K8sValidationTarget
+    from gatekeeper_tpu.webhook.batcher import MicroBatcher
+    from gatekeeper_tpu.webhook.overload import OverloadController
+    from gatekeeper_tpu.webhook.policy import ValidationHandler
+
+    if schedule is None:
+        schedule = build_schedule(seed, duration_s)
+    report = SoakReport(seed=seed, duration_s=duration_s,
+                        events=[dataclasses.asdict(e) for e in schedule],
+                        queue_capacity=queue_capacity)
+
+    def violation(kind: str, **fields) -> None:
+        report.violations.append({"kind": kind, **fields})
+        record_event("chaos_violation", kind=kind, **fields)
+        get_flight_recorder().dump("chaos:invariant")
+
+    # ---------------- fixture: external data + both engines ----------
+    register_fake("chaos-sig", FakeProvider({"img-a": "valid",
+                                             "img-b": "invalid"}))
+    rt = ExternalDataRuntime()
+    prev_rt = set_runtime(rt)
+    # short cache TTL so slow_provider actually stalls live fetches
+    # (an infinite-TTL cache would absorb the fault after warmup)
+    rt.register(Provider(name="chaos-sig", url="fake://chaos-sig",
+                         failure_policy=IGNORE, cache_ttl_s=1.0,
+                         timeout_s=2.0))
+
+    live_client = Backend(JaxDriver()).new_client([K8sValidationTarget()])
+    oracle_client = Backend(LocalDriver()).new_client([K8sValidationTarget()])
+    _install_policy_set(live_client)
+    _install_policy_set(oracle_client)
+    # a small inventory so the audit loop sweeps real rows (and the
+    # mid-sweep device_lost seam has kinds to fire between)
+    for i in range(16):
+        live_client.add_data(_ns_obj(
+            f"inv-{i}", {"gatekeeper": "on"} if i % 2 else None))
+
+    corpus = _build_corpus(48)
+    oracle_handler = ValidationHandler(oracle_client)
+    expected = [oracle_handler.handle(dict(r)) for r in corpus]
+    expected_deny = [_deny_lines(r) for r in expected]
+
+    batcher = MicroBatcher(
+        lambda reqs: live_client.review_batch(
+            reqs, shed_actions=overload.shed_actions() or None),
+        max_batch=max_batch, max_wait=0.002,
+        submit_timeout=deadline_s, capacity=queue_capacity,
+        prefetch=live_client.prefetch_external,
+        predict_seconds=live_client.predict_review_seconds)
+    overload = OverloadController(batcher.depth, queue_capacity)
+    handler = ValidationHandler(live_client, batcher=batcher,
+                                overload=overload, batch_mode="always")
+    batcher.start()
+
+    # ---------------- load + monitor threads --------------------------
+    stop = threading.Event()
+    completions = [0]
+    comp_lock = threading.Lock()
+    latencies: list[list[float]] = [[] for _ in range(n_workers)]
+    per_req_interval = n_workers / max(rps, 1.0)
+
+    def worker(w: int) -> None:
+        k = w
+        while not stop.is_set():
+            i = k % len(corpus)
+            k += n_workers
+            t0 = time.monotonic()
+            try:
+                resp = handler.handle(dict(corpus[i]),
+                                      deadline=t0 + deadline_s)
+            except Exception as e:   # noqa: BLE001 — the handler owns
+                violation("worker_exception", error=repr(e), req=i)
+                resp = None          # errors; an escape is a bug
+            lat = time.monotonic() - t0
+            latencies[w].append(lat)
+            with comp_lock:
+                completions[0] += 1
+            if resp is not None:
+                code = (resp.get("status") or {}).get("code")
+                if resp.get("allowed"):
+                    report.allowed += 1
+                    if expected_deny[i]:
+                        # THE invariant: a deny verdict is never
+                        # silently dropped, at any rung, under any fault
+                        violation("silent_admit", req=i,
+                                  expected=expected_deny[i])
+                elif code == 403:
+                    got = _deny_lines(resp)
+                    if got == expected_deny[i]:
+                        report.denied_exact += 1
+                    else:
+                        violation("verdict_mismatch", req=i, got=got,
+                                  expected=expected_deny[i])
+                else:               # 429 fail-closed / 500 / timeout
+                    report.rejected += 1
+            pause = per_req_interval - (time.monotonic() - t0)
+            if pause > 0:
+                stop.wait(pause)
+
+    def auditor() -> None:
+        while not stop.is_set():
+            try:
+                live_client.audit()
+            except Exception as e:   # noqa: BLE001
+                violation("audit_exception", error=repr(e))
+                return
+            stop.wait(0.2)
+
+    def monitor() -> None:
+        last = 0
+        stalled = 0.0
+        while not stop.wait(0.25):
+            depth = batcher.depth()
+            report.max_depth = max(report.max_depth, depth)
+            if depth > queue_capacity:
+                violation("queue_over_capacity", depth=depth,
+                          capacity=queue_capacity)
+            report.max_rung = max(report.max_rung, overload.rung())
+            with comp_lock:
+                cur = completions[0]
+            if cur == last:
+                stalled += 0.25
+                if stalled >= watchdog_s:
+                    violation("deadlock_watchdog", completions=cur,
+                              stalled_s=stalled)
+                    stop.set()
+                    return
+            else:
+                stalled = 0.0
+                last = cur
+
+    threads = [threading.Thread(target=worker, args=(w,), daemon=True,
+                                name=f"chaos-worker-{w}")
+               for w in range(n_workers)]
+    threads.append(threading.Thread(target=auditor, daemon=True,
+                                    name="chaos-audit"))
+    threads.append(threading.Thread(target=monitor, daemon=True,
+                                    name="chaos-monitor"))
+    t_start = time.monotonic()
+    for t in threads:
+        t.start()
+
+    # ---------------- the schedule ------------------------------------
+    try:
+        for ev in schedule:
+            if stop.is_set():
+                break
+            delay = t_start + ev.t - time.monotonic()
+            if delay > 0 and stop.wait(delay):
+                break
+            if ev.fault in ONE_SHOT:
+                faults.rearm(ev.fault)
+            os.environ["GATEKEEPER_FAULT"] = ev.fault
+            record_event("chaos_event", fault=ev.fault, action="arm",
+                         t=ev.t, duration=ev.duration)
+            stop.wait(ev.duration)
+            os.environ["GATEKEEPER_FAULT"] = ""
+            record_event("chaos_event", fault=ev.fault, action="disarm",
+                         t=ev.t + ev.duration)
+        # run out the remaining soak window fault-free
+        remaining = t_start + duration_s - time.monotonic()
+        if remaining > 0:
+            stop.wait(remaining)
+    finally:
+        os.environ["GATEKEEPER_FAULT"] = ""
+        stop.set()
+        for t in threads:
+            t.join(timeout=max(10.0, deadline_s * 4))
+        for t in threads:
+            if t.is_alive():
+                violation("thread_wedged", thread=t.name)
+
+    # ---------------- post-soak invariants ----------------------------
+    sup = get_supervisor()
+    report.backend_degradations = \
+        sup.metrics.counter("backend_degradations").value
+    if report.backend_degradations:
+        t_rec = time.monotonic() + recovery_budget_s
+        while time.monotonic() < t_rec and sup.state != HEALTHY:
+            time.sleep(0.25)
+        if sup.state != HEALTHY:
+            violation("no_recovery", state=sup.state,
+                      budget_s=recovery_budget_s)
+        report.backend_recoveries = \
+            sup.metrics.counter("backend_recoveries").value
+        report.backend_rejits = \
+            live_client.driver.metrics.counter("backend_rejits").value
+        if report.backend_recoveries and not report.backend_rejits:
+            violation("no_rejit_after_recovery",
+                      recoveries=report.backend_recoveries)
+
+    all_lat = [x for per in latencies for x in per]
+    with comp_lock:
+        report.completed = completions[0]
+    report.p50_s = _percentile(all_lat, 0.50)
+    report.p99_s = _percentile(all_lat, 0.99)
+    # a request either finishes or is rejected near its deadline; a p99
+    # far past the deadline means something hung instead of shedding
+    p99_bound = deadline_s * 3 + 1.0
+    if report.p99_s > p99_bound:
+        violation("p99_unbounded", p99_s=report.p99_s, bound_s=p99_bound)
+
+    # shed accounting may live across several registries (batcher,
+    # handler, ladder); read it back through the public snapshots
+    shed = 0
+    for m in {id(batcher.metrics): batcher.metrics,
+              id(handler.metrics): handler.metrics,
+              id(overload.metrics): overload.metrics}.values():
+        for key, val in m.snapshot().items():
+            if key.startswith("admission_shed_total"):
+                shed += int(val)
+    report.shed_total = shed
+    if report.max_rung == 0 and not shed:
+        report.warnings.append(
+            "brownout never engaged: load never pressured the queue "
+            "(raise rps or shrink capacity)")
+    fired = {e["fault"] for e in report.events}
+    if "device_lost" in fired and not report.backend_degradations:
+        report.warnings.append(
+            "device_lost armed but the backend never degraded "
+            "(audit loop may not have reached the seam)")
+
+    # teardown
+    batcher.stop()
+    set_runtime(prev_rt)
+    if prev_fault is None:
+        os.environ.pop("GATEKEEPER_FAULT", None)
+    else:
+        os.environ["GATEKEEPER_FAULT"] = prev_fault
+    record_event("chaos_soak_done", violations=len(report.violations),
+                 warnings=len(report.warnings))
+    if report.violations:
+        get_flight_recorder().dump("chaos:final")
+    return report
+
+
+def main(argv: list[str] | None = None) -> int:
+    import argparse
+    import json
+    ap = argparse.ArgumentParser(description="seeded chaos soak")
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--duration", type=float, default=30.0)
+    ap.add_argument("--rps", type=float, default=150.0)
+    ap.add_argument("--workers", type=int, default=8)
+    ap.add_argument("--queue", type=int, default=64)
+    ap.add_argument("--deadline", type=float, default=0.75)
+    args = ap.parse_args(argv)
+    report = run_soak(seed=args.seed, duration_s=args.duration,
+                      rps=args.rps, n_workers=args.workers,
+                      queue_capacity=args.queue,
+                      deadline_s=args.deadline)
+    print(json.dumps({"violations": report.violations,
+                      "warnings": report.warnings}, indent=2,
+                     default=str))
+    print(report.headline())
+    if report.violations:
+        return 2
+    return 1 if report.warnings else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

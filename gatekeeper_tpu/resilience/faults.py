@@ -12,11 +12,23 @@ consults this module at the exact seams a real failure would hit:
 - ``snapshot_corrupt`` — fires ONCE per snapshot read, making the
                          loader treat the entry as corrupt; exercises
                          the delete-and-rebuild path.
+- ``slow_provider``    — external-data provider fetches stall for
+                         ``GATEKEEPER_FAULT_STALL_S`` while armed,
+                         simulating a saturated/far-away provider
+                         (drives deadline expiry + brownout, not
+                         breaker-open errors).
+- ``queue_storm``      — fires ONCE, stalling admission batch
+                         formation so the bounded queue fills and the
+                         overload ladder engages (a simulated consumer
+                         stall: slow device, GC pause, noisy
+                         neighbor).
 
 ``active`` faults apply every time they are consulted; ``take`` faults
 are one-shot per process (the set of already-fired names is kept here)
 so a single armed fault produces one discrete failure event rather
-than a permanently broken subsystem.
+than a permanently broken subsystem.  The chaos soak
+(``resilience/chaos.py``) re-arms one-shot faults between schedule
+events via ``rearm``.
 """
 
 from __future__ import annotations
@@ -59,6 +71,14 @@ def take(name: str) -> bool:
     except Exception:   # noqa: BLE001
         pass
     return True
+
+
+def rearm(name: str) -> None:
+    """Forget that a one-shot fault fired, so the next ``take`` while
+    armed fires again — the chaos scheduler injects the same fault
+    class repeatedly across a soak."""
+    with _lock:
+        _fired.discard(name)
 
 
 def reset_for_tests() -> None:

@@ -387,6 +387,15 @@ class BackendSupervisor:
             t.join(timeout=5)
 
 
+    def peek_state(self) -> str:
+        """State WITHOUT triggering the seed probe — for hot-path
+        readers (the overload ladder runs on every admission request)
+        that must never pay the ~45s first-contact device probe.  An
+        unseeded supervisor reads as healthy: the ladder only wants
+        degradation signals that some dispatch already discovered."""
+        return self._state if self._seeded else HEALTHY
+
+
 _SUP: BackendSupervisor | None = None
 _SUP_LOCK = threading.Lock()
 
@@ -399,6 +408,14 @@ def get_supervisor() -> BackendSupervisor:
         if _SUP is None:
             _SUP = BackendSupervisor()
         return _SUP
+
+
+def peek_state() -> str:
+    """Module-level hot-path read: current supervisor state without
+    creating the singleton or triggering its seed probe.  The overload
+    ladder calls this per admission request."""
+    sup = _SUP
+    return sup.peek_state() if sup is not None else HEALTHY
 
 
 def reset_for_tests() -> None:

@@ -11,21 +11,45 @@ dispatch.
 Callers block in ``submit`` until their batch is evaluated; a dedicated
 worker thread owns batch formation, so latency is bounded by
 ``max_wait + evaluation``.
+
+Overload control (PR 13): the pending queue is BOUNDED
+(``GATEKEEPER_ADMISSION_QUEUE``) — a full queue rejects the submit with
+``QueueFull`` instead of buffering unboundedly (the Podracer-style
+feeder/evaluator split only works if the feeder sheds instead of
+buffering; an unbounded list under a 100k rps storm is an OOM, not a
+queue).  Each request carries its propagated deadline (apiserver
+``?timeout=`` → server request deadline), and batch formation drops
+entries that are already expired or withdrawn *before* device dispatch,
+then sizes the batch so the cost-model-predicted evaluation latency
+fits the tightest deadline in the batch.
 """
 
 from __future__ import annotations
 
+import os
 import threading
+import time
 from typing import Callable
 
 from gatekeeper_tpu.errors import GatekeeperError
 from gatekeeper_tpu.utils.metrics import Metrics
 
+DEFAULT_QUEUE_CAPACITY = 2048
+
+
+def queue_capacity_env(default: int = DEFAULT_QUEUE_CAPACITY) -> int:
+    try:
+        cap = int(os.environ.get("GATEKEEPER_ADMISSION_QUEUE", default))
+    except ValueError:
+        cap = default
+    return max(1, cap)
+
 
 class _Pending:
-    __slots__ = ("request", "event", "response", "error", "ctx")
+    __slots__ = ("request", "event", "response", "error", "ctx",
+                 "deadline", "withdrawn")
 
-    def __init__(self, request, ctx=None):
+    def __init__(self, request, ctx=None, deadline: float | None = None):
         self.request = request
         self.event = threading.Event()
         self.response = None
@@ -33,6 +57,12 @@ class _Pending:
         # submitting request's (trace_id, span_id): the batch span on
         # the worker thread links back to every member request trace
         self.ctx = ctx
+        # absolute monotonic deadline propagated from the caller; batch
+        # formation drops expired entries before dispatch
+        self.deadline = deadline
+        # caller gave up (SubmitTimeout) but the entry was already out
+        # of reach of the remove() — formation must not evaluate it
+        self.withdrawn = False
 
 
 class SubmitTimeout(GatekeeperError):
@@ -41,12 +71,23 @@ class SubmitTimeout(GatekeeperError):
     into a clean deny-500 instead of a severed connection."""
 
 
+class QueueFull(GatekeeperError):
+    """The bounded pending queue is at capacity: the submit is REJECTED
+    rather than buffered.  Distinct from SubmitTimeout so the webhook
+    can ride the failurePolicy path (fail open for warn/dryrun-only
+    policy sets, fail closed — 429 — when deny constraints are
+    installed; policy.py)."""
+
+
 class MicroBatcher:
     def __init__(self, evaluate_batch: Callable[[list[dict]], list],
                  max_batch: int = 64, max_wait: float = 0.002,
                  metrics: Metrics | None = None,
                  submit_timeout: float = 30.0,
-                 prefetch: Callable[[list[dict]], None] | None = None):
+                 prefetch: Callable[[list[dict]], None] | None = None,
+                 capacity: int | None = None,
+                 predict_seconds: Callable[[int], float | None]
+                 | None = None):
         self.evaluate_batch = evaluate_batch
         self.max_batch = max_batch
         self.max_wait = max_wait
@@ -60,6 +101,13 @@ class MicroBatcher:
         # once per formed batch before evaluation so provider fetch
         # latency is paid once for the whole batch
         self.prefetch = prefetch
+        # bounded pending queue: reject-over-capacity, never buffer
+        self.capacity = queue_capacity_env() if capacity is None \
+            else max(1, capacity)
+        # cost-model latency predictor (seconds for a batch of n
+        # reviews, None while uncalibrated): batch formation shrinks the
+        # batch until the prediction fits the tightest member deadline
+        self.predict_seconds = predict_seconds
         self._queue: list[_Pending] = []
         self._lock = threading.Lock()
         self._wake = threading.Condition(self._lock)
@@ -83,37 +131,137 @@ class MicroBatcher:
 
     # ------------------------------------------------------------------
 
-    def submit(self, request: dict, timeout: float | None = None):
+    def depth(self) -> int:
+        """Current pending-queue depth (the brownout ladder's pressure
+        signal; also exported as the admission_queue_depth gauge)."""
+        with self._lock:
+            return len(self._queue)
+
+    def _gauge_depth(self, n: int) -> None:
+        self.metrics.gauge(
+            "admission_queue_depth",
+            "pending admission requests awaiting batch formation").set(n)
+
+    def submit(self, request: dict, timeout: float | None = None,
+               deadline: float | None = None):
         """Block until the batch containing this request is evaluated,
-        or until ``timeout`` (default: the batcher's submit_timeout)
-        expires — then raise SubmitTimeout.  A timed-out request still
-        queued is withdrawn so the worker never evaluates for a caller
-        that already gave up; one already taken into a batch keeps
-        evaluating (the result is discarded, the thread is freed)."""
+        or until the effective deadline expires — then raise
+        SubmitTimeout.  ``deadline`` is an absolute ``time.monotonic``
+        instant propagated from the caller (apiserver ``?timeout=`` /
+        server request deadline); ``timeout`` is a relative cap
+        (default: the batcher's submit_timeout).  A full queue raises
+        QueueFull immediately — the request is shed, not buffered.  A
+        timed-out request still queued is withdrawn so the worker never
+        evaluates for a caller that already gave up; one already taken
+        into a batch keeps evaluating (the result is discarded, the
+        thread is freed)."""
         if self._thread is None:
             # no worker: degrade to a single-request batch inline
             return self.evaluate_batch([request])[0]
+        now = time.monotonic()
+        wait = self.submit_timeout if timeout is None else timeout
+        if deadline is not None:
+            wait = min(wait, deadline - now)
+        if wait <= 0:
+            self.metrics.counter("admission_expired_dropped").inc()
+            raise SubmitTimeout("admission deadline already expired "
+                                "before evaluation")
         from gatekeeper_tpu.obs.trace import get_tracer
-        p = _Pending(request, ctx=get_tracer().current())
+        p = _Pending(request, ctx=get_tracer().current(),
+                     deadline=now + wait)
         with self._wake:
+            if len(self._queue) >= self.capacity:
+                self.metrics.counter(
+                    "admission_shed_total",
+                    "admission requests shed by overload control",
+                    reason="queue_full").inc()
+                raise QueueFull(
+                    f"admission queue at capacity ({self.capacity})")
             self._queue.append(p)
+            self._gauge_depth(len(self._queue))
             self._wake.notify()
-        deadline = self.submit_timeout if timeout is None else timeout
-        if not p.event.wait(deadline):
+        if not p.event.wait(wait):
             with self._wake:
+                p.withdrawn = True
                 try:
                     self._queue.remove(p)
+                    self._gauge_depth(len(self._queue))
                 except ValueError:
                     pass    # already taken into a batch
             self.metrics.counter("admission_submit_timeouts").inc()
             raise SubmitTimeout(
-                f"admission batch evaluation exceeded {deadline:.3f}s")
+                f"admission batch evaluation exceeded {wait:.3f}s")
         if p.error is not None:
             raise p.error
         return p.response
 
+    # ------------------------------------------------------------------
+    # batch formation
+
+    def _take_batch(self, now: float) -> list[_Pending]:
+        """Pop up to max_batch live entries under the lock, dropping
+        withdrawn and already-expired entries first — an expired entry
+        would be evaluated for a caller whose apiserver already gave
+        up, pure wasted device time under overload."""
+        take: list[_Pending] = []
+        rest: list[_Pending] = []
+        expired: list[_Pending] = []
+        for p in self._queue:
+            if p.withdrawn:
+                continue
+            if p.deadline is not None and p.deadline <= now:
+                expired.append(p)
+                continue
+            (take if len(take) < self.max_batch else rest).append(p)
+        self._queue = rest
+        self._gauge_depth(len(rest))
+        if expired:
+            self.metrics.counter(
+                "admission_expired_dropped",
+                "expired admission requests dropped at batch formation"
+            ).inc(len(expired))
+            for p in expired:
+                p.error = SubmitTimeout(
+                    "admission deadline expired before evaluation")
+                p.event.set()
+        return take
+
+    def _fit_to_deadline(self, take: list[_Pending]) -> list[_Pending]:
+        """Shrink the batch until the cost-model-predicted evaluation
+        latency fits the tightest member deadline (PR-5 static cost
+        model, continuously re-calibrated by PR-9 attribution) —
+        predicted-over-budget members beyond the cut stay queued for
+        the next, smaller, batch.  No-op while uncalibrated."""
+        if self.predict_seconds is None or len(take) <= 1:
+            return take
+        deadlines = [p.deadline for p in take if p.deadline is not None]
+        if not deadlines:
+            return take
+        budget = min(deadlines) - time.monotonic()
+        n = len(take)
+        while n > 1:
+            try:
+                pred = self.predict_seconds(n)
+            except Exception:   # noqa: BLE001 — prediction is advisory;
+                return take     # a broken predictor must not shed
+            if pred is None or pred <= budget:
+                break
+            n = max(1, n // 2)
+        if n == len(take):
+            return take
+        self.metrics.counter(
+            "admission_batch_deadline_shrinks",
+            "batches shrunk so predicted latency fits the tightest "
+            "deadline").inc()
+        keep, back = take[:n], take[n:]
+        with self._wake:
+            self._queue[:0] = back
+            self._gauge_depth(len(self._queue))
+            self._wake.notify()
+        return keep
+
     def _run(self) -> None:
-        import time
+        from gatekeeper_tpu.resilience import faults
         while True:
             with self._wake:
                 while not self._queue and not self._stop:
@@ -123,6 +271,7 @@ class MicroBatcher:
                         p.error = RuntimeError("batcher stopped")
                         p.event.set()
                     self._queue.clear()
+                    self._gauge_depth(0)
                     return
                 # natural batching: under load, requests that arrived
                 # while the previous batch evaluated are already queued
@@ -133,8 +282,16 @@ class MicroBatcher:
                 if self.max_wait > 0 and len(self._queue) == 1 \
                         and not self._stop:
                     self._wake.wait(self.max_wait)
-                batch, self._queue = (self._queue[:self.max_batch],
-                                      self._queue[self.max_batch:])
+            # fault seam: queue_storm stalls batch formation once (a
+            # simulated consumer stall) so the bounded queue absorbs —
+            # and then sheds — a pressure spike; the sleep is outside
+            # the lock so submits keep landing against the bound
+            if faults.take("queue_storm"):
+                time.sleep(float(os.environ.get(
+                    "GATEKEEPER_FAULT_STALL_S", "0.25")))
+            with self._wake:
+                batch = self._take_batch(time.monotonic())
+            batch = self._fit_to_deadline(batch)
             if not batch:
                 continue
             self.metrics.counter("admission_batches").inc()

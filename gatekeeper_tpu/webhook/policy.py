@@ -51,7 +51,8 @@ def is_gk_service_account(user_info: dict) -> bool:
 class ValidationHandler:
     def __init__(self, client: Client, cluster=None, injected_config=None,
                  batcher=None, metrics: Metrics | None = None,
-                 log=lambda *_: None, batch_mode: str = "auto"):
+                 log=lambda *_: None, batch_mode: str = "auto",
+                 overload=None):
         self.client = client
         self.cluster = cluster
         self.injected_config = injected_config  # test hook (policy.go:121)
@@ -61,11 +62,21 @@ class ValidationHandler:
         # "auto": batch only when a full batch clears the device
         # engine's small-workload threshold; "always"/"never" force it
         self.batch_mode = batch_mode
+        # brownout ladder controller (webhook/overload.py); None keeps
+        # the pre-ladder behavior (always rung 0)
+        self.overload = overload
+        # cached Counter of installed enforcement actions (see
+        # _installed_actions): (expires_at_monotonic, counter)
+        self._actions_cache: tuple[float, dict] = (0.0, {})
 
     # ------------------------------------------------------------------
 
-    def handle(self, request: dict) -> dict:
-        """AdmissionRequest dict -> AdmissionResponse dict."""
+    def handle(self, request: dict, deadline: float | None = None) -> dict:
+        """AdmissionRequest dict -> AdmissionResponse dict.
+        ``deadline`` is an absolute ``time.monotonic`` instant derived
+        from the apiserver's per-request timeout (server.py parses the
+        webhook URL's ``?timeout=``); batch formation drops the request
+        once it passes."""
         from gatekeeper_tpu.obs.trace import get_tracer
         t0 = time.perf_counter()
         kind = request.get("kind") or {}
@@ -76,7 +87,7 @@ class ValidationHandler:
                 operation=request.get("operation", ""),
                 kind=kind.get("kind", "")) as sp:
             try:
-                resp = self._handle(request)
+                resp = self._handle(request, deadline)
                 if sp is not None:
                     sp.args["allowed"] = bool(resp.get("allowed"))
                 return resp
@@ -85,7 +96,55 @@ class ValidationHandler:
                     time.perf_counter() - t0)
                 self.metrics.counter("admission_requests").inc()
 
-    def _handle(self, request: dict) -> dict:
+    def _installed_actions(self) -> dict:
+        """Count of installed constraints per enforcement action — the
+        failurePolicy decision ("does a rejected request lose a deny
+        constraint?") and the shed accounting both need it.  Cached
+        ~0.5s: the constraint set changes at reconcile cadence, not
+        per request."""
+        now = time.monotonic()
+        expires, cached = self._actions_cache
+        if now < expires:
+            return cached
+        from gatekeeper_tpu.client.types import enforcement_action_of
+        counts: dict = {}
+        for by_name in self.client.constraints.values():
+            for c in by_name.values():
+                a = enforcement_action_of(c)
+                counts[a] = counts.get(a, 0) + 1
+        self._actions_cache = (now + 0.5, counts)
+        return counts
+
+    def _fail_per_policy(self, reason: str) -> dict:
+        """The failurePolicy path for a request that will NOT be
+        evaluated (queue full / fail-static rung).  Upstream's webhook
+        registration says ``failurePolicy: Ignore`` (bootstrap.py:135)
+        — but blanket Ignore silently admits everything a deny
+        constraint would have caught.  Per-template instead: if any
+        ``enforcementAction: deny`` constraint is installed, fail
+        CLOSED (429, retriable — the apiserver retries with backoff);
+        a warn/dryrun-only policy set fails open, losing only advisory
+        output."""
+        if self._installed_actions().get("deny", 0) > 0:
+            self.metrics.counter(
+                "admission_failclosed",
+                "unevaluated requests rejected because deny "
+                "constraints are installed").inc()
+            if self.overload is not None:
+                self.overload.count_shed("fail_closed")
+            return deny(429, f"admission overloaded ({reason}); "
+                             "deny policies are enforced, retry")
+        self.metrics.counter(
+            "admission_failopen",
+            "unevaluated requests admitted (no deny constraints "
+            "installed)").inc()
+        if self.overload is not None:
+            self.overload.count_shed("fail_open")
+        return allow(f"admission overloaded ({reason}); "
+                     "no deny policies installed, failing open")
+
+    def _handle(self, request: dict,
+                deadline: float | None = None) -> dict:
         if is_gk_service_account(request.get("userInfo") or {}):
             return allow("Gatekeeper does not self-manage")
 
@@ -101,8 +160,31 @@ class ValidationHandler:
         if err is not None:
             return deny(422 if user_err else 500, err)
 
+        # brownout ladder: pick the service level for THIS request
+        rung = 0
+        shed: frozenset | None = None
+        if self.overload is not None:
+            from gatekeeper_tpu.webhook.overload import FAIL_STATIC
+            rung = self.overload.rung()
+            if rung >= FAIL_STATIC:
+                out = self._fail_per_policy("brownout: fail-static rung")
+                self._record_admission(request, out, [], [])
+                return out
+            shed = self.overload.shed_actions(rung) or None
+            if shed:
+                installed = self._installed_actions()
+                for a in sorted(shed):
+                    if installed.get(a, 0):
+                        self.overload.count_shed(f"shed_{a}")
+
+        from gatekeeper_tpu.webhook.batcher import QueueFull
         try:
-            resp = self._review(request)
+            resp = self._review(request, deadline=deadline, shed=shed,
+                                rung=rung)
+        except QueueFull as e:
+            out = self._fail_per_policy(str(e))
+            self._record_admission(request, out, [], [])
+            return out
         except GatekeeperError as e:
             return deny(500, str(e))
         results = resp.results()
@@ -207,13 +289,36 @@ class ValidationHandler:
         n_cons = sum(len(v) for v in self.client.constraints.values())
         return n_cons * self.batcher.max_batch >= REVIEW_BATCH_MIN_EVALS
 
-    def _review(self, request: dict):
-        """reviewRequest (policy.go:244-277)."""
+    def _review(self, request: dict, deadline: float | None = None,
+                shed: frozenset | None = None, rung: int = 0):
+        """reviewRequest (policy.go:244-277).  ``deadline`` rides into
+        the batcher so formation drops the request once it expires;
+        ``shed``/``rung`` come from the brownout ladder — at
+        SCALAR_ONLY and above the batcher is bypassed (its queue is the
+        thing that's congested) and the request runs deny-only through
+        the scalar path."""
         tracing, dump = self._trace_switch(request)
-        if self.batcher is not None and not tracing and self._batching_pays():
-            resp = self.batcher.submit(request)
+        scalar_rung = False
+        if rung:
+            from gatekeeper_tpu.webhook.overload import SCALAR_ONLY
+            scalar_rung = rung >= SCALAR_ONLY
+        if self.batcher is not None and not tracing and not scalar_rung \
+                and self._batching_pays():
+            resp = self.batcher.submit(request, deadline=deadline)
+        elif scalar_rung and self.overload is not None:
+            # the bypass must stay visible to the pressure signal: with
+            # the queue out of the loop, in-flight scalar reviews ARE
+            # the backlog — without this, rung 3 empties the queue,
+            # pressure reads calm, and FAIL_STATIC can never engage
+            self.overload.scalar_begin()
+            try:
+                resp = self.client.review(request, tracing=tracing,
+                                          shed_actions=shed)
+            finally:
+                self.overload.scalar_end()
         else:
-            resp = self.client.review(request, tracing=tracing)
+            resp = self.client.review(request, tracing=tracing,
+                                      shed_actions=shed)
         if tracing:
             self.log(resp.trace_dump())
         if dump:

@@ -32,6 +32,24 @@ MAX_BODY_BYTES = 10 << 20    # AdmissionReview objects are etcd-bounded
 DRAIN_TIMEOUT_S = 15.0       # stop(): wait for in-flight admissions
 
 
+def _parse_timeout_param(query: str) -> float | None:
+    """Extract the apiserver's per-request timeout from the webhook
+    URL query string (``timeout=10s`` — k8s Duration, but apiservers
+    only ever send integer seconds; bootstrap.py registers the URL
+    with it appended).  None when absent or unparseable."""
+    for part in query.split("&"):
+        k, _, v = part.partition("=")
+        if k != "timeout" or not v:
+            continue
+        v = v.rstrip("s")
+        try:
+            t = float(v)
+        except ValueError:
+            return None
+        return t if t > 0 else None
+    return None
+
+
 class _DeadlineBody:
     """Body reader with a hard wall-clock deadline.
 
@@ -174,9 +192,14 @@ class WebhookServer:
                 self.wfile.write(payload)
 
             def do_POST(self):
-                if self.path != WEBHOOK_PATH:
+                # the apiserver appends its per-request timeout to the
+                # registered URL (bootstrap.py: ?timeout=10s) — split it
+                # off the path and turn it into the admission deadline
+                path, _, query = self.path.partition("?")
+                if path != WEBHOOK_PATH:
                     self.send_error(404)
                     return
+                apiserver_timeout = _parse_timeout_param(query)
                 if "chunked" in (self.headers.get(
                         "Transfer-Encoding") or "").lower():
                     # unbounded chunked bodies defeat the size cap; the
@@ -213,7 +236,16 @@ class WebhookServer:
                         self.connection.settimeout(request_timeout)
                     body = json.loads(payload or b"{}")
                     request = body.get("request") or {}
-                    response = outer.handler.handle(request)
+                    # admission deadline: the tightest of the
+                    # apiserver's ?timeout= and this server's own
+                    # request budget — propagated so batch formation
+                    # drops the request the moment it expires instead
+                    # of evaluating for a caller that already gave up
+                    budget = request_timeout
+                    if apiserver_timeout is not None:
+                        budget = min(budget, apiserver_timeout)
+                    response = outer.handler.handle(
+                        request, deadline=time.monotonic() + budget)
                     envelope = {
                         "apiVersion": body.get("apiVersion",
                                                "admission.k8s.io/v1beta1"),

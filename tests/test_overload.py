@@ -1,0 +1,333 @@
+"""Overload-control tests: bounded queue, QueueFull failurePolicy
+envelopes, deadline drops, cost-model batch sizing, and the brownout
+ladder's rung semantics (deny never fails open)."""
+
+import threading
+import time
+
+import pytest
+
+from gatekeeper_tpu.client.client import Backend
+from gatekeeper_tpu.client.local_driver import LocalDriver
+from gatekeeper_tpu.engine.jax_driver import JaxDriver
+from gatekeeper_tpu.target.k8s import K8sValidationTarget
+from gatekeeper_tpu.webhook import overload as ol
+from gatekeeper_tpu.webhook.batcher import (MicroBatcher, QueueFull,
+                                            SubmitTimeout)
+from gatekeeper_tpu.webhook.overload import OverloadController
+from gatekeeper_tpu.webhook.policy import ValidationHandler
+from gatekeeper_tpu.webhook.server import _parse_timeout_param
+from tests.test_control_plane import (constraint_obj, ns_obj, template_obj)
+from tests.test_webhook import review_request
+
+
+# ---------------------------------------------------------------------------
+# bounded queue
+
+
+class TestBoundedQueue:
+    def test_burst_over_capacity_sheds_not_buffers(self):
+        """A burst at 4x max_batch against a stalled evaluator must keep
+        the queue at its bound and reject the overflow with QueueFull —
+        bounded memory, not an unbounded buffer."""
+        release = threading.Event()
+        max_batch, capacity = 8, 16
+
+        def evaluate(reqs):
+            release.wait(10)
+            return [{"i": r["i"]} for r in reqs]
+
+        b = MicroBatcher(evaluate, max_batch=max_batch, max_wait=0,
+                         capacity=capacity, submit_timeout=10)
+        b.start()
+        n = 4 * max_batch + capacity
+        outcomes: list = [None] * n
+        threads = []
+
+        def submit(i):
+            try:
+                outcomes[i] = b.submit({"i": i})
+            except QueueFull:
+                outcomes[i] = "queue_full"
+
+        try:
+            for i in range(n):
+                threads.append(threading.Thread(target=submit, args=(i,)))
+                threads[-1].start()
+            # wait until every submit either queued or bounced
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                settled = sum(o == "queue_full" for o in outcomes)
+                # queued = in the queue or taken into the stalled batch
+                if settled + b.depth() + max_batch >= n:
+                    break
+                time.sleep(0.01)
+            assert b.depth() <= capacity
+            bounced = sum(o == "queue_full" for o in outcomes)
+            assert bounced >= n - capacity - max_batch
+        finally:
+            release.set()
+            for t in threads:
+                t.join(5)
+            b.stop()
+        # everyone either evaluated or was explicitly shed — no losses
+        assert all(o is not None for o in outcomes)
+        shed = b.metrics.snapshot().get(
+            'admission_shed_total{reason="queue_full"}', 0)
+        assert shed == bounced > 0
+
+    def test_capacity_env(self, monkeypatch):
+        monkeypatch.setenv("GATEKEEPER_ADMISSION_QUEUE", "3")
+        b = MicroBatcher(lambda reqs: [None] * len(reqs))
+        assert b.capacity == 3
+        monkeypatch.setenv("GATEKEEPER_ADMISSION_QUEUE", "bogus")
+        assert MicroBatcher(lambda r: r).capacity == 2048
+
+
+# ---------------------------------------------------------------------------
+# deadline propagation
+
+
+class TestDeadlines:
+    def test_pre_expired_deadline_rejected_without_queueing(self):
+        b = MicroBatcher(lambda reqs: [None] * len(reqs), submit_timeout=5)
+        b.start()
+        try:
+            with pytest.raises(SubmitTimeout):
+                b.submit({"x": 1}, deadline=time.monotonic() - 0.01)
+            assert b.metrics.snapshot().get(
+                "admission_expired_dropped", 0) == 1
+        finally:
+            b.stop()
+
+    def test_expired_entries_dropped_at_formation(self):
+        """Entries whose deadline passes while queued are dropped at
+        batch formation — never evaluated — and their waiters get
+        SubmitTimeout."""
+        stall = threading.Event()
+        evaluated: list = []
+
+        def evaluate(reqs):
+            evaluated.extend(r["i"] for r in reqs)
+            return [None] * len(reqs)
+
+        b = MicroBatcher(evaluate, max_batch=4, max_wait=0,
+                         submit_timeout=5)
+        # no worker yet: queue entries by hand through submit on threads
+        b.start()
+        # jam the worker with a slow first batch so later entries expire
+        def slow_first(reqs):
+            stall.wait(2)
+            evaluated.extend(r["i"] for r in reqs)
+            return [None] * len(reqs)
+        b.evaluate_batch = slow_first
+        errs: list = []
+
+        def submit_short(i):
+            try:
+                b.submit({"i": i}, deadline=time.monotonic() + 0.15)
+            except SubmitTimeout as e:
+                errs.append((i, str(e)))
+
+        t0 = threading.Thread(target=submit_short, args=(0,))
+        t0.start()
+        time.sleep(0.05)           # worker now stalled on batch [0]
+        t1 = threading.Thread(target=submit_short, args=(1,))
+        t1.start()
+        time.sleep(0.3)            # entry 1 expires while queued
+        b.evaluate_batch = evaluate
+        stall.set()
+        t0.join(5)
+        t1.join(5)
+        b.stop()
+        assert 1 not in evaluated  # formation dropped it before dispatch
+        assert len(errs) == 2      # both callers saw SubmitTimeout
+
+    def test_cost_model_shrinks_batch_to_tightest_deadline(self):
+        """With a calibrated predictor saying a big batch misses the
+        tightest deadline, formation halves the batch until it fits and
+        re-queues the remainder."""
+        gate = threading.Event()
+        first_taken = threading.Event()
+        sizes: list = []
+
+        def evaluate(reqs):
+            sizes.append(len(reqs))
+            first_taken.set()
+            gate.wait(5)
+            return [None] * len(reqs)
+
+        # predictor: >4 reviews blows the budget, <=4 fits easily
+        b = MicroBatcher(evaluate, max_batch=8, max_wait=0,
+                         submit_timeout=5,
+                         predict_seconds=lambda n: 10.0 if n > 4 else 0.01)
+        b.start()
+        threads = [threading.Thread(
+            target=lambda: b.submit({"x": 1},
+                                    deadline=time.monotonic() + 5.0))
+            for _ in range(8)]
+        threads[0].start()
+        assert first_taken.wait(2)  # worker now parked on gate
+        for t in threads[1:]:
+            t.start()
+        deadline = time.monotonic() + 2
+        while b.depth() < 7 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        gate.set()
+        for t in threads:
+            t.join(5)
+        b.stop()
+        # the 7 queued entries predicted over-budget: halved to 3, the
+        # rest re-queued and served in a following, fitting, batch
+        assert max(sizes[1:]) <= 4
+        assert sum(sizes) == 8
+        assert b.metrics.snapshot().get(
+            "admission_batch_deadline_shrinks", 0) >= 1
+
+    def test_parse_timeout_param(self):
+        assert _parse_timeout_param("timeout=10s") == 10.0
+        assert _parse_timeout_param("timeout=2") == 2.0
+        assert _parse_timeout_param("a=b&timeout=5s&c=d") == 5.0
+        assert _parse_timeout_param("") is None
+        assert _parse_timeout_param("timeout=") is None
+        assert _parse_timeout_param("timeout=wat") is None
+        assert _parse_timeout_param("timeout=0s") is None
+
+
+# ---------------------------------------------------------------------------
+# the brownout ladder
+
+
+def _client(driver=None, actions=("deny",)):
+    c = Backend(driver or LocalDriver()).new_client([K8sValidationTarget()])
+    c.add_template(template_obj())
+    for i, a in enumerate(actions):
+        con = constraint_obj(name=f"c-{a}-{i}")
+        if a != "deny":
+            con["spec"]["enforcementAction"] = a
+        c.add_constraint(con)
+    return c
+
+
+class TestLadder:
+    def test_rung_thresholds_and_hysteresis(self, monkeypatch):
+        monkeypatch.setenv("GATEKEEPER_BROWNOUT", "auto")
+        monkeypatch.setenv("GATEKEEPER_BROWNOUT_DECAY_S", "0.05")
+        depth = [0]
+        c = OverloadController(lambda: depth[0], capacity=100)
+        assert c.rung() == ol.HEALTHY
+        depth[0] = 55
+        assert c.rung() == ol.SHED_DRYRUN
+        depth[0] = 96
+        assert c.rung() == ol.FAIL_STATIC     # escalation is instant
+        depth[0] = 0
+        assert c.rung() == ol.FAIL_STATIC     # arms the calm timer
+        time.sleep(0.07)
+        assert c.rung() == ol.SCALAR_ONLY     # one rung per decay window
+        assert c.rung() == ol.SCALAR_ONLY     # re-arms the timer
+        time.sleep(0.07)
+        assert c.rung() == ol.SHED_WARN
+
+    def test_forced_rung_env(self, monkeypatch):
+        monkeypatch.setenv("GATEKEEPER_BROWNOUT", "3")
+        c = OverloadController(lambda: 0, capacity=10)
+        assert c.rung() == ol.SCALAR_ONLY
+        monkeypatch.setenv("GATEKEEPER_BROWNOUT", "off")
+        assert c.rung() == ol.HEALTHY
+
+    def test_shed_sets_never_contain_deny(self):
+        c = OverloadController(lambda: 0, capacity=10)
+        for rung in range(5):
+            assert "deny" not in c.shed_actions(rung)
+
+    @pytest.mark.parametrize("rung", [1, 2, 3])
+    def test_deny_enforced_at_every_evaluating_rung(self, rung,
+                                                    monkeypatch):
+        """Rungs 1-3 still evaluate deny constraints — a violating
+        request is denied 403 with the same message as healthy."""
+        monkeypatch.setenv("GATEKEEPER_BROWNOUT", str(rung))
+        client = _client(actions=("deny", "warn", "dryrun"))
+        c = OverloadController(lambda: 0, capacity=10)
+        h = ValidationHandler(client, overload=c)
+        resp = h.handle(review_request(ns_obj("bad")))
+        assert resp["allowed"] is False
+        assert resp["status"]["code"] == 403
+        assert "[denied by c-deny-0]" in resp["status"]["message"]
+        # and a clean request still passes
+        ok = h.handle(review_request(ns_obj("ok", {"gatekeeper": "on"})))
+        assert ok["allowed"] is True
+
+    def test_fail_static_fails_closed_with_deny_installed(self,
+                                                          monkeypatch):
+        monkeypatch.setenv("GATEKEEPER_BROWNOUT", "4")
+        client = _client(actions=("deny",))
+        c = OverloadController(lambda: 0, capacity=10)
+        h = ValidationHandler(client, overload=c)
+        resp = h.handle(review_request(ns_obj("bad")))
+        assert resp["allowed"] is False
+        assert resp["status"]["code"] == 429
+        assert h.metrics.snapshot().get("admission_failclosed", 0) == 1
+
+    def test_fail_static_fails_open_without_deny(self, monkeypatch):
+        monkeypatch.setenv("GATEKEEPER_BROWNOUT", "4")
+        client = _client(actions=("warn", "dryrun"))
+        c = OverloadController(lambda: 0, capacity=10)
+        h = ValidationHandler(client, overload=c)
+        resp = h.handle(review_request(ns_obj("bad")))
+        assert resp["allowed"] is True
+        assert h.metrics.snapshot().get("admission_failopen", 0) == 1
+
+    def test_shed_rungs_drop_warn_and_dryrun_output(self, monkeypatch):
+        client = _client(actions=("deny", "warn", "dryrun"))
+        c = OverloadController(lambda: 0, capacity=10)
+        h = ValidationHandler(client, overload=c)
+        monkeypatch.setenv("GATEKEEPER_BROWNOUT", "0")
+        healthy = h.handle(review_request(ns_obj("bad")))
+        assert healthy.get("warnings")          # warn constraint speaks
+        monkeypatch.setenv("GATEKEEPER_BROWNOUT", "2")
+        browned = h.handle(review_request(ns_obj("bad")))
+        assert not browned.get("warnings")      # warn shed
+        assert browned["allowed"] is False      # deny intact
+        shed = {k: v for k, v in c.metrics.snapshot().items()
+                if k.startswith("admission_shed_total")}
+        assert shed.get('admission_shed_total{reason="shed_warn"}', 0) >= 1
+        assert shed.get('admission_shed_total{reason="shed_dryrun"}', 0) >= 1
+
+
+class TestQueueFullEnvelope:
+    @pytest.mark.parametrize("driver_cls", [LocalDriver, JaxDriver])
+    def test_queue_full_rides_failure_policy(self, driver_cls):
+        """A full queue surfaces as QueueFull, and the handler answers
+        per failurePolicy: 429 fail-closed with deny installed."""
+        client = _client(driver=driver_cls(), actions=("deny",))
+        release = threading.Event()
+
+        def evaluate(reqs):
+            release.wait(5)
+            return client.review_batch(reqs)
+
+        b = MicroBatcher(evaluate, max_batch=1, max_wait=0, capacity=1,
+                         submit_timeout=5)
+        c = OverloadController(b.depth, capacity=1)
+        h = ValidationHandler(client, batcher=b, overload=c,
+                              batch_mode="always")
+        b.start()
+        try:
+            # occupy the worker + fill the 1-slot queue
+            t1 = threading.Thread(
+                target=lambda: h.handle(review_request(ns_obj("a"))))
+            t1.start()
+            time.sleep(0.1)
+            t2 = threading.Thread(
+                target=lambda: h.handle(review_request(ns_obj("b"))))
+            t2.start()
+            time.sleep(0.1)
+            resp = h.handle(review_request(ns_obj("c")))
+            assert resp["allowed"] is False
+            assert resp["status"]["code"] == 429
+            assert "retry" in resp["status"]["message"]
+        finally:
+            release.set()
+            t1.join(5)
+            t2.join(5)
+            b.stop()

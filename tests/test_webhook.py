@@ -419,9 +419,9 @@ class TestHardening:
         release = threading.Event()
         orig = handler.handle
 
-        def slow_handle(request):
+        def slow_handle(request, deadline=None):
             release.wait(5)
-            return orig(request)
+            return orig(request, deadline=deadline)
 
         handler.handle = slow_handle
         server = WebhookServer(handler, port=0, drain_timeout=10)
