@@ -161,7 +161,7 @@ def flush_partial() -> None:
 def _slim_headline() -> dict:
     """The stdout headline WITHOUT the full detail tree: metric, value,
     backend, and one-line north-star / full-sweep summaries.  Kept
-    ≤1,500 chars by contract — the capture windows that consume the
+    ≤1,600 chars by contract — the capture windows that consume the
     bench keep only a stdout tail (ci.sh parses the trailing 2,000
     bytes; the round-5 number of record was erased by exactly such a
     window).  Everything measured stays in BENCH_partial.json."""
@@ -204,6 +204,12 @@ def _slim_headline() -> dict:
                                    ("kinds_skipped", "evaluations_saved",
                                     "parity")
                                    if cs.get(k) is not None}
+    pc = DETAIL.get("paged_churn")
+    if isinstance(pc, dict):
+        slim["paged_churn"] = {k: pc.get(k) for k in
+                               ("parity", "rows_frac",
+                                "evaluations_saved")
+                               if pc.get(k) is not None}
     tv = DETAIL.get("transval")
     if isinstance(tv, dict):
         slim["transval"] = {k: tv.get(k) for k in
@@ -254,7 +260,7 @@ def _slim_headline() -> dict:
 
 def emit_headline() -> None:
     """Print THE one stdout JSON line (exactly once, from any thread) —
-    the SLIM headline (≤1,500 chars; full detail goes to
+    the SLIM headline (≤1,600 chars; full detail goes to
     BENCH_partial.json via flush_partial, never to stdout).  The
     watchdog calls this while a phase thread may be mutating DETAIL —
     serialization must survive the race (and _EMITTED only latches
@@ -272,7 +278,7 @@ def emit_headline() -> None:
                 break
             except RuntimeError:        # dict mutated mid-dump; retry
                 time.sleep(0.05)
-        if line is None or len(line) > 1500:    # belt and braces: the
+        if line is None or len(line) > 1600:    # belt and braces: the
             # headline must fit the 2,000-byte tail window whole
             line = json.dumps({k: HEADLINE.get(k) for k in
                                ("metric", "value", "unit", "vs_baseline",
@@ -1247,6 +1253,139 @@ def bench_churn_selective(detail):
             f"oracle={len(v_oracle)} selective={len(v_sel)}")
 
 
+def bench_paged_churn(detail):
+    """Continuous enforcement at library scale: the row-paged sweep
+    (GATEKEEPER_PAGES=on, enforce/ledger.py) vs the PR-10
+    kind-granular selective sweep vs the pages-off/footprint-off full
+    oracle, at 0.1% and 1% churn.  Verdicts must be bit-identical
+    across all three configs; the paged run additionally reports the
+    page-level work accounting (rows re-evaluated as a fraction of the
+    row-evaluation space, constraint-evaluations saved, delta events)
+    from jax_driver's ``pages`` phase stanza.  The acceptance floor —
+    <5% of row-evaluations at 0.1% churn — is gated in ci.sh off this
+    detail row."""
+    import copy
+    from gatekeeper_tpu.engine import jax_driver as jd_mod
+
+    n = sized(BASELINE_N, 400, 1_000)
+    log(f"[paged-churn] n={n}, paged vs kind-granular vs full oracle")
+    rng = random.Random(13)
+    resources = make_mixed(rng, n)
+    opts = QueryOpts(limit_per_constraint=CAP)
+    full_opts = QueryOpts(limit_per_constraint=CAP, full=True)
+
+    def run(pages: str, fp_mode: str, n_churn: int, image_only: bool):
+        prev_pg = os.environ.get("GATEKEEPER_PAGES")
+        prev_fp = os.environ.get("GATEKEEPER_FOOTPRINT")
+        os.environ["GATEKEEPER_PAGES"] = pages
+        os.environ["GATEKEEPER_FOOTPRINT"] = fp_mode
+        saved = jd_mod.SMALL_WORKLOAD_EVALS
+        try:
+            if not FALLBACK:
+                jd_mod.SMALL_WORKLOAD_EVALS = 0
+            work = copy.deepcopy(resources)     # churn mutates rows
+            jd = JaxDriver()
+            c = Backend(jd).new_client([K8sValidationTarget()])
+            for tdoc, cdoc in all_docs():
+                c.add_template(tdoc)
+                c.add_constraint(cdoc)
+            c.add_data_batch(work)
+            jd.query_audit(TARGET_NAME, full_opts)      # compile warm
+            jd.query_audit(TARGET_NAME, opts)           # ledger built
+            churn_rng = random.Random(99)
+            pod_idx = [i for i, o in enumerate(work)
+                       if (o.get("spec") or {}).get("containers")]
+            for j in range(n_churn):
+                # fresh object per event — a real watch decodes a new
+                # dict each time (re-upserting the stored reference
+                # trips the aliasing guard and widens the path set)
+                if image_only or j % 2:
+                    # verdict-flipping edit inside the image templates'
+                    # read-sets (sampled from container-bearing rows so
+                    # the edit lands): those kinds re-evaluate ONE page
+                    # and the ledger emits the msg delta
+                    o = copy.deepcopy(work[churn_rng.choice(pod_idx)])
+                    for cont in o["spec"]["containers"]:
+                        cont["image"] = f"evil.io/paged:{j}"
+                else:
+                    # annotation noise outside every read-set
+                    o = copy.deepcopy(work[churn_rng.randrange(n)])
+                    o.setdefault("metadata", {}).setdefault(
+                        "annotations", {})["bench-paged"] = f"r{j}"
+                c.add_data(o)
+            t0 = time.perf_counter()
+            results, _ = jd.query_audit(TARGET_NAME, opts)
+            wall = time.perf_counter() - t0
+            verdicts = sorted(
+                ((r.constraint or {}).get("kind", ""),
+                 ((r.constraint or {}).get("metadata") or {}).get(
+                     "name", ""),
+                 ((r.resource or {}).get("metadata") or {}).get(
+                     "name", ""),
+                 r.msg)
+                for r in results)
+            stanza = dict(jd.last_sweep_phases.get("pages") or {})
+            return verdicts, wall, stanza
+        finally:
+            jd_mod.SMALL_WORKLOAD_EVALS = saved
+            for key, prev in (("GATEKEEPER_PAGES", prev_pg),
+                              ("GATEKEEPER_FOOTPRINT", prev_fp)):
+                if prev is None:
+                    os.environ.pop(key, None)
+                else:
+                    os.environ[key] = prev
+
+    out = {"n_resources": n}
+    for label, n_churn, image_only in (
+            ("churn_0p1", max(n // 1000, 1), True),
+            ("churn_1p0", max(n // 100, 1), False)):
+        v_or, or_s, _ = run("off", "off", n_churn, image_only)
+        v_kind, kind_s, _ = run("off", "on", n_churn, image_only)
+        v_pg, pg_s, stanza = run("on", "on", n_churn, image_only)
+        parity = v_or == v_kind == v_pg
+        digest = hashlib.sha256(repr(v_pg).encode()).hexdigest()[:16]
+        kinds_paged = stanza.get("kinds_paged", 0) or 1
+        rows_frac = (stanza.get("rows_reevaluated", 0)
+                     / float(n * kinds_paged))
+        out[label] = {
+            "churn_rows": n_churn,
+            "parity": parity,
+            "parity_digest": digest,
+            "kinds_paged": kinds_paged,
+            "kinds_fallback": stanza.get("kinds_fallback", 0),
+            "pages_evaluated": stanza.get("pages_evaluated", 0),
+            "pages_skipped": stanza.get("pages_skipped", 0),
+            "rows_reevaluated": stanza.get("rows_reevaluated", 0),
+            "rows_frac": round(rows_frac, 5),
+            "evaluations_saved": stanza.get("evaluations_saved", 0),
+            "events": stanza.get("events", 0),
+            "paged_seconds": round(pg_s, 4),
+            "kind_granular_seconds": round(kind_s, 4),
+            "oracle_seconds": round(or_s, 4),
+            "paged_vs_oracle_ratio": round(pg_s / or_s, 3)
+            if or_s else None,
+        }
+        log(f"[paged-churn] {label}: {n_churn} row(s) churned | paged "
+            f"{pg_s*1e3:.0f}ms vs kind {kind_s*1e3:.0f}ms vs oracle "
+            f"{or_s*1e3:.0f}ms | rows_frac={rows_frac:.4f} "
+            f"saved={stanza.get('evaluations_saved', 0)} "
+            f"events={stanza.get('events', 0)} | parity={parity} "
+            f"digest={digest}")
+        if not parity:
+            raise AssertionError(
+                f"paged-churn verdict mismatch at {label}: "
+                f"oracle={len(v_or)} kind={len(v_kind)} paged={len(v_pg)}")
+    # the headline/gate keys: the 0.1%-churn leg carries the O(dirty)
+    # claim of record
+    out["parity"] = out["churn_0p1"]["parity"] \
+        and out["churn_1p0"]["parity"]
+    out["parity_digest"] = out["churn_0p1"]["parity_digest"]
+    out["rows_frac"] = out["churn_0p1"]["rows_frac"]
+    out["evaluations_saved"] = out["churn_0p1"]["evaluations_saved"]
+    out["page_rows"] = stanza.get("page_rows")
+    detail["paged_churn"] = out
+
+
 _SHARD_SIM_CHILD = r"""
 import copy, hashlib, json, os, random, sys, time
 os.environ["JAX_PLATFORMS"] = "cpu"
@@ -2176,6 +2315,8 @@ def main():
     run_phase("analysis", bench_analysis, 300)
     quiesce_upgrades()
     run_phase("churn_selective", bench_churn_selective, 300)
+    quiesce_upgrades()
+    run_phase("paged_churn", bench_paged_churn, 420)
     quiesce_upgrades()
     run_phase("transval", bench_transval, 240)
     quiesce_upgrades()

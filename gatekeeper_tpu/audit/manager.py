@@ -74,6 +74,35 @@ class AuditManager:
         self.last_sweep: dict = {}
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+        # continuous enforcement (opt-in via attach_ledger): constraint
+        # keys (kind, name) whose written status still reflects the
+        # ledger's verdicts — a delta event dirties its key, and a
+        # non-full sweep skips the status write for clean keys, so
+        # status updates come from deltas instead of full resyncs
+        self._ledger = None
+        self._ledger_clean: set[tuple[str, str]] = set()
+        self._ledger_lock = threading.Lock()
+
+    # ------------------------------------------------------------------
+    # continuous enforcement subscription
+
+    def attach_ledger(self, ledger) -> None:
+        """Subscribe to a VerdictLedger's delta events (enforce/
+        ledger.py).  Once attached, a non-full sweep skips the
+        ``status.violations`` write for any ledger-maintained
+        constraint whose verdicts did not change since its last write —
+        the reference rewrites every constraint's status every
+        ``--audit-interval`` regardless.  Default (unattached) behavior
+        is byte-identical to before."""
+        self._ledger = ledger
+        with self._ledger_lock:
+            self._ledger_clean.clear()
+        ledger.subscribe(self._on_verdict_delta)
+
+    def _on_verdict_delta(self, ev: dict) -> None:
+        with self._ledger_lock:
+            self._ledger_clean.discard((ev.get("kind", ""),
+                                        ev.get("constraint", "")))
 
     # ------------------------------------------------------------------
     # one sweep
@@ -140,7 +169,7 @@ class AuditManager:
         if phases:
             for k in ("host_prep_s", "h2d_s", "device_s",
                       "overlap_fraction", "external", "dedup",
-                      "attribution"):
+                      "attribution", "pages"):
                 if k in phases:
                     report[k] = phases[k]
 
@@ -176,9 +205,18 @@ class AuditManager:
             return report
 
         t_write = self._now()
-        updated = self._write_audit_results(kinds, update_lists, timestamp)
+        # delta-skip is live only when the ledger actually served this
+        # sweep (pages enabled, non-full) — a legacy sweep emits no
+        # delta events, so skipping on its strength would go stale
+        allow_skip = self._ledger is not None and not full and \
+            bool((phases or {}).get("pages", {}).get("enabled"))
+        updated, skipped = self._write_audit_results(
+            kinds, update_lists, timestamp, allow_skip=allow_skip)
         report["write_seconds"] = self._now() - t_write
         report["constraints_updated"] = updated
+        if self._ledger is not None:
+            report["status_writes_skipped"] = skipped
+            self.metrics.counter("status_writes_skipped").inc(skipped)
         self._maybe_snapshot_store()
         return report
 
@@ -226,11 +264,14 @@ class AuditManager:
 
     def _write_audit_results(self, kinds: list[dict],
                              update_lists: dict[str, list[dict]],
-                             timestamp: str) -> int:
+                             timestamp: str,
+                             allow_skip: bool = False) -> tuple[int, int]:
         """writeAuditResults + updateConstraintLoop (:201-248,313-379):
         list every constraint of every kind and write its status with
         exponential-backoff retry; constraints without violations get
-        stale status.violations removed."""
+        stale status.violations removed.  With ``allow_skip`` (ledger
+        attached + paged sweep), ledger-maintained constraints whose
+        verdicts didn't change since their last write are skipped."""
         pending: dict[str, dict] = {}
         for res in kinds:
             gvk = GVK("constraints.gatekeeper.sh", "v1alpha1", res["kind"])
@@ -238,10 +279,22 @@ class AuditManager:
                 link = (item.get("metadata") or {}).get("selfLink", "")
                 pending[link] = item
 
+        led_kinds = set(self._ledger.entries) if self._ledger is not None \
+            else set()
         updated = 0
+        skipped = 0
         delay = 1.0
         for _ in range(5):  # wait.Backoff{Duration:1s, Factor:2, Steps:5}
             for link, item in list(pending.items()):
+                ckey = (item.get("kind", ""),
+                        (item.get("metadata") or {}).get("name", ""))
+                if allow_skip and ckey[0] in led_kinds:
+                    with self._ledger_lock:
+                        clean = ckey in self._ledger_clean
+                    if clean:
+                        del pending[link]
+                        skipped += 1
+                        continue
                 try:
                     latest = self.cluster.get(
                         gvk_of_constraint(item),
@@ -253,11 +306,14 @@ class AuditManager:
                     continue  # retried next backoff round
                 del pending[link]
                 updated += 1
+                if self._ledger is not None and ckey[0] in led_kinds:
+                    with self._ledger_lock:
+                        self._ledger_clean.add(ckey)
             if not pending:
                 break
             self._sleep(delay)
             delay *= 2
-        return updated
+        return updated, skipped
 
     def _update_constraint_status(self, instance: dict,
                                   violations: list[dict],

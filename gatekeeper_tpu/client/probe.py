@@ -952,6 +952,136 @@ def run_whatif() -> int:
     return _severity_rc(n_err, 1 if scalar else 0)
 
 
+def run_pages(paths: list[str], use_library: bool = False) -> int:
+    """``--pages``: self-validate the continuous-enforcement paged
+    sweep (enforce/, ROADMAP item 2) over template files and/or the
+    built-in library.  Two identically-churned clients run side by
+    side — ``GATEKEEPER_PAGES=on`` vs the legacy full path — and every
+    sweep's verdicts must match bit-identically while the paged client
+    maintains its VerdictLedger by per-page deltas.  Prints the page
+    geometry (rows/page, page count, occupancy), per-sweep dirty work
+    (pages evaluated vs total, evaluations saved, delta events), the
+    ledger size, and per-kind eligibility with fallback reasons.  Exit
+    contract (:func:`_severity_rc`): 2 on any parity break or
+    unreadable input, 1 when parity held but some kind fell back to
+    the full-kind path (cross-row / scalar-pin — delta maintenance
+    disabled for it), 0 all kinds paged with parity."""
+    import copy
+    import os as _os
+    import random
+    import sys
+    import time as _time
+
+    from gatekeeper_tpu.client.client import Backend
+    from gatekeeper_tpu.engine.jax_driver import JaxDriver
+    from gatekeeper_tpu.library import make_mixed
+    from gatekeeper_tpu.target.k8s import K8sValidationTarget
+    from gatekeeper_tpu.whatif import normalize_results
+
+    work = _load_work(paths, use_library)
+    if work is None:
+        return 2
+    if not work:
+        print("pages: no ConstraintTemplate docs "
+              "(pass template yaml or --library)", file=sys.stderr)
+        return 2
+
+    n = int(_os.environ.get("GATEKEEPER_PAGES_PROBE_N", "300"))
+    objs = make_mixed(random.Random(7), n)
+
+    def _build():
+        driver = JaxDriver()
+        handler = K8sValidationTarget()
+        client = Backend(driver).new_client([handler])
+        for _label, tdoc, cdocs in work:
+            client.add_template(tdoc)
+            for c in cdocs:
+                client.add_constraint(c)
+        client.add_data_batch(copy.deepcopy(objs))
+        return driver, handler, client
+
+    prev = _os.environ.get("GATEKEEPER_PAGES")
+
+    def _sweep(client, pages: bool):
+        _os.environ["GATEKEEPER_PAGES"] = "on" if pages else "off"
+        try:
+            return normalize_results(
+                client.audit(limit_per_constraint=20).results())
+        finally:
+            if prev is None:
+                _os.environ.pop("GATEKEEPER_PAGES", None)
+            else:
+                _os.environ["GATEKEEPER_PAGES"] = prev
+
+    # churn batches built once from the seed objects, applied
+    # identically to both clients: metadata noise (invisible to most
+    # footprints — the paged sweep should skip almost everything),
+    # then an image edit that actually flips verdicts
+    rng = random.Random(11)
+    churn_n = max(n // 100, 1)
+    batches = []
+    b = []
+    for o in rng.sample(objs, min(churn_n, len(objs))):
+        o = copy.deepcopy(o)
+        o.setdefault("metadata", {}).setdefault(
+            "annotations", {})["probe/pages"] = "noise-1"
+        b.append(o)
+    batches.append(b)
+    pods = [o for o in objs
+            if isinstance((o.get("spec") or {}).get("containers"), list)
+            and (o.get("spec") or {}).get("containers")]
+    b = []
+    for o in rng.sample(pods, min(churn_n, len(pods))):
+        o = copy.deepcopy(o)
+        o["spec"]["containers"][0]["image"] = "evil.io/pages-probe:1"
+        b.append(o)
+    if b:
+        batches.append(b)
+
+    t0 = _time.perf_counter()
+    jd_p, h_p, cl_p = _build()
+    _jd_o, _h_o, cl_o = _build()
+    n_err = 0
+    for i, batch in enumerate([None] + batches):
+        if batch:
+            cl_p.add_data_batch(copy.deepcopy(batch))
+            cl_o.add_data_batch(copy.deepcopy(batch))
+        got = _sweep(cl_p, True)
+        want = _sweep(cl_o, False)
+        pg = (jd_p.last_sweep_phases or {}).get("pages", {})
+        ok = got == want
+        n_err += 0 if ok else 1
+        label = ("cold build" if i == 0
+                 else f"churn {i} ({len(batch)} upsert(s))")
+        print(f"  {'ok  ' if ok else 'FAIL'} sweep {i}: {label} — "
+              f"{len(got)} verdict(s), "
+              f"{pg.get('pages_evaluated', 0)}/{pg.get('n_pages', 0)} "
+              f"page(s) evaluated, "
+              f"{pg.get('evaluations_saved', 0)} evaluation(s) saved, "
+              f"{pg.get('events', 0)} delta event(s)")
+
+    st = jd_p._state(h_p.name)
+    table = st.table
+    n_warn = 0
+    for kind in sorted(st.templates):
+        reason = jd_p._pages_ineligible(st, kind, st.templates[kind])
+        if reason is None:
+            print(f"  ok   {kind}: paged (delta-maintained)")
+        else:
+            n_warn += 1
+            print(f"  warn {kind}: full-kind fallback — {reason}")
+    led = st.ledger
+    occ = table.n_rows / max(1, table.n_pages * table.page_rows)
+    wall = _time.perf_counter() - t0
+    print(f"pages: page_rows={table.page_rows} pages={table.n_pages} "
+          f"rows={table.n_rows} occupancy={occ:.0%}; "
+          f"ledger {led.total_violations() if led else 0} violation(s) "
+          f"seq={led.seq if led else 0}; "
+          f"{len(st.templates) - n_warn}/{len(st.templates)} kind(s) "
+          f"paged; {n_err} parity failure(s) in {wall:.1f}s")
+    return _severity_rc(n_err, n_warn)
+
+
 def run_health() -> int:
     """``probe --health``: the k8s liveness/readiness consumer.  One
     JSON line with the backend supervisor's serving posture (state,
@@ -1017,6 +1147,8 @@ def _run_subcommand(argv: list[str]) -> int | None:
         ("--footprint", lambda rest: run_footprint(
             rest, use_library=use_library)),
         ("--shardplan", lambda rest: run_shardplan(
+            rest, use_library=use_library)),
+        ("--pages", lambda rest: run_pages(
             rest, use_library=use_library)),
         ("--lint", lambda rest: run_lint(
             rest, use_library=use_library, strict=strict)),

@@ -1,0 +1,13 @@
+"""Continuous enforcement: incremental verdict maintenance.
+
+The paged sweep (engine/jax_driver.py) re-evaluates only dirty pages ×
+affected constraints and applies the per-page deltas to a
+:class:`~gatekeeper_tpu.enforce.ledger.VerdictLedger`, which holds the
+continuously-true violation set per kind and emits an ordered event
+stream of violations appearing/clearing.
+"""
+
+from gatekeeper_tpu.enforce.ledger import (VerdictLedger, export_all,
+                                           pages_mode)
+
+__all__ = ["VerdictLedger", "export_all", "pages_mode"]

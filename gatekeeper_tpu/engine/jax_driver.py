@@ -194,6 +194,13 @@ class JaxTargetState(TargetState):
         # kind -> last device sweep payload + guards, for
         # footprint-driven selective invalidation (_selective_reuse)
         self.sweep_cache: dict[str, dict] = {}
+        # continuous enforcement (enforce/ledger.py): the target's
+        # VerdictLedger (created on the first paged sweep), a restored
+        # pagemap snapshot awaiting per-kind adoption, and the overflow
+        # counter watermark already exported to metrics
+        self.ledger = None
+        self.ledger_restored: dict | None = None
+        self.dirtylog_overflows_seen = 0
 
     def bump(self, kind: str) -> None:
         self.con_version[kind] = self.con_version.get(kind, 0) + 1
@@ -313,7 +320,15 @@ class JaxDriver(LocalDriver):
         if not _snap.enabled():
             return False
         st = self._state(target)
-        return _snap.save_store(target, st.table.snapshot_state())
+        ok = _snap.save_store(target, st.table.snapshot_state())
+        if ok and isinstance(st, JaxTargetState) and st.ledger is not None \
+                and st.ledger.entries:
+            # companion pagemap tier: the ledger's confirmed verdicts
+            # ride the same snapshot so a warm restart adopts them
+            # (per kind, revalidated by constraint digest + row count)
+            # instead of paying a cold full build
+            _snap.save_pagemap(target, st.ledger.snapshot_payload())
+        return ok
 
     @locked
     def restore_store_snapshot(self, target: str) -> bool:
@@ -329,6 +344,15 @@ class JaxDriver(LocalDriver):
         if hit is None:
             return False
         st.table.restore_state(hit[0])
+        if isinstance(st, JaxTargetState):
+            from gatekeeper_tpu.enforce.ledger import pages_mode as _pg
+            # the pagemap tier only exists for paged deployments — with
+            # pages off the ledger is never consulted, so don't charge
+            # a tier miss against the warm-restart counters
+            if _pg():
+                hitpg = _snap.load_pagemap(target)
+                st.ledger_restored = hitpg[0] if hitpg is not None \
+                    else None
         return True
 
     @locked
@@ -354,6 +378,11 @@ class JaxDriver(LocalDriver):
             st.fmt_cache = {}
             st.match_engine = None
             st.sweep_cache = {}
+            # the ledger's row ids and generation guards are meaningless
+            # against the swapped table (its counters restart)
+            st.ledger = None
+            st.ledger_restored = None
+            st.dirtylog_overflows_seen = 0
             for kind in list(st.templates):
                 st.bump(kind)
 
@@ -810,6 +839,189 @@ class JaxDriver(LocalDriver):
                 "remap": table.remap_generation, "n_rows": table.n_rows,
                 "conver": self.con_version_of(st, kind), "limit": limit,
             }
+
+    def _pages_ineligible(self, st: JaxTargetState, kind: str,
+                          compiled: CompiledTemplate) -> str | None:
+        """None when the kind can serve from the VerdictLedger, else
+        the fallback reason.  Same gates as footprint selective reuse:
+        only a row-local template with no provider/inventory reads has
+        verdicts that per-page re-evaluation can maintain exactly."""
+        if compiled.vectorized is None:
+            return "scalar-pin"
+        fp = st.footprints.get(kind)
+        if fp is None:
+            return "no-footprint"
+        if not fp.row_local:
+            return "cross-row"
+        if fp.providers:
+            return "external-providers"
+        if compiled.uses_inventory:
+            return "inventory-read"
+        return None
+
+    def _paged_kind(self, st, target, handler, compiled, constraints,
+                    ordered_rows, row_order, kind, limit, tagged, rcache,
+                    pg, dirty_pages_out) -> None:
+        """Serve one kind from the VerdictLedger, first applying the
+        deltas for every page dirtied since the entry's generation.
+        Rows re-evaluate through the exact scalar path (match + oracle
+        + fmt memo), so the ledger holds exactly the confirmed
+        violating rows; capped output walks them in rank order —
+        bit-identical to the full path's top-k + refill emission."""
+        from gatekeeper_tpu.analysis.footprint import (MATCH_PATHS,
+                                                       paths_intersect)
+        from gatekeeper_tpu.enforce.ledger import (VerdictLedger,
+                                                   constraints_digest)
+        table = st.table
+        if st.ledger is None:
+            st.ledger = VerdictLedger(target)
+        led = st.ledger
+        ent = led.entry(kind)
+        conver = self.con_version_of(st, kind)
+        condigest = constraints_digest(constraints)
+        if ent.gen < 0 and st.ledger_restored:
+            # warm restart: adopt the snapshot's verdicts when the
+            # constraint set (by content) and row space still match the
+            # restored table — a hit means zero cold full builds
+            payload = st.ledger_restored.pop(kind, None)
+            if payload is not None and led.adopt(kind, payload, condigest,
+                                                 table, conver):
+                ent = led.entry(kind)
+        rebuild = None
+        if ent.gen < 0:
+            rebuild = "cold"
+        elif ent.conver != conver or ent.condigest != condigest:
+            rebuild = "constraints-changed"
+        elif ent.remap != table.remap_generation:
+            rebuild = "rows-remapped"
+        elif table.namespaces_dirty_since(ent.gen):
+            # namespace label edits shift namespaceSelector matching of
+            # OTHER rows — page locality doesn't hold
+            rebuild = "namespace-churn"
+        entries = None
+        if rebuild is None and table.generation != ent.gen:
+            entries = table.dirty_page_entries_since(ent.gen)
+            if entries is None:
+                # window predates the log or spans an overflow widen
+                # marker: degrade to full-kind for exactly this interval
+                pg["widen_fallbacks"] += 1
+                rebuild = "widen"
+        n_evals = 0
+        if rebuild is not None:
+            # full build: clear rows that died since (sorted — the
+            # canonical event order puts dead-row clears first), then
+            # every live row in rank order
+            for row in sorted(ent.rows):
+                if row >= table.n_rows or table.meta_at(row) is None:
+                    pg["events"] += len(led.set_row(kind, row, (), {}))
+            for row in ordered_rows:
+                n_evals += self._ledger_apply_row(
+                    st, target, handler, compiled, constraints, kind, led,
+                    rcache, row, pg)
+            ent.full_builds += 1
+            pg["full_builds"] += 1
+            pg["pages_evaluated"] += table.n_pages
+            pg["rows_reevaluated"] += len(ordered_rows)
+        elif entries:
+            fp = st.footprints[kind]
+            read = set(fp.object_paths()) | set(MATCH_PATHS)
+            kgen_changed = ent.kgen != table.key_generation
+            pages: set[int] = set()
+            for _g, paths, pgs in entries:
+                # page filtering by read-set intersection is only exact
+                # for pure replaces: a bulk entry mixing inserts (empty
+                # paths) with non-intersecting edits can't attribute
+                # pages, so key-set churn includes every touched page
+                if kgen_changed or not paths or any(
+                        paths_intersect(p, r) for p in paths
+                        for r in read):
+                    pages |= pgs
+            R = table.page_rows
+            n_rows = table.n_rows
+            rows_seen = 0
+            for p in sorted(pages):
+                start, end = p * R, (p + 1) * R
+                if start >= n_rows:
+                    continue    # stale page beyond the row space
+                pg["rows_padded"] += max(0, end - n_rows)
+                for row in range(start, min(end, n_rows)):
+                    n_evals += self._ledger_apply_row(
+                        st, target, handler, compiled, constraints, kind,
+                        led, rcache, row, pg)
+                    rows_seen += 1
+            dirty_pages_out |= pages
+            pg["pages_evaluated"] += len(pages)
+            pg["pages_skipped"] += max(0, table.n_pages - len(pages))
+            pg["rows_reevaluated"] += rows_seen
+            pg["evaluations_saved"] += \
+                max(0, len(ordered_rows) - rows_seen) * len(constraints)
+        else:
+            # generation unchanged (or every entry already applied):
+            # the ledger is current — pure serve
+            pg["pages_skipped"] += table.n_pages
+            pg["evaluations_saved"] += len(ordered_rows) * len(constraints)
+        ent.gen = table.generation
+        ent.kgen = table.key_generation
+        ent.remap = table.remap_generation
+        ent.n_rows = table.n_rows
+        ent.conver = conver
+        ent.condigest = condigest
+        self._ledger_serve(ent, constraints, row_order, kind, limit, tagged)
+
+    def _ledger_apply_row(self, st, target, handler, compiled, constraints,
+                          kind, led, rcache, row, pg) -> int:
+        """Re-evaluate one row against the kind's constraints through
+        the exact scalar path and replace its ledger verdicts, emitting
+        the delta events.  Returns evaluations performed."""
+        table = st.table
+        meta = table.meta_at(row)
+        if meta is None:
+            pg["events"] += len(led.set_row(kind, row, (), {}))
+            return 0
+        pair = self._row_review(st, handler, row, rcache)
+        if pair is None:
+            pg["events"] += len(led.set_row(kind, row, (), {}))
+            return 0
+        review, frozen, shared = pair
+        by_c: dict[str, list] = {}
+        n_evals = 0
+        for c in constraints:
+            if not any(True for _ in handler.matching_constraints(
+                    review, [c], table)):
+                continue
+            n_evals += 1
+            results = self._pair_results(st, target, kind, compiled, c,
+                                         row, review, frozen, None, shared)
+            if results:
+                by_c[(c.get("metadata") or {}).get("name", "")] = results
+        pg["events"] += len(led.set_row(kind, row,
+                                        (meta.namespace, meta.name), by_c))
+        return n_evals
+
+    def _ledger_serve(self, ent, constraints, row_order, kind, limit,
+                      tagged) -> None:
+        """Emit capped results from the ledger's confirmed rows.  Rank
+        order + whole-row emission with the cap checked at the top of
+        the loop reproduces _format_topk/_scalar_kind exactly (top-k by
+        rank plus full-mask refill IS "walk confirmed rows in rank
+        order until the result count reaches the cap")."""
+        for c in constraints:
+            cname = (c.get("metadata") or {}).get("name", "")
+            rows = [row for row, (_ident, by_c) in ent.rows.items()
+                    if cname in by_c and row in row_order]
+            rows.sort(key=row_order.__getitem__)
+            emitted = 0
+            for row in rows:
+                if limit is not None and emitted >= limit:
+                    break
+                results = ent.rows[row][1][cname]
+                for r in results:
+                    # fresh copies: downstream sets .resource and owns
+                    # result.metadata; the ledger's canon stays pristine
+                    tagged.append(((row_order[row], kind, cname),
+                                   dataclasses.replace(
+                                       r, metadata=dict(r.metadata))))
+                emitted += len(results)
 
     def _ensure_order(self, st):
         """Sorted-cache-key row order (matches the scalar driver) with
@@ -1479,6 +1691,21 @@ class JaxDriver(LocalDriver):
             fp_enabled = not self.scalar_only and _fp_mode() != "off"
             fp_skipped: list[str] = []
             fp_saved = 0
+            # continuous enforcement (enforce/): eligible kinds skip
+            # the per-kind device sweep entirely — only dirty pages ×
+            # affected constraints re-evaluate, and capped results are
+            # served from the VerdictLedger's confirmed violation set.
+            # GATEKEEPER_PAGES=off is the bit-identical oracle (the
+            # legacy path below, including footprint selective reuse).
+            from gatekeeper_tpu.enforce.ledger import pages_mode as _pg_mode
+            pg_on = _pg_mode()
+            pg_kinds: list[str] = []
+            pg_fallback: dict[str, str] = {}
+            pg_stats = {"pages_evaluated": 0, "pages_skipped": 0,
+                        "rows_padded": 0, "rows_reevaluated": 0,
+                        "evaluations_saved": 0, "widen_fallbacks": 0,
+                        "full_builds": 0, "events": 0}
+            pg_dirty_pages: set[int] = set()
             # what-if twin sharing (whatif/shadow.py): when shadow
             # kinds are staged, an unchanged twin aliases the live
             # kind's dispatch instead of re-running it on device.
@@ -1532,6 +1759,23 @@ class JaxDriver(LocalDriver):
                         constraints = self._kind_constraints(st, kind)
                         if not constraints:
                             continue
+                        if pg_on and not full and trace is None:
+                            reason = self._pages_ineligible(st, kind,
+                                                            compiled)
+                            if reason is None:
+                                # no device dispatch: the paged serve
+                                # runs in phase 2 on the sweep thread
+                                # (futures=None kinds format first, in
+                                # sorted-kind order — deterministic
+                                # ledger event order)
+                                spec = ("pages", kind, compiled,
+                                        constraints, None, None, None)
+                                _prep_done(kind, _tk)
+                                futures.append(None)
+                                specs.append(spec)
+                                pg_kinds.append(kind)
+                                continue
+                            pg_fallback[kind] = reason
                         if fp_enabled and not full and trace is None:
                             reuse = self._selective_reuse(
                                 st, kind, compiled, constraints, limit)
@@ -1685,7 +1929,13 @@ class JaxDriver(LocalDriver):
                         payload = handle.get()
                         handle = _ResolvedHandle(payload)
                     try:
-                        if mode == "topk":
+                        if mode == "pages":
+                            self._paged_kind(st, target, handler, compiled,
+                                             constraints, ordered_rows,
+                                             row_order, kind, limit, tagged,
+                                             rcache, pg_stats,
+                                             pg_dirty_pages)
+                        elif mode == "topk":
                             self._format_topk(st, target, handler, compiled,
                                               constraints, prog, bindings,
                                               mask, rank, row_order, kind,
@@ -1869,6 +2119,39 @@ class JaxDriver(LocalDriver):
                 "per_shard_evals": int(sp_evals),
                 "collectives": int(sp_collectives),
             }
+            # continuous-enforcement stanza (both sweep shapes): which
+            # kinds served from the ledger vs fell back (with reasons),
+            # page-level work accounting, and the delta events emitted
+            _led = st.ledger if isinstance(st, JaxTargetState) else None
+            self.last_sweep_phases["pages"] = {
+                "enabled": pg_on,
+                "page_rows": st.table.page_rows,
+                "n_pages": st.table.n_pages,
+                "kinds_paged": len(pg_kinds),
+                "kinds_fallback": len(pg_fallback),
+                "fallback_reasons": dict(pg_fallback),
+                "pages_evaluated": int(pg_stats["pages_evaluated"]),
+                "pages_skipped": int(pg_stats["pages_skipped"]),
+                "rows_padded": int(pg_stats["rows_padded"]),
+                "rows_reevaluated": int(pg_stats["rows_reevaluated"]),
+                "evaluations_saved": int(pg_stats["evaluations_saved"]),
+                "widen_fallbacks": int(pg_stats["widen_fallbacks"]),
+                "ledger_full_builds": int(pg_stats["full_builds"]),
+                "ledger_violations": _led.total_violations()
+                if _led is not None else 0,
+                "events": int(pg_stats["events"]),
+            }
+            m.gauge("store_pages_total").set(float(st.table.n_pages))
+            if pg_kinds:
+                m.gauge("store_pages_dirty").set(float(len(pg_dirty_pages)))
+            if _led is not None:
+                m.gauge("ledger_violations").set(
+                    float(_led.total_violations()))
+            _ov = st.table.dirtylog_overflows
+            if _ov > st.dirtylog_overflows_seen:
+                m.counter("store_dirtylog_overflow_total").inc(
+                    _ov - st.dirtylog_overflows_seen)
+                st.dirtylog_overflows_seen = _ov
             if _sweep_sp is not None:
                 _sweep_sp.args["results"] = len(tagged)
             from gatekeeper_tpu.obs.flightrecorder import \

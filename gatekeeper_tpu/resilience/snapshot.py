@@ -70,7 +70,8 @@ class SnapshotStats:
                "store_hits", "store_misses",
                "cert_hits", "cert_misses",
                "fp_hits", "fp_misses",
-               "sp_hits", "sp_misses", "corrupt_discarded",
+               "sp_hits", "sp_misses",
+               "pg_hits", "pg_misses", "corrupt_discarded",
                "saves", "save_errors")
 
     def __init__(self):
@@ -457,6 +458,30 @@ def save_store(target: str, state) -> bool:
     return _write_entry("store", f"store:{target}", payload)
 
 
+def load_pagemap(target: str, root: str | None = None):
+    """Load the pagemap tier: the VerdictLedger's per-kind confirmed
+    violation sets, saved alongside the store tier so a warm restart
+    adopts its verdicts (revalidated per kind by constraint digest +
+    row count) instead of paying a cold full build."""
+    if root is None and not enabled():
+        return None
+    got = _read_entry("pg", f"pg:{target}", root=root)
+    stats.bump("pg_hits" if got is not None else "pg_misses")
+    return got
+
+
+def save_pagemap(target: str, payload_obj) -> bool:
+    if not enabled():
+        return False
+    try:
+        payload = dumps(payload_obj)
+    except Exception as e:   # noqa: BLE001
+        stats.bump("save_errors")
+        _log.warning("pagemap snapshot failed to serialize", error=e)
+        return False
+    return _write_entry("pg", f"pg:{target}", payload)
+
+
 # ----------------------------------------------------------------------
 # the combined restart counter (the keying-bug fix)
 
@@ -466,10 +491,12 @@ def tier_counts(s: dict) -> tuple[int, int]:
     deltas)."""
     hits = (s["ir_hits"] + s["mod_hits"] + s["plan_hits"]
             + s["store_hits"] + s.get("cert_hits", 0)
-            + s.get("fp_hits", 0) + s.get("sp_hits", 0))
+            + s.get("fp_hits", 0) + s.get("sp_hits", 0)
+            + s.get("pg_hits", 0))
     misses = (s["ir_misses"] + s["mod_misses"] + s["plan_misses"]
               + s["store_misses"] + s.get("cert_misses", 0)
-              + s.get("fp_misses", 0) + s.get("sp_misses", 0))
+              + s.get("fp_misses", 0) + s.get("sp_misses", 0)
+              + s.get("pg_misses", 0))
     return hits, misses
 
 

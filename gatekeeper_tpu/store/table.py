@@ -26,6 +26,22 @@ DELTA_MAX_FRAC = 0.125
 PATH_LOG_CAP = 4096
 """Dirty-path log entries kept; older windows degrade to "unknown"."""
 
+DEFAULT_PAGE_ROWS = 32
+"""Rows per fixed-size page (GATEKEEPER_PAGE_ROWS overrides).  Pages
+are the dirty-tracking granule for the paged sweep: a watch event
+dirties exactly one page, so at 0.1% churn the paged sweep touches
+~page_rows/1000 of the table."""
+
+
+def page_rows_env() -> int:
+    """Page geometry from GATEKEEPER_PAGE_ROWS (min 1)."""
+    import os
+    try:
+        return max(1, int(os.environ.get("GATEKEEPER_PAGE_ROWS",
+                                         DEFAULT_PAGE_ROWS)))
+    except ValueError:
+        return DEFAULT_PAGE_ROWS
+
 PATH_DIFF_DEPTH = 6
 """Replace-diff recursion depth; deeper changes report the subtree."""
 
@@ -114,13 +130,22 @@ class ResourceTable:
         self._elem_cache: dict[tuple, tuple] = {}   # base -> (gen, counts, cols)
         self._identity_cache: tuple[int, int, IdentityColumns] | None = None
         self._ns_items_cache: tuple[int, dict] | None = None
-        # dirty COLUMN paths per write generation (replace-upserts
-        # only — inserts/removes bump key_generation, which every
-        # selective consumer guards on).  Feeds dirty_paths_since, the
-        # watch-delta side of footprint-driven selective invalidation.
-        self._path_log: list[tuple[int, frozenset]] = []
+        # dirty COLUMN paths + dirty PAGES per write generation.
+        # Replace-upserts log the changed column paths; inserts/removes
+        # log an empty path set (they bump key_generation, which every
+        # path consumer guards on) but DO log their page — the paged
+        # sweep needs delete/insert locality too.  Entries are
+        # (generation, frozenset(paths) | None, frozenset(pages)); a
+        # ``paths=None`` entry is a generation-stamped "widen" marker
+        # left behind when the cap trips — windows spanning it degrade
+        # to "unknown" (full re-sweep) for exactly that interval
+        # instead of silently forever-after.
+        self._path_log: list[tuple[int, frozenset | None, frozenset]] = []
         self._path_floor = 0          # windows starting below: unknown
         self._pending_paths: set[tuple] = set()
+        self._pending_pages: set[int] = set()
+        self.page_rows = page_rows_env()
+        self.dirtylog_overflows = 0   # widen markers recorded (ever)
 
     # ------------------------------------------------------------------
 
@@ -130,6 +155,15 @@ class ResourceTable:
     @property
     def n_rows(self) -> int:
         return len(self._objs)
+
+    @property
+    def n_pages(self) -> int:
+        """Fixed-size page count covering the row space; the tail page
+        is padded (its trailing slots map past n_rows)."""
+        return -(-len(self._objs) // self.page_rows)
+
+    def page_of(self, row: int) -> int:
+        return row // self.page_rows
 
     def _ensure_ver(self, n: int) -> None:
         if len(self._ver) < n:
@@ -167,6 +201,7 @@ class ResourceTable:
                 self._pending_paths |= _diff_paths(old_obj, obj)
             self._objs[row] = obj
             self._metas[row] = meta
+        self._pending_pages.add(row // self.page_rows)
         if meta.kind == "Namespace" and meta.api_version == "v1":
             self._ns_rows.add(row)
             self._ns_touched = True
@@ -176,14 +211,25 @@ class ResourceTable:
         return row
 
     def _flush_paths(self) -> None:
-        if self._pending_paths:
+        if self._pending_paths or self._pending_pages:
             self._path_log.append((self.generation,
-                                   frozenset(self._pending_paths)))
+                                   frozenset(self._pending_paths),
+                                   frozenset(self._pending_pages)))
             self._pending_paths = set()
+            self._pending_pages = set()
             if len(self._path_log) > PATH_LOG_CAP:
+                # Cap trip: drop the older half, but leave a widen
+                # marker (paths=None) stamped with the last dropped
+                # generation.  Windows that span the marker degrade to
+                # "unknown" — the paged sweep falls back to full-kind
+                # for exactly the overflowed interval, counted via
+                # store_dirtylog_overflow_total — instead of the old
+                # behavior of moving the floor (unknown forever after).
                 drop = len(self._path_log) // 2
-                self._path_floor = self._path_log[drop - 1][0]
+                widen_gen = self._path_log[drop - 1][0]
                 del self._path_log[:drop]
+                self._path_log.insert(0, (widen_gen, None, frozenset()))
+                self.dirtylog_overflows += 1
 
     def upsert(self, key: str, obj: dict, meta: ResourceMeta) -> int:
         row = self._place(key, obj, meta)
@@ -213,12 +259,14 @@ class ResourceTable:
         self._objs[row] = None
         self._metas[row] = None
         self._free.append(row)
+        self._pending_pages.add(row // self.page_rows)
         if row in self._ns_rows:
             self._ns_rows.discard(row)
             self.ns_generation = self.generation + 1
         self.generation += 1
         self.key_generation += 1
         self._ver[row] = self.generation
+        self._flush_paths()
         if len(self._free) > 64 and len(self._free) > len(self._rows):
             self.compact()
         return True
@@ -236,6 +284,7 @@ class ResourceTable:
         self._ns_items_cache = None
         self._path_log.clear()
         self._pending_paths.clear()
+        self._pending_pages.clear()
         self.generation += 1
         self.remap_generation += 1
         self.key_generation += 1
@@ -253,6 +302,7 @@ class ResourceTable:
         self._free = []
         self._path_log.clear()
         self._pending_paths.clear()
+        self._pending_pages.clear()
         self.generation += 1
         self.remap_generation += 1
         self.key_generation += 1
@@ -318,17 +368,53 @@ class ResourceTable:
 
     def dirty_paths_since(self, gen: int) -> frozenset | None:
         """Union of column paths changed by replace-upserts after
-        generation ``gen``, or None when the window predates the log
-        (caller must assume everything changed).  Inserts and removes
-        are NOT logged — they bump ``key_generation``, which selective
-        consumers must guard on separately."""
+        generation ``gen``, or None when the window predates the log or
+        spans a cap-overflow widen marker (caller must assume
+        everything changed — for a widen, exactly the overflowed
+        interval).  Inserts and removes log empty path sets — they bump
+        ``key_generation``, which selective consumers must guard on
+        separately."""
         if gen < self._path_floor:
             return None
         out: set = set()
-        for g, paths in reversed(self._path_log):
+        for g, paths, _pages in reversed(self._path_log):
             if g <= gen:
                 break
+            if paths is None:       # widen marker inside the window
+                return None
             out |= paths
+        return frozenset(out)
+
+    def dirty_page_entries_since(self, gen: int) \
+            -> list[tuple[int, frozenset, frozenset]] | None:
+        """Log entries newer than generation ``gen`` in write order —
+        each ``(generation, paths, pages)`` — or None when the window
+        predates the log or spans a widen marker.  Watch events are
+        one-row-per-entry, so a consumer can intersect each entry's
+        paths with a kind's read-set and collect only the pages whose
+        changes that kind can observe."""
+        if gen < self._path_floor:
+            return None
+        newer: list = []
+        for g, paths, pages in reversed(self._path_log):
+            if g <= gen:
+                break
+            if paths is None:       # widen marker inside the window
+                return None
+            newer.append((g, paths, pages))
+        newer.reverse()
+        return newer
+
+    def dirty_pages_since(self, gen: int) -> frozenset | None:
+        """Union of pages touched after generation ``gen`` (upserts,
+        inserts AND removes), or None on floor/widen — see
+        ``dirty_page_entries_since``."""
+        entries = self.dirty_page_entries_since(gen)
+        if entries is None:
+            return None
+        out: set = set()
+        for _g, _paths, pages in entries:
+            out |= pages
         return frozenset(out)
 
     # ------------------------------------------------------------------

@@ -156,6 +156,20 @@ class WebhookServer:
                     self.end_headers()
                     self.wfile.write(payload)
                     return
+                if self.path.split("?", 1)[0] == "/debug/violations":
+                    # the continuously-true violation set + the recent
+                    # delta event stream (enforce/ledger.py): one JSON
+                    # document per live VerdictLedger
+                    import json as _json
+                    from gatekeeper_tpu.enforce.ledger import export_all
+                    payload = _json.dumps(
+                        export_all(), default=str).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(payload)))
+                    self.end_headers()
+                    self.wfile.write(payload)
+                    return
                 if self.path.split("?", 1)[0] == "/debug/trace":
                     # Chrome trace-event JSON of the tracer's span ring
                     # — load in Perfetto / chrome://tracing

@@ -128,11 +128,12 @@ def test_full_sweep_device_path_parity_and_phases(monkeypatch):
     assert "full_sweep_overlap_fraction" in snap
 
     # a plain (memoized) sweep records no phase breakdown — only the
-    # full flag and the Stage-5/-6 selective-invalidation and
-    # plan-driven-sharding stanzas
+    # full flag and the Stage-5/-6 selective-invalidation,
+    # plan-driven-sharding, and continuous-enforcement stanzas
     c.audit()
     assert jd.last_sweep_phases["full"] is False
-    assert set(jd.last_sweep_phases) <= {"full", "footprint", "shard"}
+    assert set(jd.last_sweep_phases) <= {"full", "footprint", "shard",
+                                         "pages"}
 
     # oracle parity for the same workload
     ld = LocalDriver()

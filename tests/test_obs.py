@@ -311,7 +311,8 @@ class TestAttribution:
         # Stage-5/-6 selective-invalidation and sharding stanzas)
         _audit(jd, full=False)
         assert jd.last_sweep_phases["full"] is False
-        assert set(jd.last_sweep_phases) <= {"full", "footprint", "shard"}
+        assert set(jd.last_sweep_phases) <= {"full", "footprint", "shard",
+                                             "pages"}
 
 
 # ----------------------------------------------------------------------
