@@ -210,6 +210,11 @@ def _slim_headline() -> dict:
                                ("parity", "rows_frac",
                                 "evaluations_saved")
                                if pc.get(k) is not None}
+    wl = DETAIL.get("watch_latency")
+    if isinstance(wl, dict):
+        slim["watch_latency"] = {k: wl.get(k) for k in
+                                 ("parity", "p50_ms", "p99_ms")
+                                 if wl.get(k) is not None}
     tv = DETAIL.get("transval")
     if isinstance(tv, dict):
         slim["transval"] = {k: tv.get(k) for k in
@@ -1386,6 +1391,132 @@ def bench_paged_churn(detail):
     detail["paged_churn"] = out
 
 
+def bench_watch_latency(detail):
+    """Event→verdict latency of the continuous-enforcement reactor: a
+    FakeCluster mutation flows watch event → page-granular re-eval →
+    ledger delta inside one pump, timed per event (p50/p99), against
+    the wall a fixed-interval auditor would pay — one full pages-off
+    oracle sweep over the same final state.  The live verdicts after
+    the whole event stream must be bit-identical to that oracle; the
+    parity digest rides the headline and is gated in ci.sh."""
+    import copy
+    from gatekeeper_tpu.cluster.fake import FakeCluster, gvk_of
+    from gatekeeper_tpu.enforce.reactor import Reactor
+    from gatekeeper_tpu.engine import jax_driver as jd_mod
+
+    n = sized(BASELINE_N, 300, 800)
+    n_events = sized(100, 40, 60)
+    log(f"[watch-latency] n={n}, {n_events} events, reactor vs sweep")
+    rng = random.Random(29)
+    resources = make_mixed(rng, n)
+    opts = QueryOpts(limit_per_constraint=CAP)
+    full_opts = QueryOpts(limit_per_constraint=CAP, full=True)
+
+    def mk_client():
+        jd = JaxDriver()
+        c = Backend(jd).new_client([K8sValidationTarget()])
+        for tdoc, cdoc in all_docs():
+            c.add_template(tdoc)
+            c.add_constraint(cdoc)
+        return jd, c
+
+    def verdicts_of(results):
+        return sorted(
+            ((r.constraint or {}).get("kind", ""),
+             ((r.constraint or {}).get("metadata") or {}).get("name", ""),
+             (((r.resource or {}).get("metadata") or {}).get("name")
+              or (r.review or {}).get("name", "")),
+             r.msg) for r in results)
+
+    prev_pg = os.environ.get("GATEKEEPER_PAGES")
+    os.environ["GATEKEEPER_PAGES"] = "on"
+    saved = jd_mod.SMALL_WORKLOAD_EVALS
+    try:
+        if not FALLBACK:
+            jd_mod.SMALL_WORKLOAD_EVALS = 0
+        cluster = FakeCluster()
+        for o in resources:
+            cluster.create(copy.deepcopy(o))
+        gvks = sorted({gvk_of(o) for o in resources},
+                      key=lambda g: g.kind)
+        jd, c = mk_client()
+        c.add_data_batch(
+            copy.deepcopy([o for g in gvks for o in cluster.list(g)]))
+        rx = Reactor(c, cluster=cluster, apply_objects=True, seed=29)
+        for g in gvks:
+            rx.attach(g)
+        jd.query_audit(TARGET_NAME, full_opts)      # compile warm
+        jd.query_audit(TARGET_NAME, opts)           # ledger built
+        churn_rng = random.Random(31)
+        pods = [o for o in resources
+                if (o.get("spec") or {}).get("containers")]
+        lat = []
+        for j in range(n_events):
+            src = churn_rng.choice(pods) if j % 2 else \
+                churn_rng.choice(resources)
+            cur = cluster.get(gvk_of(src), src["metadata"]["name"],
+                              src["metadata"].get("namespace"))
+            o = copy.deepcopy(cur)
+            if j % 2 and (o.get("spec") or {}).get("containers"):
+                # verdict-flipping edit inside the image read-sets
+                for cont in o["spec"]["containers"]:
+                    cont["image"] = f"evil.io/watch:{j}"
+            else:
+                o.setdefault("metadata", {}).setdefault(
+                    "labels", {})["bench-watch"] = f"r{j}"
+            t0 = time.perf_counter()
+            cluster.update(o)
+            rx.pump()                   # event → page re-eval → delta
+            lat.append(time.perf_counter() - t0)
+        assert rx.counters["events"] >= n_events
+        live = verdicts_of(jd.query_audit(TARGET_NAME, opts)[0])
+        # the fixed-interval baseline: one full pages-off sweep over
+        # the same final cluster state (what every audit tick costs
+        # when there is no event→page coupling)
+        jdo, co = mk_client()
+        co.add_data_batch(
+            copy.deepcopy([o for g in gvks for o in cluster.list(g)]))
+        os.environ["GATEKEEPER_PAGES"] = "off"
+        try:
+            jdo.query_audit(TARGET_NAME, full_opts)     # compile warm
+            t0 = time.perf_counter()
+            oracle = verdicts_of(jdo.query_audit(TARGET_NAME, opts)[0])
+            sweep_s = time.perf_counter() - t0
+        finally:
+            os.environ["GATEKEEPER_PAGES"] = "on"
+        parity = live == oracle
+        digest = hashlib.sha256(repr(live).encode()).hexdigest()[:16]
+        lat.sort()
+        p50 = lat[len(lat) // 2]
+        p99 = lat[min(len(lat) - 1, int(len(lat) * 0.99))]
+        out = {
+            "n_resources": n,
+            "events": len(lat),
+            "p50_ms": round(p50 * 1e3, 3),
+            "p99_ms": round(p99 * 1e3, 3),
+            "sweep_oracle_ms": round(sweep_s * 1e3, 3),
+            "p50_vs_sweep_ratio": round(p50 / sweep_s, 4)
+            if sweep_s else None,
+            "coalesced_pages": rx.counters.get("coalesced_pages", 0),
+            "parity": parity,
+            "parity_digest": digest,
+        }
+        log(f"[watch-latency] p50={p50*1e3:.2f}ms p99={p99*1e3:.2f}ms "
+            f"vs sweep {sweep_s*1e3:.0f}ms | events={len(lat)} | "
+            f"parity={parity} digest={digest}")
+        if not parity:
+            raise AssertionError(
+                f"watch-latency verdict mismatch: live={len(live)} "
+                f"oracle={len(oracle)}")
+        detail["watch_latency"] = out
+    finally:
+        jd_mod.SMALL_WORKLOAD_EVALS = saved
+        if prev_pg is None:
+            os.environ.pop("GATEKEEPER_PAGES", None)
+        else:
+            os.environ["GATEKEEPER_PAGES"] = prev_pg
+
+
 _SHARD_SIM_CHILD = r"""
 import copy, hashlib, json, os, random, sys, time
 os.environ["JAX_PLATFORMS"] = "cpu"
@@ -2317,6 +2448,8 @@ def main():
     run_phase("churn_selective", bench_churn_selective, 300)
     quiesce_upgrades()
     run_phase("paged_churn", bench_paged_churn, 420)
+
+    run_phase("watch_latency", bench_watch_latency, 300)
     quiesce_upgrades()
     run_phase("transval", bench_transval, 240)
     quiesce_upgrades()

@@ -21,8 +21,10 @@ python -m gatekeeper_tpu.analysis.selflint gatekeeper_tpu/engine gatekeeper_tpu/
 python -m gatekeeper_tpu.analysis.selflint --locks gatekeeper_tpu/watch gatekeeper_tpu/controllers gatekeeper_tpu/externaldata
 # lock-order self-lint: the lock-acquisition graph (lexical nesting +
 # calls made while holding a lock) must stay acyclic, or two threads
-# taking the same pair in opposite order can deadlock
-python -m gatekeeper_tpu.analysis.selflint --lockorder gatekeeper_tpu/engine gatekeeper_tpu/watch gatekeeper_tpu/externaldata
+# taking the same pair in opposite order can deadlock; enforce/ brings
+# the reactor's _rx_lock into the graph (client → driver → reactor
+# must stay one-directional)
+python -m gatekeeper_tpu.analysis.selflint --lockorder gatekeeper_tpu/engine gatekeeper_tpu/watch gatekeeper_tpu/externaldata gatekeeper_tpu/enforce
 # rebind-only self-lint: Bindings.arrays / base_dirty are shared with
 # the sweep cache and in-flight futures — engine code must rebind a
 # fresh dict, never mutate in place
@@ -183,15 +185,20 @@ EOF
 
 echo "== chaos (seeded 30s soak, admission + audit under faults) =="
 # Seeded schedule-driven chaos soak (resilience/chaos.py): sustained
-# concurrent admission + audit load while probe_hang / device_lost /
-# snapshot_corrupt / slow_provider / queue_storm fire on a schedule
-# that is a pure function of the seed.  Invariants: no deadlock, deny
-# verdicts bit-identical to the scalar oracle or explicitly rejected
-# (never silently admitted), p99 bounded, queue depth <= its bound,
-# supervisor recovers + re-jits.  rc=1 is the warning tier (e.g. a
-# quiet run where brownout never engaged); rc=2 (any invariant
-# violation) fails the build.  The last line is the headline — grep it
-# from the trailing window like the bench gate does.
+# concurrent admission + audit + watch-churn load with
+# GATEKEEPER_PAGES=on while probe_hang / device_lost /
+# snapshot_corrupt / slow_provider / queue_storm and the watch-class
+# faults (watch_stall / watch_gap / watch_duplicate / watch_reorder /
+# watch_flood) fire on a schedule that is a pure function of the seed.
+# Invariants: no deadlock, deny verdicts bit-identical to the scalar
+# oracle or explicitly rejected (never silently admitted), p99
+# bounded, queue depth <= its bound, supervisor recovers + re-jits,
+# the ledger delta stream stays exact (mirror == state == pages-off
+# oracle at every checkpoint), forced resyncs emit zero phantom
+# events, and the reactor returns to live.  rc=1 is the warning tier
+# (e.g. a quiet run where brownout never engaged); rc=2 (any
+# invariant violation) fails the build.  The last line is the
+# headline — grep it from the trailing window like the bench gate.
 CH_RC=0
 CH=$(JAX_PLATFORMS=cpu GATEKEEPER_SUPERVISOR_BACKOFF_S=0.5 \
      timeout -k 10 300 \
@@ -204,6 +211,10 @@ echo "$CH" | grep -q " 0 invariant violation(s)" \
   || { echo "chaos soak reported invariant violations" >&2; exit 1; }
 echo "$CH" | grep -Eq "completed=[1-9][0-9]*" \
   || { echo "chaos soak completed no admissions" >&2; exit 1; }
+echo "$CH" | grep -Eq "watch_ev=[1-9][0-9]*" \
+  || { echo "chaos soak delivered no watch events" >&2; exit 1; }
+echo "$CH" | grep -Eq "ledger_checks=[1-9][0-9]*" \
+  || { echo "chaos soak ran no ledger checkpoints" >&2; exit 1; }
 
 echo "== bench smoke (quick shapes) =="
 GATEKEEPER_BENCH_QUICK=1 GATEKEEPER_BENCH_N=20000 python bench.py > /tmp/bench.json
@@ -264,6 +275,13 @@ assert isinstance(pc, dict) and pc.get("parity") is True \
     and pc.get("rows_frac", 1) < 0.05 \
     and pc.get("evaluations_saved", 0) > 0, \
     f"no paged_churn row (with oracle parity + O(dirty)) in: {d}"
+# the watch_latency row must survive the window: every reactor event →
+# page re-eval → ledger delta must land with verdicts bit-identical
+# to the pages-off full-sweep oracle over the same cluster state
+wl = d.get("watch_latency")
+assert isinstance(wl, dict) and wl.get("parity") is True \
+    and wl.get("p50_ms", 0) > 0 and wl.get("p99_ms", 0) > 0, \
+    f"no watch_latency row (with oracle parity) in the headline: {d}"
 # the shard_sim row must survive the window: the plan-driven 2/4-shard
 # simulated-mesh sweep must be bit-identical to the unsharded oracle
 sh = d.get("shard_sim")

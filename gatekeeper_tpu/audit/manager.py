@@ -82,6 +82,7 @@ class AuditManager:
         self._ledger = None
         self._ledger_clean: set[tuple[str, str]] = set()
         self._ledger_lock = threading.Lock()
+        self._reactor = None
 
     # ------------------------------------------------------------------
     # continuous enforcement subscription
@@ -103,6 +104,13 @@ class AuditManager:
         with self._ledger_lock:
             self._ledger_clean.discard((ev.get("kind", ""),
                                         ev.get("constraint", "")))
+
+    def attach_reactor(self, reactor) -> None:
+        """Let the event reactor (enforce/reactor.py) observe sweep
+        completions: while its watch stream is degraded the periodic
+        sweep is the enforcement freshness bound, and the reactor's
+        health payload reports the age of the last one."""
+        self._reactor = reactor
 
     # ------------------------------------------------------------------
     # one sweep
@@ -129,6 +137,8 @@ class AuditManager:
             self.metrics.counter("audit_violations").inc(report["violations"])
             self.metrics.timer("audit_sweep_seconds").observe(
                 report["total_seconds"])
+            if self._reactor is not None:
+                self._reactor.note_sweep()
         self.last_sweep = report
         if report["skipped"]:
             _log.debug("audit skipped: template CRD not deployed")

@@ -414,6 +414,70 @@ class Client:
             responses.handled[name] = True
         return responses
 
+    # ------------------------------------------------------------------
+    # continuous enforcement (enforce/reactor.py rides these)
+
+    def react(self, kind: str | None = None) -> dict | None:
+        """Fold the store's dirty pages for one resource ``kind`` (or
+        all kinds when None) into the verdict ledger — the reactor's
+        rung 1: a single-object event becomes a single-page re-eval
+        with no sweep in between.  Reader lock, like audit: the table
+        is not mutated, the ledger has its own lock.  No-op (None) on
+        drivers without the paged surface or with pages off."""
+        fn = getattr(self.driver, "react_kind", None)
+        if fn is None:
+            return None
+        with self._lock.read():
+            out: dict | None = None
+            for name in self.targets:
+                r = fn(name, kind)
+                if r is not None:
+                    out = r if out is None else {
+                        k: out.get(k, 0) + v for k, v in r.items()}
+            return out
+
+    def resync(self, kind: str | None = None) -> dict | None:
+        """Force a whole-kind diff re-apply against the existing ledger
+        entry (rungs 2/3): the entry is marked cold but keeps its rows,
+        so the rebuild emits exactly the true appear/clear diff — a
+        clean resync is event-free, never a phantom storm."""
+        fn = getattr(self.driver, "resync_kind", None)
+        if fn is None:
+            return None
+        with self._lock.read():
+            out: dict | None = None
+            for name in self.targets:
+                r = fn(name, kind)
+                if r is not None:
+                    out = r if out is None else {
+                        k: out.get(k, 0) + v for k, v in r.items()}
+            return out
+
+    def sync_kind(self, api_version: str, kind: str, objs: list) -> int:
+        """Replace the store's residents of one (apiVersion, kind) with
+        ``objs`` — the relist half of a rung-2 resync.  Listed objects
+        are upserted; residents absent from the list are removed.
+        Returns the number of stale residents deleted."""
+        removed = 0
+        with self._lock.write():
+            residents = getattr(self.driver, "kind_residents", None)
+            for name, handler in self.targets.items():
+                live_keys = set()
+                for obj in objs:
+                    try:
+                        key, meta, doc = handler.process_data(obj)
+                    except UnhandledData:
+                        continue
+                    live_keys.add(key)
+                    self.driver.put_data(name, key, meta, doc)
+                if residents is None:
+                    continue
+                for key in residents(name, api_version, kind):
+                    if key not in live_keys:
+                        self.driver.delete_data(name, key)
+                        removed += 1
+        return removed
+
     def reset(self) -> None:
         with self._lock.write():
             for kind, targets in list(self.templates.items()):

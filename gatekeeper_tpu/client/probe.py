@@ -1114,6 +1114,18 @@ def run_health() -> int:
         "restart_persistent_cache_misses":
             rep["restart_persistent_cache_misses"],
     }
+    # watch-driven enforcement posture (enforce/reactor.py): the state
+    # machine (live/degraded/resyncing) per live reactor.  Informational
+    # only — a degraded watch falls back to sweep cadence, still
+    # serving correct verdicts, so it does not change the exit code.
+    from gatekeeper_tpu.enforce.reactor import export_state
+    reactors = export_state()
+    if reactors:
+        out["reactors"] = [
+            {"name": r["name"], "state": r["state"],
+             "state_age_s": r["state_age_s"],
+             "last_sweep_age_s": r.get("last_sweep_age_s")}
+            for r in reactors]
     print(json.dumps(out))
     if st["state"] != HEALTHY:
         print(f"HEALTH FAIL ({st['state']}: {st['reason']})")

@@ -152,6 +152,18 @@ class Manager:
                                   interval=args.audit_interval,
                                   violations_limit=args.constraint_violations_limit,
                                   metrics=self.metrics)
+        # continuous enforcement (pages on): couple the watch stream to
+        # the paged store so a single-object event becomes a single-page
+        # re-eval, with the periodic sweep as degraded-mode fallback.
+        # The sync controllers own store writes; the reactor only
+        # schedules re-evaluation (apply_objects stays False).
+        from gatekeeper_tpu.enforce.ledger import pages_mode
+        self.reactor = None
+        if pages_mode():
+            from gatekeeper_tpu.enforce.reactor import Reactor
+            self.reactor = Reactor(self.client, cluster=self.cluster,
+                                   metrics=self.metrics)
+            self.audit.attach_reactor(self.reactor)
         self.watch_poll_interval = getattr(args, "watch_poll_interval", 5.0)
         self._poll_stop = None
         self._poll_thread = None
@@ -171,6 +183,10 @@ class Manager:
                 except Exception as e:
                     _log.error("webhook bootstrap failed", error=e)
         self.audit.start()
+        if self.reactor is not None:
+            self.reactor.sync_subscriptions(
+                self.plane.watch_manager.watched_gvks())
+            self.reactor.start()
         # roster poll loop (reference updateManagerLoop, 5 s —
         # watch/manager.go:165-178): a GVK whose CRD becomes served
         # AFTER registration is picked up without any roster mutation
@@ -180,6 +196,12 @@ class Manager:
             while not self._poll_stop.wait(self.watch_poll_interval):
                 try:
                     self.plane.watch_manager.poll_once()
+                    if self.reactor is not None:
+                        # the reactor's subscriptions track the watch
+                        # roster: a kind gaining/losing sync intent
+                        # attaches/detaches its stream
+                        self.reactor.sync_subscriptions(
+                            self.plane.watch_manager.watched_gvks())
                 except Exception as e:   # log-and-continue like the loop
                     _log.warning("watch poll error", error=e)
         self._poll_thread = threading.Thread(
@@ -191,6 +213,8 @@ class Manager:
             self._poll_stop.set()
             self._poll_thread.join(timeout=10)
             self._poll_stop = None
+        if self.reactor is not None:
+            self.reactor.stop()
         self.audit.stop()
         if self.webhook is not None:
             self.webhook.stop()

@@ -38,12 +38,16 @@ at emit time — the ring is a debugging window, not the stream)."""
 
 
 def pages_mode() -> bool:
-    """GATEKEEPER_PAGES: ``on``/``1``/``true`` enables the paged sweep.
-    Default off — the legacy full-kind path (with PR-10 footprint
-    selective invalidation) stays the serving default until the paged
-    path has soaked at production watch rates (ROADMAP item 2)."""
+    """GATEKEEPER_PAGES: the paged O(dirty) sweep + VerdictLedger.
+    Default ON as of the reactor PR — the path has soaked under the
+    chaos harness with watch-class faults injected (gap, duplicate,
+    reorder, stall, flood) with the ledger stream bit-identical to the
+    full-sweep diff throughout (ROADMAP item 2 graduation).  ``off``
+    selects the legacy full-kind path (with PR-10 footprint selective
+    invalidation) — still maintained as the shipping oracle every
+    parity gate diffs against."""
     import os
-    return os.environ.get("GATEKEEPER_PAGES", "off").lower() in (
+    return os.environ.get("GATEKEEPER_PAGES", "on").lower() in (
         "on", "1", "true")
 
 
@@ -72,6 +76,10 @@ class LedgerEntry:
     rows: dict[int, tuple[tuple, dict[str, list]]] = \
         dataclasses.field(default_factory=dict)
     full_builds: int = 0          # cold/fallback rebuilds of this entry
+    rv: int = 0                   # watch resourceVersion watermark the
+    #                               entry was built/adopted at (stamped
+    #                               at snapshot save; guards the pg
+    #                               tier against stale watch state)
 
     def size(self) -> int:
         return sum(len(rs) for _ident, by_c in self.rows.values()
@@ -209,6 +217,7 @@ class VerdictLedger:
         for kind, ent in self.entries.items():
             out[kind] = {
                 "condigest": ent.condigest, "n_rows": ent.n_rows,
+                "rv": ent.rv,
                 "rows": {row: (ident, {c: list(rs)
                                for c, rs in by_c.items()})
                          for row, (ident, by_c) in ent.rows.items()},
@@ -231,6 +240,7 @@ class VerdictLedger:
             gen=table.generation, kgen=table.key_generation,
             remap=table.remap_generation, n_rows=table.n_rows,
             conver=conver, condigest=condigest,
+            rv=int(payload.get("rv", 0) or 0),
             rows={row: (tuple(ident), dict(by_c))
                   for row, (ident, by_c) in payload["rows"].items()})
         self.entries[kind] = ent

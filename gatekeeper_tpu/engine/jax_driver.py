@@ -321,13 +321,35 @@ class JaxDriver(LocalDriver):
             return False
         st = self._state(target)
         ok = _snap.save_store(target, st.table.snapshot_state())
-        if ok and isinstance(st, JaxTargetState) and st.ledger is not None \
-                and st.ledger.entries:
-            # companion pagemap tier: the ledger's confirmed verdicts
-            # ride the same snapshot so a warm restart adopts them
-            # (per kind, revalidated by constraint digest + row count)
-            # instead of paying a cold full build
-            _snap.save_pagemap(target, st.ledger.snapshot_payload())
+        if ok and isinstance(st, JaxTargetState):
+            from gatekeeper_tpu.enforce.ledger import pages_mode as _pg
+            if st.ledger is not None and st.ledger.entries:
+                # companion pagemap tier: the ledger's confirmed
+                # verdicts ride the same snapshot so a warm restart
+                # adopts them (per kind, revalidated by constraint
+                # digest + row count) instead of paying a cold build.
+                # Each kind is stamped with the watch RV watermark the
+                # verdicts were built at: the reactor forces one kind
+                # resync if its first observed event does not extend it
+                payload = st.ledger.snapshot_payload()
+                wm = st.table.rv_watermark()
+                # ledger entries are keyed by constraint kind while the
+                # store watermark is keyed by resource kind, so each
+                # entry gets the global epoch (RVs are cluster-global)
+                # and the per-resource-kind map rides along under a
+                # reserved key for the reactor's per-stream floors
+                wm_max = max(wm.values(), default=0)
+                for kind, p in payload.items():
+                    p["rv"] = max(int(p.get("rv", 0) or 0), wm_max)
+                if wm:
+                    payload["__rv__"] = dict(wm)
+                _snap.save_pagemap(target, payload)
+            elif _pg():
+                # pages-on deployment snapshotted before the first
+                # sweep built a ledger: persist an empty pagemap so the
+                # companion-tier restore is a hit with zero adoptions,
+                # not a spurious tier miss
+                _snap.save_pagemap(target, {})
         return ok
 
     @locked
@@ -903,9 +925,17 @@ class JaxDriver(LocalDriver):
             entries = table.dirty_page_entries_since(ent.gen)
             if entries is None:
                 # window predates the log or spans an overflow widen
-                # marker: degrade to full-kind for exactly this interval
+                # marker: the dirty PAGES are unattributable, but the
+                # row space itself is intact (a shrink would have
+                # bumped remap_generation and been caught above), so
+                # rebuild the kind page-by-page through the normal
+                # delta path below — every page re-evaluates, warming
+                # the review cache incrementally and clearing dead rows
+                # via their own page's re-eval — instead of one
+                # monolithic whole-kind build
                 pg["widen_fallbacks"] += 1
-                rebuild = "widen"
+                entries = [(table.generation, None,
+                            frozenset(range(table.n_pages)))]
         n_evals = 0
         if rebuild is not None:
             # full build: clear rows that died since (sorted — the
@@ -966,7 +996,12 @@ class JaxDriver(LocalDriver):
         ent.n_rows = table.n_rows
         ent.conver = conver
         ent.condigest = condigest
-        self._ledger_serve(ent, constraints, row_order, kind, limit, tagged)
+        if tagged is not None:
+            # sweep caller: emit capped results.  The reactor passes
+            # None — it maintains verdicts between sweeps; formatting
+            # happens when the next audit serves from the ledger.
+            self._ledger_serve(ent, constraints, row_order, kind, limit,
+                               tagged)
 
     def _ledger_apply_row(self, st, target, handler, compiled, constraints,
                           kind, led, rcache, row, pg) -> int:
@@ -1022,6 +1057,148 @@ class JaxDriver(LocalDriver):
                                    dataclasses.replace(
                                        r, metadata=dict(r.metadata))))
                 emitted += len(results)
+
+    # ------------------------------------------------------------------
+    # continuous-enforcement entry points (enforce/reactor.py)
+
+    def react_kind(self, target: str,
+                   kind: str | None = None) -> dict | None:
+        """Rung 1 of the reactor's resync ladder: fold the store's
+        dirty pages into the VerdictLedger for one kind (every eligible
+        kind when None) with no sweep in between — the single-event →
+        single-page re-eval path.  Serving is skipped (verdicts are
+        *maintained*; the next audit formats from the updated ledger).
+        Returns the paged accounting dict, or None when pages are off
+        or nothing was eligible."""
+        from gatekeeper_tpu.enforce.ledger import pages_mode
+        if not pages_mode():
+            return None
+        st = self._state(target)
+        if not isinstance(st, JaxTargetState):
+            return None
+        handler = self.targets[target]
+        pg = {"pages_evaluated": 0, "pages_skipped": 0, "rows_padded": 0,
+              "rows_reevaluated": 0, "evaluations_saved": 0,
+              "widen_fallbacks": 0, "full_builds": 0, "events": 0}
+        dirty: set[int] = set()
+        reacted = 0
+        with self._prep_lock:
+            ordered_rows, row_order = self._ensure_order(st)
+            kinds = [kind] if kind is not None else sorted(st.templates)
+            rcache: dict[int, tuple] = {}
+            for k in kinds:
+                compiled = st.templates.get(k)
+                if compiled is None:
+                    continue
+                constraints = self._kind_constraints(st, k)
+                if not constraints:
+                    continue
+                if self._pages_ineligible(st, k, compiled) is not None:
+                    continue
+                self._paged_kind(st, target, handler, compiled,
+                                 constraints, ordered_rows, row_order, k,
+                                 None, None, rcache, pg, dirty)
+                reacted += 1
+        if reacted == 0:
+            return None
+        pg["kinds"] = reacted
+        pg["dirty_pages"] = len(dirty)
+        m = self.metrics
+        m.counter("reactor_reacts_total").inc()
+        if st.ledger is not None:
+            m.gauge("ledger_violations").set(
+                st.ledger.total_violations())
+        return pg
+
+    def resync_kind(self, target: str,
+                    kind: str | None = None) -> dict | None:
+        """Rungs 2/3: force a whole-kind rebuild that DIFF-APPLIES
+        against the existing ledger rows — the entry is marked cold but
+        keeps its verdicts, so a clean resync emits zero events and a
+        divergent one emits exactly the true appear/clear delta, never
+        a drop-and-replay phantom storm.  Pending snapshot adoptions
+        for the kind are discarded: a resync exists precisely because
+        adopted state is suspect."""
+        from gatekeeper_tpu.enforce.ledger import pages_mode
+        if not pages_mode():
+            return None
+        st = self._state(target)
+        if not isinstance(st, JaxTargetState):
+            return None
+        with self._prep_lock:
+            led = st.ledger
+            kinds = [kind] if kind is not None else sorted(st.templates)
+            for k in kinds:
+                if st.ledger_restored:
+                    st.ledger_restored.pop(k, None)
+                if led is not None:
+                    ent = led.entries.get(k)
+                    if ent is not None:
+                        ent.gen = -1
+        # _prep_lock released: react_kind re-acquires it (plain Lock,
+        # not reentrant)
+        out = self.react_kind(target, kind)
+        self.metrics.counter("reactor_resyncs_total").inc()
+        return out
+
+    @locked_read
+    def page_of_object(self, target: str, obj: Any) -> int | None:
+        """Row page an event object lands in — the reactor's
+        coalescing hint.  None when unhandled or not resident."""
+        handler = self.targets.get(target)
+        if handler is None:
+            return None
+        try:
+            key, _meta, _doc = handler.process_data(obj)
+        except Exception:   # noqa: BLE001 — unhandled/malformed event
+            return None
+        st = self._state(target)
+        row = st.table.lookup(key)
+        return None if row is None else st.table.page_of(row)
+
+    @locked_read
+    def kind_residents(self, target: str, api_version: str,
+                       kind: str) -> list[str]:
+        """Store keys of every resident row of (apiVersion, kind) — the
+        deletion scan for a rung-2 relist (Client.sync_kind)."""
+        st = self._state(target)
+        table = st.table
+        out: list[str] = []
+        for key, row in list(table.rows_items()):
+            meta = table.meta_at(row)
+            if meta is not None and meta.kind == kind \
+                    and meta.api_version == api_version:
+                out.append(key)
+        return out
+
+    def ledger_rv(self, target: str, kind: str) -> int:
+        """The kind's adopted/live RV watermark (0 = none recorded) —
+        seeds the reactor's first-event staleness check on restart."""
+        st = self._state(target)
+        if not isinstance(st, JaxTargetState):
+            return 0
+        led = st.ledger
+        if led is not None:
+            ent = led.entries.get(kind)
+            if ent is not None and ent.rv:
+                return int(ent.rv)
+        if st.ledger_restored:
+            # resource-kind floors from the snapshot's watermark map
+            # (reactor streams are keyed by resource kind; the ledger
+            # entries below are keyed by constraint kind)
+            wm = st.ledger_restored.get("__rv__")
+            if isinstance(wm, dict) and kind in wm:
+                try:
+                    return int(wm[kind] or 0)
+                except (TypeError, ValueError):
+                    return 0
+            payload = st.ledger_restored.get(kind)
+            if isinstance(payload, dict):
+                try:
+                    return int(payload.get("rv", 0) or 0)
+                except (TypeError, ValueError):
+                    return 0
+        return 0
 
     def _ensure_order(self, st):
         """Sorted-cache-key row order (matches the scalar driver) with

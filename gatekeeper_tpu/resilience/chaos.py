@@ -26,6 +26,24 @@ the invariants that define "degrades, never lies":
    degraded backend returns to healthy (and the driver re-jits) within
    the backoff budget.
 
+The reactor PR added the watch dimension: the soak runs with
+``GATEKEEPER_PAGES=on``, a FakeCluster + event reactor
+(``enforce/reactor.py``) driving store writes from watch events, a
+namespace churn worker mutating the cluster throughout, and five
+watch-class faults (``watch_stall``, ``watch_gap``,
+``watch_duplicate``, ``watch_reorder``, ``watch_flood``) in the
+schedule pool.  Three more invariants:
+
+6. **The ledger event stream is exact** — a mirror violation multiset
+   maintained purely from appear/clear events must equal the ledger's
+   actual state AND the pages-off oracle's evaluation of the same
+   store at every checkpoint (the stream is bit-identical to the diff
+   of consecutive full sweeps, under every injected pathology).
+7. **Resync never leaves phantoms** — a forced whole-ladder resync
+   against the settled store emits zero events.
+8. **The reactor recovers** — after the schedule disarms, the state
+   machine returns to ``live`` within the recovery budget.
+
 Everything is seeded: ``build_schedule(seed, duration)`` is a pure
 function of its arguments (the determinism test in
 ``tests/test_chaos.py`` pins this), so a failing soak replays with the
@@ -43,6 +61,8 @@ rc 2 = invariant violation(s).  The final line always reads
 
 from __future__ import annotations
 
+import collections
+import copy
 import dataclasses
 import os
 import random
@@ -50,10 +70,13 @@ import threading
 import time
 
 FAULTS = ("probe_hang", "device_lost", "snapshot_corrupt",
-          "slow_provider", "queue_storm")
+          "slow_provider", "queue_storm",
+          "watch_stall", "watch_gap", "watch_duplicate",
+          "watch_reorder", "watch_flood")
 
 # one-shot (``faults.take``) seams the scheduler re-arms between events
-ONE_SHOT = ("device_lost", "snapshot_corrupt", "queue_storm")
+ONE_SHOT = ("device_lost", "snapshot_corrupt", "queue_storm",
+            "watch_gap", "watch_duplicate", "watch_reorder")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -236,6 +259,13 @@ class SoakReport:
     backend_degradations: int = 0
     backend_recoveries: int = 0
     backend_rejits: int = 0
+    watch_events: int = 0        # frames the reactor ingested
+    watch_pathologies: dict = dataclasses.field(default_factory=dict)
+    reactor_resyncs: int = 0     # rung-2 + rung-3 ladder runs
+    reactor_reconnects: int = 0
+    ledger_checks: int = 0       # mirror==state==oracle checkpoints
+    ledger_events: int = 0       # appear/clear deltas emitted
+    churn_ops: int = 0
     violations: list = dataclasses.field(default_factory=list)
     warnings: list = dataclasses.field(default_factory=list)
 
@@ -247,6 +277,10 @@ class SoakReport:
                 f"/{self.queue_capacity} p99={self.p99_s * 1e3:.1f}ms "
                 f"recoveries={self.backend_recoveries} "
                 f"rejits={self.backend_rejits} "
+                f"watch_ev={self.watch_events} "
+                f"pathologies={sum(self.watch_pathologies.values())} "
+                f"resyncs={self.reactor_resyncs} "
+                f"ledger_checks={self.ledger_checks} "
                 f"{len(self.warnings)} warning(s) "
                 f"{len(self.violations)} invariant violation(s)")
 
@@ -275,12 +309,27 @@ def run_soak(seed: int = 7, duration_s: float = 30.0, rps: float = 150.0,
     os.environ.setdefault("GATEKEEPER_SUPERVISOR_BACKOFF_S", "0.5")
     os.environ.setdefault("GATEKEEPER_SUPERVISOR_REPROBE_TIMEOUT_S", "2.0")
     os.environ.setdefault("GATEKEEPER_FAULT_STALL_S", "0.3")
+    # the soak IS the pages graduation gate: force the paged path on so
+    # the ledger invariants are checked under injection (restored at
+    # teardown), and tighten the reactor's timers so watch-fault
+    # detection/recovery cycles fit inside ~1s fault windows
+    prev_pages = os.environ.get("GATEKEEPER_PAGES")
+    os.environ["GATEKEEPER_PAGES"] = "on"
+    os.environ.setdefault("GATEKEEPER_PAGE_ROWS", "8")
+    os.environ.setdefault("GATEKEEPER_REACTOR_QUEUE", "8")
+    os.environ.setdefault("GATEKEEPER_REACTOR_STALL_S", "0.25")
+    os.environ.setdefault("GATEKEEPER_REACTOR_BACKOFF_S", "0.25")
+    os.environ.setdefault("GATEKEEPER_REACTOR_GAP_GRACE_S", "0.15")
     prev_fault = os.environ.get("GATEKEEPER_FAULT")
     os.environ["GATEKEEPER_FAULT"] = ""
 
+    from gatekeeper_tpu.api.config import GVK
     from gatekeeper_tpu.api.externaldata import IGNORE, Provider
     from gatekeeper_tpu.client.client import Backend
+    from gatekeeper_tpu.client.interface import QueryOpts
     from gatekeeper_tpu.client.local_driver import LocalDriver
+    from gatekeeper_tpu.cluster.fake import FakeCluster
+    from gatekeeper_tpu.enforce.reactor import LIVE, Reactor
     from gatekeeper_tpu.engine.jax_driver import JaxDriver
     from gatekeeper_tpu.externaldata.fake import FakeProvider, register_fake
     from gatekeeper_tpu.externaldata.runtime import (ExternalDataRuntime,
@@ -290,7 +339,7 @@ def run_soak(seed: int = 7, duration_s: float = 30.0, rps: float = 150.0,
     from gatekeeper_tpu.resilience import faults
     from gatekeeper_tpu.resilience.supervisor import (HEALTHY,
                                                       get_supervisor)
-    from gatekeeper_tpu.target.k8s import K8sValidationTarget
+    from gatekeeper_tpu.target.k8s import TARGET_NAME, K8sValidationTarget
     from gatekeeper_tpu.webhook.batcher import MicroBatcher
     from gatekeeper_tpu.webhook.overload import OverloadController
     from gatekeeper_tpu.webhook.policy import ValidationHandler
@@ -322,15 +371,103 @@ def run_soak(seed: int = 7, duration_s: float = 30.0, rps: float = 150.0,
     _install_policy_set(live_client)
     _install_policy_set(oracle_client)
     # a small inventory so the audit loop sweeps real rows (and the
-    # mid-sweep device_lost seam has kinds to fire between)
+    # mid-sweep device_lost seam has kinds to fire between); created
+    # through the FakeCluster and list-synced into the store, so the
+    # reactor's rung-2 relists see the same objects
+    cluster = FakeCluster()
+    ns_gvk = GVK(group="", version="v1", kind="Namespace")
     for i in range(16):
-        live_client.add_data(_ns_obj(
-            f"inv-{i}", {"gatekeeper": "on"} if i % 2 else None))
+        live_client.add_data(cluster.create(_ns_obj(
+            f"inv-{i}", {"gatekeeper": "on"} if i % 2 else None)))
 
     corpus = _build_corpus(48)
     oracle_handler = ValidationHandler(oracle_client)
     expected = [oracle_handler.handle(dict(r)) for r in corpus]
     expected_deny = [_deny_lines(r) for r in expected]
+
+    # ---------------- the watch path: reactor + ledger mirror ---------
+    # apply_objects=True makes the reactor the ONLY store writer for
+    # cluster churn: a dropped frame is genuine store staleness that
+    # only the resync ladder heals
+    rx = Reactor(live_client, cluster=cluster, apply_objects=True,
+                 seed=seed, name="chaos-reactor")
+    rx.attach(ns_gvk)
+    drv = live_client.driver
+    drv.react_kind(TARGET_NAME, None)       # cold-build the ledger
+    led = drv.state[TARGET_NAME].ledger
+    if led is None:
+        raise RuntimeError("chaos soak requires the paged sweep: no "
+                           "VerdictLedger after react_kind (is every "
+                           "kind pages-ineligible?)")
+
+    def _led_multiset() -> collections.Counter:
+        out: collections.Counter = collections.Counter()
+        for kind, ent in led.entries.items():
+            for _row, (ident, by_c) in ent.rows.items():
+                ref = led._resource_ref(ident)
+                for cname, rs in by_c.items():
+                    for r in rs:
+                        out[(kind, cname, ref, r.msg)] += 1
+        return out
+
+    mirror_lock = threading.Lock()
+    mirror: collections.Counter = _led_multiset()   # primed pre-subscribe
+
+    def _on_delta(ev: dict) -> None:
+        with mirror_lock:
+            key = (ev["kind"], ev["constraint"], ev["resource"], ev["msg"])
+            if ev["op"] == "appear":
+                mirror[key] += 1
+            else:
+                mirror[key] -= 1
+                if not mirror[key]:
+                    del mirror[key]
+
+    led.subscribe(_on_delta)
+    ledger_checks = [0]
+
+    def ledger_checkpoint(tag: str) -> None:
+        """Invariant 6: under the client write lock (no concurrent
+        sweeps or reactor applies) the event-stream mirror, the
+        ledger's state, and the pages-off oracle's evaluation of the
+        same store must be one multiset."""
+        with live_client._lock.write():
+            drv.react_kind(TARGET_NAME, None)   # fold pending store dirt
+            state = _led_multiset()
+            with mirror_lock:
+                mir = collections.Counter(
+                    {k: v for k, v in mirror.items() if v})
+            if mir != state:
+                violation("ledger_stream_divergence", tag=tag,
+                          missing=sorted(map(str, (state - mir))),
+                          extra=sorted(map(str, (mir - state))))
+            saved = os.environ.get("GATEKEEPER_PAGES")
+            os.environ["GATEKEEPER_PAGES"] = "off"
+            try:
+                results, _ = drv.query_audit(
+                    TARGET_NAME, QueryOpts(limit_per_constraint=100_000))
+            finally:
+                os.environ["GATEKEEPER_PAGES"] = saved
+            oracle: collections.Counter = collections.Counter()
+            for r in results:
+                kind = (r.constraint or {}).get("kind", "")
+                if kind not in led.entries:
+                    continue        # non-paged kinds aren't ledgered
+                cname = ((r.constraint or {}).get("metadata")
+                         or {}).get("name", "")
+                # the legacy sweep reports identity via the synthesized
+                # review, the paged serve via the stored resource
+                meta = (r.resource or {}).get("metadata") or {}
+                rev = r.review or {}
+                name = meta.get("name") or rev.get("name", "")
+                ns = meta.get("namespace") or rev.get("namespace")
+                ref = f"{ns}/{name}" if ns else str(name)
+                oracle[(kind, cname, ref, r.msg)] += 1
+            if oracle != state:
+                violation("ledger_oracle_divergence", tag=tag,
+                          missing=sorted(map(str, (oracle - state))),
+                          extra=sorted(map(str, (state - oracle))))
+        ledger_checks[0] += 1
 
     batcher = MicroBatcher(
         lambda reqs: live_client.review_batch(
@@ -390,13 +527,53 @@ def run_soak(seed: int = 7, duration_s: float = 30.0, rps: float = 150.0,
                 stop.wait(pause)
 
     def auditor() -> None:
+        cycles = 0
         while not stop.is_set():
             try:
                 live_client.audit()
+                cycles += 1
+                if cycles % 5 == 0:
+                    ledger_checkpoint("periodic")
             except Exception as e:   # noqa: BLE001
                 violation("audit_exception", error=repr(e))
                 return
             stop.wait(0.2)
+
+    churn_ops = [0]
+
+    def churner() -> None:
+        """Continuous cluster mutation: the watch stream always has
+        traffic for the armed fault to corrupt.  Single writer, so
+        FakeCluster RV conflicts can't occur."""
+        rng = random.Random(seed * 31 + 7)
+        extras: list[str] = []
+        n_created = 0
+        while not stop.wait(0.02):
+            try:
+                r = rng.random()
+                if r < 0.75:
+                    cur = cluster.get(ns_gvk, f"inv-{rng.randrange(16)}")
+                    obj = copy.deepcopy(cur)
+                    labels = obj.setdefault("metadata", {}).setdefault(
+                        "labels", {})
+                    if "gatekeeper" in labels and rng.random() < 0.5:
+                        labels.pop("gatekeeper")
+                    else:
+                        labels["gatekeeper"] = "on"
+                    labels["churn"] = str(churn_ops[0])
+                    cluster.update(obj)
+                elif r < 0.92 or not extras:
+                    name = f"churn-{n_created}"
+                    n_created += 1
+                    cluster.create(_ns_obj(name, {"team": "x"}))
+                    extras.append(name)
+                else:
+                    cluster.delete(ns_gvk, extras.pop(
+                        rng.randrange(len(extras))))
+                churn_ops[0] += 1
+            except Exception as e:   # noqa: BLE001 — churn must never
+                violation("churn_exception", error=repr(e))   # wedge
+                return
 
     def monitor() -> None:
         last = 0
@@ -428,6 +605,9 @@ def run_soak(seed: int = 7, duration_s: float = 30.0, rps: float = 150.0,
                                     name="chaos-audit"))
     threads.append(threading.Thread(target=monitor, daemon=True,
                                     name="chaos-monitor"))
+    threads.append(threading.Thread(target=churner, daemon=True,
+                                    name="chaos-churn"))
+    rx.start(interval=0.02)
     t_start = time.monotonic()
     for t in threads:
         t.start()
@@ -463,6 +643,43 @@ def run_soak(seed: int = 7, duration_s: float = 30.0, rps: float = 150.0,
                 violation("thread_wedged", thread=t.name)
 
     # ---------------- post-soak invariants ----------------------------
+    # invariant 8: the reactor's state machine returns to live within
+    # the recovery budget once the schedule stops injecting (its pump
+    # thread is still running and drives reconnect/resync)
+    t_rec = time.monotonic() + recovery_budget_s
+    while time.monotonic() < t_rec and rx.state != LIVE:
+        time.sleep(0.1)
+    if rx.state != LIVE:
+        violation("reactor_no_recovery", state=rx.state,
+                  budget_s=recovery_budget_s,
+                  transitions=list(rx.transitions)[-8:])
+    rx.stop()
+    # invariant 6, once more against the settled store
+    ledger_checkpoint("final")
+    # invariant 7: a forced rung-2 resync of EVERY kind against the
+    # settled store must be event-free — resync never leaves phantom
+    # verdicts (and never drops real ones)
+    with live_client._lock.write():
+        drv.react_kind(TARGET_NAME, None)
+        seq0 = led.seq
+        drv.resync_kind(TARGET_NAME, None)
+        if led.seq != seq0:
+            violation("resync_phantom_events", events=led.seq - seq0)
+    report.watch_events = rx.counters.get("events", 0)
+    report.watch_pathologies = {
+        p[len("pathology_"):]: n for p, n in rx.counters.items()
+        if p.startswith("pathology_")}
+    report.reactor_resyncs = (rx.counters.get("rung2", 0)
+                              + rx.counters.get("rung3", 0))
+    report.reactor_reconnects = rx.counters.get("reconnects", 0)
+    report.ledger_checks = ledger_checks[0]
+    report.ledger_events = led.seq
+    report.churn_ops = churn_ops[0]
+    if not report.watch_events:
+        report.warnings.append(
+            "watch stream carried no events: churn worker never ran "
+            "(reactor invariants were vacuous)")
+
     sup = get_supervisor()
     report.backend_degradations = \
         sup.metrics.counter("backend_degradations").value
@@ -519,6 +736,10 @@ def run_soak(seed: int = 7, duration_s: float = 30.0, rps: float = 150.0,
         os.environ.pop("GATEKEEPER_FAULT", None)
     else:
         os.environ["GATEKEEPER_FAULT"] = prev_fault
+    if prev_pages is None:
+        os.environ.pop("GATEKEEPER_PAGES", None)
+    else:
+        os.environ["GATEKEEPER_PAGES"] = prev_pages
     record_event("chaos_soak_done", violations=len(report.violations),
                  warnings=len(report.warnings))
     if report.violations:

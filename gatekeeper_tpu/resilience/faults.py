@@ -23,6 +23,33 @@ consults this module at the exact seams a real failure would hit:
                          stall: slow device, GC pause, noisy
                          neighbor).
 
+Watch-class faults (consumed at the reactor's ingest edge,
+``enforce/reactor.py`` — each models one way a watch stream breaks):
+
+- ``watch_stall``      — while armed, frames buffer unstamped (bytes
+                         stuck in the socket); past the stall timeout
+                         the reactor declares the connection dead and
+                         degrades to sweep cadence, reconnecting under
+                         exponential backoff (attempts while armed
+                         fail, as against a still-sick API server).
+- ``watch_gap``        — fires ONCE: a stamped frame is lost on the
+                         wire; the gap detector confirms the missing
+                         sequence after the grace window and takes a
+                         rung-2 kind resync.
+- ``watch_duplicate``  — fires ONCE: a frame is delivered twice with
+                         the same sequence; classified ``duplicate``
+                         and dropped (verdict application is
+                         idempotent regardless).
+- ``watch_reorder``    — fires ONCE: a frame arrives late, below the
+                         high-water sequence; classified
+                         ``out_of_order`` and HEALS the suspected gap
+                         — no resync.
+- ``watch_flood``      — while armed, every real frame is followed by
+                         a replay storm of recent frames; coalescing
+                         absorbs small storms, a storm past the queue
+                         bound is an ``overflow`` pathology escalating
+                         to a rung-2 resync.
+
 ``active`` faults apply every time they are consulted; ``take`` faults
 are one-shot per process (the set of already-fired names is kept here)
 so a single armed fault produces one discrete failure event rather

@@ -417,6 +417,28 @@ class ResourceTable:
             out |= pages
         return frozenset(out)
 
+    def rv_watermark(self) -> dict[str, int]:
+        """Max ``metadata.resourceVersion`` per kind over resident rows
+        — the watch watermark the pg snapshot tier is built at.  A warm
+        restart adopting the ledger compares the reactor's first
+        observed RV against this: an event that does not extend it
+        means the adopted verdicts describe state the new stream never
+        saw, and the kind takes one forced resync."""
+        out: dict[str, int] = {}
+        for _key, row in self._rows.items():
+            meta = self._metas[row]
+            obj = self._objs[row]
+            if meta is None or not isinstance(obj, dict):
+                continue
+            rv = (obj.get("metadata") or {}).get("resourceVersion")
+            if isinstance(rv, str) and rv.isdigit():
+                rv = int(rv)
+            if not isinstance(rv, int):
+                continue
+            if rv > out.get(meta.kind, 0):
+                out[meta.kind] = rv
+        return out
+
     # ------------------------------------------------------------------
 
     def object_at(self, row: int) -> Any:

@@ -57,6 +57,11 @@ class WatchManager:
         return Registrar(self, name)
 
     def watched_gvks(self) -> set[GVK]:
+        """The running watch set.  Besides observability this is the
+        roster the event reactor (enforce/reactor.py) mirrors via
+        ``sync_subscriptions`` after every poll: the reference feeds
+        informer events only to the sync *cache*, while here the same
+        roster also drives page-granular verdict maintenance."""
         with self._lock:
             return {gvk for (_, gvk) in self._active}
 

@@ -162,8 +162,10 @@ class WebhookServer:
                     # document per live VerdictLedger
                     import json as _json
                     from gatekeeper_tpu.enforce.ledger import export_all
-                    payload = _json.dumps(
-                        export_all(), default=str).encode()
+                    from gatekeeper_tpu.enforce.reactor import export_state
+                    doc = export_all()
+                    doc["reactors"] = export_state()
+                    payload = _json.dumps(doc, default=str).encode()
                     self.send_response(200)
                     self.send_header("Content-Type", "application/json")
                     self.send_header("Content-Length", str(len(payload)))

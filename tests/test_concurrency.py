@@ -106,3 +106,113 @@ def test_concurrent_reviews_audits_and_churn():
     ores = oracle.audit().results()
     key = lambda r: (r.msg, r.constraint["metadata"]["name"])
     assert sorted(map(key, final)) == sorted(map(key, ores))
+
+
+def test_concurrent_writers_paged_reactor_no_lost_page(monkeypatch):
+    """Two writer threads churn disjoint halves of a FakeCluster while
+    the reactor pumps page-granular re-evals and a sweeper runs paged
+    audits: the dirty-path log must not lose a page bit under
+    concurrent mutation (final paged verdicts == a pages-off oracle
+    over the final cluster state) and no client→driver→reactor
+    lock-order inversion may wedge a thread."""
+    import copy
+    import os
+
+    from gatekeeper_tpu.cluster.fake import FakeCluster, gvk_of
+    from gatekeeper_tpu.enforce.reactor import Reactor
+    from gatekeeper_tpu.library import all_docs, make_mixed
+    from gatekeeper_tpu.target.k8s import TARGET_NAME, K8sValidationTarget
+
+    monkeypatch.setenv("GATEKEEPER_PAGES", "on")
+    monkeypatch.setenv("GATEKEEPER_PAGE_ROWS", "8")
+    monkeypatch.delenv("GATEKEEPER_FAULT", raising=False)
+    monkeypatch.delenv("GATEKEEPER_SNAPSHOT_DIR", raising=False)
+    kinds = ("K8sRequiredLabels", "K8sAllowedRepos")
+    opts = QueryOpts(limit_per_constraint=100)
+
+    def mk_client():
+        jd = JaxDriver()
+        c = Backend(jd).new_client([K8sValidationTarget()])
+        for tdoc, cdoc in all_docs():
+            if tdoc["spec"]["crd"]["spec"]["names"]["kind"] in kinds:
+                c.add_template(tdoc)
+                c.add_constraint(cdoc)
+        return jd, c
+
+    def verdicts(results):
+        return sorted(
+            ((r.constraint or {}).get("kind", ""),
+             ((r.constraint or {}).get("metadata") or {}).get("name", ""),
+             (((r.resource or {}).get("metadata") or {}).get("name")
+              or (r.review or {}).get("name", "")),
+             r.msg) for r in results)
+
+    rng = random.Random(3)
+    resources = make_mixed(rng, 32)
+    cluster = FakeCluster()
+    for o in resources:
+        cluster.create(copy.deepcopy(o))
+    gvks = sorted({gvk_of(o) for o in resources}, key=lambda g: g.kind)
+    jd, c = mk_client()
+    c.add_data_batch(
+        copy.deepcopy([o for g in gvks for o in cluster.list(g)]))
+    rx = Reactor(c, cluster=cluster, apply_objects=True, seed=3)
+    for g in gvks:
+        rx.attach(g)
+    jd.query_audit(TARGET_NAME, opts)           # cold build
+    errors: list = []
+    stop = threading.Event()
+
+    def writer(half):
+        mine = resources[half::2]               # disjoint: no RV races
+        wrng = random.Random(100 + half)
+        n = 0
+        while not stop.is_set():
+            n += 1
+            src = wrng.choice(mine)
+            try:
+                cur = cluster.get(gvk_of(src), src["metadata"]["name"],
+                                  src["metadata"].get("namespace"))
+                o = copy.deepcopy(cur)
+                o.setdefault("metadata", {}).setdefault(
+                    "labels", {})[f"w{half}"] = str(n)
+                cluster.update(o)
+            except Exception:
+                errors.append(("writer", traceback.format_exc()))
+
+    def sweeper():
+        while not stop.is_set():
+            try:
+                jd.query_audit(TARGET_NAME, opts)
+            except Exception:
+                errors.append(("sweep", traceback.format_exc()))
+
+    rx.start(interval=0.005)
+    threads = [threading.Thread(target=writer, args=(h,)) for h in (0, 1)]
+    threads.append(threading.Thread(target=sweeper))
+    for t in threads:
+        t.start()
+    threading.Event().wait(2.0)
+    stop.set()
+    for t in threads:
+        t.join(timeout=20)
+        assert not t.is_alive(), "thread wedged"
+    rx.stop()
+    for _ in range(20):                         # drain residual events
+        rx.pump()
+        payload = rx.state_payload()
+        if all(k["pending"] == 0 for k in payload["kinds"].values()):
+            break
+        threading.Event().wait(0.02)
+    assert not errors, errors[:3]
+    assert rx.counters["events"] > 0
+    live = verdicts(jd.query_audit(TARGET_NAME, opts)[0])
+    jdo, co = mk_client()
+    co.add_data_batch(
+        copy.deepcopy([o for g in gvks for o in cluster.list(g)]))
+    os.environ["GATEKEEPER_PAGES"] = "off"
+    try:
+        oracle = verdicts(jdo.query_audit(TARGET_NAME, opts)[0])
+    finally:
+        os.environ["GATEKEEPER_PAGES"] = "on"
+    assert live == oracle

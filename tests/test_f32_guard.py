@@ -10,6 +10,8 @@ kinds to the scalar oracle — parity over mis-ordering, never silence.
 
 import random
 
+import pytest
+
 from gatekeeper_tpu.client.client import Backend
 from gatekeeper_tpu.client.interface import QueryOpts
 from gatekeeper_tpu.client.local_driver import LocalDriver
@@ -51,6 +53,15 @@ class TestF32Exact:
         assert not _f32_exact([16777217.0])
         assert _f32_exact([float("nan"), 3.0])
         assert _f32_exact([])
+
+
+@pytest.fixture(autouse=True)
+def _legacy_sweep(monkeypatch):
+    # the routing guard under test lives in the legacy device sweep;
+    # the paged path re-evaluates rows scalar-side and so never takes
+    # the f32 fallback (its parity gates live in test_pages.py)
+    monkeypatch.setenv("GATEKEEPER_PAGES", "off")
+    yield
 
 
 class TestDriverRouting:

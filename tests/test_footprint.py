@@ -40,6 +40,10 @@ def _reset_footprint_state(monkeypatch):
     monkeypatch.delenv("GATEKEEPER_FOOTPRINT", raising=False)
     monkeypatch.delenv("GATEKEEPER_FOOTPRINT_TEST_NARROW", raising=False)
     monkeypatch.delenv("GATEKEEPER_SNAPSHOT_DIR", raising=False)
+    # this file measures the legacy full-kind sweep's selective
+    # invalidation (kind skips); the paged path supersedes it with page
+    # bits and has its own oracle gates in test_pages.py
+    monkeypatch.setenv("GATEKEEPER_PAGES", "off")
     yield
 
 

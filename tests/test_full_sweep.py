@@ -99,6 +99,9 @@ def test_full_sweep_device_path_parity_and_phases(monkeypatch):
     memoized device path and the oracle, a genuinely re-built cache
     layer, and per-phase pipeline timings recorded."""
     monkeypatch.setattr(jd_mod, "SMALL_WORKLOAD_EVALS", 0)
+    # the bindings_cache memo layer belongs to the legacy device sweep;
+    # paged kinds are served from the VerdictLedger instead
+    monkeypatch.setenv("GATEKEEPER_PAGES", "off")
     rng = random.Random(3)
     jd, c, resources, params = _small_device_client(rng)
 

@@ -460,3 +460,130 @@ class TestPagemapSnapshot:
         assert warm == want
         pg = dict(jd_warm.last_sweep_phases.get("pages") or {})
         assert pg["ledger_full_builds"] > 0     # drift forced a rebuild
+
+
+class TestWatchWatermark:
+    """Satellite: the pg snapshot tier records the watch
+    resourceVersion watermark it was built at; on restart the reactor
+    seeds each kind's RV floor from it.  A clean restart replays
+    nothing; a watermark the live stream cannot extend means the
+    adopted state is from another watch epoch, and the kind gets one
+    forced resync."""
+
+    KINDS = ("K8sRequiredLabels", "K8sAllowedRepos")
+
+    def _cluster_fixture(self, jd_mod, n=24, seed=11):
+        from gatekeeper_tpu.cluster.fake import FakeCluster, gvk_of
+        resources = make_mixed(random.Random(seed), n)
+        cluster = FakeCluster()
+        for o in resources:
+            cluster.create(copy.deepcopy(o))
+        gvks = sorted({gvk_of(o) for o in resources}, key=lambda g: g.kind)
+        jd, c = _mk_client(jd_mod, self.KINDS)
+        objs = [o for g in gvks for o in cluster.list(g)]
+        c.add_data_batch(copy.deepcopy(objs))
+        return cluster, gvks, resources, jd, c
+
+    def test_clean_warm_restart_replays_nothing(self, monkeypatch,
+                                                tmp_path):
+        import gatekeeper_tpu.engine.jax_driver as jd_mod
+        from gatekeeper_tpu.cluster.fake import gvk_of
+        from gatekeeper_tpu.enforce.reactor import LIVE, Reactor
+        monkeypatch.setenv("GATEKEEPER_SNAPSHOT_DIR", str(tmp_path))
+        monkeypatch.setattr(jd_mod, "SMALL_WORKLOAD_EVALS", 0)
+        opts = QueryOpts(limit_per_constraint=20)
+        cluster, gvks, resources, jd, c = self._cluster_fixture(jd_mod)
+        _sweep(jd, opts, pages=True)
+        os.environ["GATEKEEPER_PAGES"] = "on"
+        try:
+            assert jd.save_store_snapshot(TARGET_NAME)
+
+            jd2, c2 = _mk_client(jd_mod, self.KINDS)
+            assert jd2.restore_store_snapshot(TARGET_NAME) is True
+            # the watermark survived the round-trip: floors seed > 0
+            assert any(jd2.ledger_rv(TARGET_NAME, g.kind) > 0
+                       for g in gvks)
+            rx = Reactor(c2, cluster=cluster, apply_objects=True)
+            for g in gvks:
+                rx.attach(g)
+            # adoption sweep: nothing changed, nothing replayed
+            warm = _verdicts(_sweep(jd2, opts, pages=True))
+            led = jd2._state(TARGET_NAME).ledger
+            assert led.seq == 0              # zero spurious events
+            # live stream extends the watermark: no stale-RV resync
+            src = resources[0]
+            cur = cluster.get(gvk_of(src), src["metadata"]["name"],
+                              src["metadata"].get("namespace"))
+            o = copy.deepcopy(cur)
+            o.setdefault("metadata", {}).setdefault(
+                "labels", {})["wm"] = "x"
+            cluster.update(o)
+            rx.pump()
+            assert rx.counters["pathology_stale_rv"] == 0
+            assert rx.counters["rung2"] == 0
+            assert rx.state == LIVE
+        finally:
+            os.environ.pop("GATEKEEPER_PAGES", None)
+        assert warm == _verdicts(_sweep(jd, opts, pages=True))
+
+    def test_stale_watermark_forces_kind_resync(self, monkeypatch,
+                                                tmp_path):
+        import gatekeeper_tpu.engine.jax_driver as jd_mod
+        from gatekeeper_tpu.cluster.fake import FakeCluster, gvk_of
+        from gatekeeper_tpu.enforce.reactor import LIVE, Reactor
+        monkeypatch.setenv("GATEKEEPER_SNAPSHOT_DIR", str(tmp_path))
+        monkeypatch.setattr(jd_mod, "SMALL_WORKLOAD_EVALS", 0)
+        opts = QueryOpts(limit_per_constraint=20)
+        cluster_a, gvks, resources, jd, c = self._cluster_fixture(jd_mod)
+        # pump cluster A's RVs well past what a fresh cluster restarts
+        # at, so the snapshot watermark is unambiguously ahead
+        for _round in range(3):
+            for src in resources:
+                cur = cluster_a.get(gvk_of(src), src["metadata"]["name"],
+                                    src["metadata"].get("namespace"))
+                o = copy.deepcopy(cur)
+                o.setdefault("metadata", {}).setdefault(
+                    "labels", {})["bump"] = str(_round)
+                cluster_a.update(o)
+        objs = [o for g in gvks for o in cluster_a.list(g)]
+        c.add_data_batch(copy.deepcopy(objs))
+        _sweep(jd, opts, pages=True)
+        os.environ["GATEKEEPER_PAGES"] = "on"
+        try:
+            assert jd.save_store_snapshot(TARGET_NAME)
+
+            # "restart" against a DIFFERENT watch epoch: a fresh
+            # cluster whose RVs restart from 1, with diverged objects
+            cluster_b = FakeCluster()
+            for o in resources:
+                o2 = copy.deepcopy(o)
+                o2.setdefault("metadata", {}).setdefault(
+                    "labels", {})["epoch"] = "b"
+                cluster_b.create(o2)
+            jd2, c2 = _mk_client(jd_mod, self.KINDS)
+            assert jd2.restore_store_snapshot(TARGET_NAME) is True
+            rx = Reactor(c2, cluster=cluster_b, apply_objects=True)
+            for g in gvks:
+                rx.attach(g)
+            # first observed event per kind fails to extend the
+            # watermark -> one forced resync each, relisted from B
+            for g in gvks:
+                src = next(o for o in resources if o["kind"] == g.kind)
+                cur = cluster_b.get(g, src["metadata"]["name"],
+                                    src["metadata"].get("namespace"))
+                o = copy.deepcopy(cur)
+                o.setdefault("metadata", {}).setdefault(
+                    "labels", {})["poke"] = "1"
+                cluster_b.update(o)
+            rx.pump()
+            assert rx.counters["pathology_stale_rv"] >= len(gvks)
+            assert (rx.counters["rung2"] + rx.counters["rung3"]) >= 1
+            assert rx.state == LIVE
+            got = _verdicts(_sweep(jd2, opts, pages=True))
+        finally:
+            os.environ.pop("GATEKEEPER_PAGES", None)
+        # the store converged to cluster B, not the adopted snapshot
+        jd_o, c_o = _mk_client(jd_mod, self.KINDS)
+        c_o.add_data_batch(
+            copy.deepcopy([o for g in gvks for o in cluster_b.list(g)]))
+        assert got == _verdicts(_sweep(jd_o, opts, pages=False))

@@ -285,7 +285,8 @@ def test_warm_restart_in_process_skips_lowering(clean_backend, monkeypatch,
     assert delta["mod_hits"] == 3                   # parse+vet skipped too
     assert delta["store_hits"] == 1
     assert delta["plan_hits"] == 1
-    assert hits == 8
+    assert delta["pg_hits"] == 1      # pagemap tier (pages default-on)
+    assert hits == 9
 
     # repeat prepare_audit is satisfied from the in-memory memo, not
     # another disk read (monkeypatched loader would fail the call)
