@@ -161,7 +161,7 @@ def flush_partial() -> None:
 def _slim_headline() -> dict:
     """The stdout headline WITHOUT the full detail tree: metric, value,
     backend, and one-line north-star / full-sweep summaries.  Kept
-    ≤1,600 chars by contract — the capture windows that consume the
+    ≤1,750 chars by contract — the capture windows that consume the
     bench keep only a stdout tail (ci.sh parses the trailing 2,000
     bytes; the round-5 number of record was erased by exactly such a
     window).  Everything measured stays in BENCH_partial.json."""
@@ -248,6 +248,20 @@ def _slim_headline() -> dict:
                                ("clusters", "parity", "kinds_stacked",
                                 "device_dispatches")
                                if fs2.get(k) is not None}
+    rx = DETAIL.get("regex_high_cardinality")
+    rh = DETAIL.get("regex_heavy")
+    if isinstance(rx, dict) or isinstance(rh, dict):
+        rg = {}
+        if isinstance(rx, dict):
+            for k in ("n_unique", "in_jit_vs_host_loop"):
+                if rx.get(k) is not None:
+                    rg[k] = rx[k]
+        if isinstance(rh, dict):
+            for k in ("dfa_parity", "parity_digest"):
+                if rh.get(k) is not None:
+                    rg[k] = rh[k]
+        if rg:
+            slim["regex"] = rg
     ov = DETAIL.get("overload")
     if isinstance(ov, dict):
         so = {k: ov.get(k) for k in ("shed_total", "max_rung",
@@ -265,7 +279,7 @@ def _slim_headline() -> dict:
 
 def emit_headline() -> None:
     """Print THE one stdout JSON line (exactly once, from any thread) —
-    the SLIM headline (≤1,600 chars; full detail goes to
+    the SLIM headline (≤1,750 chars; full detail goes to
     BENCH_partial.json via flush_partial, never to stdout).  The
     watchdog calls this while a phase thread may be mutating DETAIL —
     serialization must survive the race (and _EMITTED only latches
@@ -283,7 +297,7 @@ def emit_headline() -> None:
                 break
             except RuntimeError:        # dict mutated mid-dump; retry
                 time.sleep(0.05)
-        if line is None or len(line) > 1600:    # belt and braces: the
+        if line is None or len(line) > 1750:    # belt and braces: the
             # headline must fit the 2,000-byte tail window whole
             line = json.dumps({k: HEADLINE.get(k) for k in
                                ("metric", "value", "unit", "vs_baseline",
@@ -1886,6 +1900,21 @@ def bench_selector_heavy(detail):
         constraints, oracle_n=2_000)
 
 
+def _verdict_digest(results) -> str:
+    """Order-independent digest of a full audit result set (same shape
+    as resilience/smoke.py's) — the bit-identity oracle the regex rows
+    report."""
+    items = sorted(
+        ((r.constraint or {}).get("kind", ""),
+         ((r.constraint or {}).get("metadata") or {}).get("name", ""),
+         (r.resource or {}).get("kind", ""),
+         str(((r.resource or {}).get("metadata") or {}).get("namespace")),
+         ((r.resource or {}).get("metadata") or {}).get("name", ""),
+         r.msg)
+        for r in results)
+    return hashlib.sha256(repr(items).encode()).hexdigest()[:16]
+
+
 def bench_regex_heavy(detail):
     n = sized(100_000, 2_000, 10_000)
     rng = random.Random(6)
@@ -1895,6 +1924,37 @@ def bench_regex_heavy(detail):
     constraints = [constraint_doc(k, k.lower(), LIBRARY[k][1]) for k in kinds]
     bench_two_engines(detail, f"regex_heavy_{n}", resources, templates,
                       constraints, oracle_n=2_000)
+    # in-jit dfa_match vs GATEKEEPER_DFA=off lookup-table parity: the
+    # same jax sweep with the DFA lowering disabled is the graduation
+    # oracle — both legs must produce a bit-identical verdict digest
+    row = dict(detail.get(f"regex_heavy_{n}") or {})
+    # the parity legs run even in scalar fallback (smaller subset, like
+    # every other parity row) — the digest is the gate, not the wall
+    sub = resources[:min(n, 2_000 if FALLBACK else 4_000)]
+    digests = {}
+    for mode in ("on", "off"):
+        prev = os.environ.get("GATEKEEPER_DFA")
+        os.environ["GATEKEEPER_DFA"] = mode
+        try:
+            drv = JaxDriver()
+            c = Backend(drv).new_client([K8sValidationTarget()])
+            for t in templates:
+                c.add_template(t)
+            for cd in constraints:
+                c.add_constraint(cd)
+            c.add_data_batch(sub)
+            got, _ = drv.query_audit(TARGET_NAME, QueryOpts(full=True))
+            digests[mode] = _verdict_digest(got)
+        finally:
+            if prev is None:
+                os.environ.pop("GATEKEEPER_DFA", None)
+            else:
+                os.environ["GATEKEEPER_DFA"] = prev
+    row["dfa_parity"] = digests["on"] == digests["off"]
+    row["parity_digest"] = digests["on"]
+    log(f"[regex_heavy] dfa parity {row['dfa_parity']} "
+        f"(digest {digests['on']} vs off-oracle {digests['off']})")
+    detail["regex_heavy"] = row
 
 
 def bench_admission_open_loop(detail, handler, reqs):
@@ -2170,8 +2230,24 @@ def bench_regex_high_cardinality(detail):
 
     n = sized(500_000, 20_000, 50_000)
     rng = random.Random(17)
-    interp = Interpreter(parse_module(LIBRARY["K8sImageDigests"][0]))
-    lowered = Lowerer(interp.module, interp).lower()
+
+    def _lower(mode):
+        prev = os.environ.get("GATEKEEPER_DFA")
+        os.environ["GATEKEEPER_DFA"] = mode
+        try:
+            interp = Interpreter(parse_module(LIBRARY["K8sImageDigests"][0]))
+            return Lowerer(interp.module, interp).lower()
+        finally:
+            if prev is None:
+                os.environ.pop("GATEKEEPER_DFA", None)
+            else:
+                os.environ["GATEKEEPER_DFA"] = prev
+
+    # table lowering (regex as a per-unique lookup table) for the three
+    # host build routes; dfa_match lowering for the in-jit route, whose
+    # bindings carry only the packed bytes + transition constants
+    lowered = _lower("off")
+    lowered_jit = _lower("on")
     table = ResourceTable()
     hexd = "0123456789abcdef"
     log(f"[regex-hicard] building {n} unique image strings")
@@ -2192,16 +2268,22 @@ def bench_regex_high_cardinality(detail):
     out = {"n_unique": n}
     saved = (regex_dfa.TABLE_MIN_UNIQUES, regex_dfa.TABLE_DEVICE_MIN_UNIQUES)
     try:
-        modes = [("host_re_loop", big, big), ("dfa_numpy", 1, big)]
+        modes = [("host_re_loop", lowered.spec, big, big),
+                 ("dfa_numpy", lowered.spec, 1, big)]
         if not FALLBACK:
-            modes.append(("dfa_device", 1, 1))
-        for mode, t_min, d_min in modes:
+            modes.append(("dfa_device", lowered.spec, 1, 1))
+        # in_jit: per-churn binding cost of the dfa_match route — the
+        # match itself runs as gathers inside the jitted sweep, so the
+        # rebuilt bindings are just the packed bytes + per-dfa fallback
+        # vector (no per-unique host re.search, no table)
+        modes.append(("in_jit", lowered_jit.spec, big, big))
+        for mode, spec, t_min, d_min in modes:
             regex_dfa.TABLE_MIN_UNIQUES = t_min
             regex_dfa.TABLE_DEVICE_MIN_UNIQUES = d_min
             times = []
             for _ in range(2):
                 t0 = time.perf_counter()
-                build_bindings(lowered.spec, table, cons)
+                build_bindings(spec, table, cons)
                 times.append(time.perf_counter() - t0)
             out[mode + "_seconds"] = round(min(times), 3)
             log(f"[regex-hicard] {mode}: {min(times):.3f}s "
@@ -2209,6 +2291,11 @@ def bench_regex_high_cardinality(detail):
     finally:
         regex_dfa.TABLE_MIN_UNIQUES, \
             regex_dfa.TABLE_DEVICE_MIN_UNIQUES = saved
+    hs, js = out.get("host_re_loop_seconds"), out.get("in_jit_seconds")
+    if hs and js:
+        out["in_jit_vs_host_loop"] = round(hs / max(js, 1e-9), 1)
+        log(f"[regex-hicard] in-jit DFA {out['in_jit_vs_host_loop']}x "
+            f"faster than host re loop at {n} uniques")
     detail["regex_high_cardinality"] = out
 
 

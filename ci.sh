@@ -177,10 +177,16 @@ assert warm["verdict_digest"] == cold["verdict_digest"], \
 assert warm["startup_seconds"] < 0.5 * cold["startup_seconds"], \
     f"warm startup {warm['startup_seconds']}s not < 50% of " \
     f"cold {cold['startup_seconds']}s"
+assert cold["dfa_compiles"] > 0, \
+    f"cold run never compiled a regex DFA (dfa lowering off?): {cold}"
+assert warm["dfa_compiles"] == 0, \
+    f"warm run recompiled DFAs instead of loading the dfa " \
+    f"snapshot tier: {warm}"
 print(f"restart smoke ok: startup cold {cold['startup_seconds']}s -> "
       f"warm {warm['startup_seconds']}s; "
       f"{warm['restart_persistent_cache_hits']} snapshot hits, "
-      f"0 re-lowerings, verdict digest {warm['verdict_digest']}")
+      f"0 re-lowerings, 0 DFA recompiles, "
+      f"verdict digest {warm['verdict_digest']}")
 EOF
 
 echo "== chaos (seeded 30s soak, admission + audit under faults) =="
@@ -223,9 +229,10 @@ import json
 # Parse ONLY the trailing 2,000 bytes — the capture window that erased
 # the round-5 number of record kept just a stdout tail, so the gate
 # must prove the headline survives one.  The slim headline contract
-# (bench.emit_headline) is ≤1,600 chars — grown one stanza per PR,
-# paged_churn took it past the old 1,500 — so it still fits the
-# 2,000-byte window whole with margin for trailing prints.
+# (bench.emit_headline) is ≤1,750 chars — grown one stanza per PR,
+# paged_churn took it past 1,500 and the regex row past 1,600 — so it
+# still fits the 2,000-byte window whole with margin for trailing
+# prints.
 raw = open("/tmp/bench.json", "rb").read()[-2000:].decode("utf-8", "replace")
 d = line = None
 for ln in reversed(raw.splitlines()):
@@ -238,7 +245,7 @@ for ln in reversed(raw.splitlines()):
     except ValueError:
         continue
 assert d is not None, f"no JSON headline in the trailing 2000 bytes: {raw!r}"
-assert len(line) <= 1600, f"headline is {len(line)} chars (> 1600)"
+assert len(line) <= 1750, f"headline is {len(line)} chars (> 1750)"
 assert d["metric"] and d["value"] > 0, d
 # the external_data row must survive the same tail window: the
 # cold/warm/baseline numbers are the PR's acceptance record
@@ -305,6 +312,17 @@ fs = d.get("fleet_stack")
 assert isinstance(fs, dict) and fs.get("parity") is True \
     and fs.get("clusters", 0) >= 4, \
     f"no 4-cluster fleet_stack parity row in the headline: {d}"
+# the regex row must survive the window: regex builtins lowered to the
+# in-program dfa_match op must be bit-identical to the
+# GATEKEEPER_DFA=off lookup-table oracle (sha256 verdict digest), and
+# the per-churn binding build must beat the per-unique host re.search
+# loop by >=10x at bench cardinality (the PR's acceptance record)
+rx = d.get("regex")
+assert isinstance(rx, dict) and rx.get("dfa_parity") is True \
+    and rx.get("parity_digest"), \
+    f"no regex row (with DFA-vs-table parity digest) in the headline: {d}"
+assert rx.get("in_jit_vs_host_loop", 0) >= 10, \
+    f"in-jit DFA not >=10x the host re loop: {d}"
 # the overload row must survive the window: open-loop replay at 2x the
 # measured saturation rate must degrade gracefully — the deny-path p99
 # stays under 5x the healthy (1x) p99, with sheds explicit
@@ -323,6 +341,7 @@ print("bench ok:", d["metric"], round(d["value"], 1), d["unit"],
       f"{sh['parity_digest']} with {sh['kinds_sharded']} kinds sharded; "
       f"shadow {ss.get('ratio')}x parity {ss.get('parity_digest')}; "
       f"fleet {fs.get('clusters')} clusters parity ok; overload 2x p99 "
-      f"{ov.get('p99_2x_ms')}ms within budget)")
+      f"{ov.get('p99_2x_ms')}ms within budget; regex "
+      f"{rx.get('in_jit_vs_host_loop')}x parity {rx.get('parity_digest')})")
 EOF
 echo "CI PASS"

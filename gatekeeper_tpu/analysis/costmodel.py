@@ -139,8 +139,8 @@ def node_axes(program) -> list[tuple[bool, bool, bool]]:
             ax = (True, True, True)
         elif op in ("any_e", "all_e", "count_e"):
             ax = (c, r, False)          # the element axis is reduced
-        else:   # table / cmp / and / or / not / arith: broadcast of args
-            ax = (c, r, e)
+        else:   # table / dfa_match / cmp / and / or / not / arith:
+            ax = (c, r, e)          # broadcast of args
         out.append(ax)
     return out
 
@@ -184,6 +184,18 @@ def _spec_h2d_bytes(spec, r_pad: int, c_pad: int, e_pad: int,
         if t.ext_providers:
             provider_tables += 1
             provider_bytes += tb
+    dfas = getattr(spec, "dfas", ())
+    if dfas:
+        from gatekeeper_tpu.ops.regex_dfa import MAX_DFA_STATES, cached_dfa
+        h2d += r_pad * (128 + 1)      # __strbytes__ [T, W] + __strdfaok__ [T]
+        for d in dfas:
+            dfa = cached_dfa(d.pattern)
+            n_states = len(dfa.accept) if dfa is not None else MAX_DFA_STATES
+            # .trans [S, 256] int32 + .accept [S] + .xv [T <= r_pad]:
+            # priced as table bytes so the install-time budget sees a
+            # state-count blowup the same way it sees a huge host table
+            tb = n_states * 256 * 4 + n_states + r_pad
+            table_bytes += tb
     h2d += table_bytes
     for _pt in spec.ptables:
         h2d += r_pad * 4 + c_pad * (set_len + 1)
@@ -224,8 +236,8 @@ def estimate(lowered, n_rows: int, n_constraints: int,
         n = program.nodes[i]
         op = n.op
         sz = cells(axes[i])
-        if op in ("table", "ptable_any", "ptable_all", "in_cset",
-                  "keyed_val"):
+        if op in ("table", "dfa_match", "ptable_any", "ptable_all",
+                  "in_cset", "keyed_val"):
             cv.gathers += sz
             cv.gather_volume_bytes += 4 * sz
         elif op == "cmp":
@@ -240,6 +252,13 @@ def estimate(lowered, n_rows: int, n_constraints: int,
             cv.matmul_flops += 2 * c_pad * set_len * r_pad
         elif op == "elem_keys_missing":
             cv.matmul_flops += 2 * c_pad * set_len * r_pad * e_pad
+    # the in-program DFA scan: each distinct dfa_match table is computed
+    # once per evaluation as max_str_len transition gathers over the
+    # whole interner (t_pad priced at r_pad, the same one-distinct-value
+    # -per-row upper bound unary tables use)
+    for _d in getattr(lowered.spec, "dfas", ()):
+        cv.gathers += r_pad * 128
+        cv.gather_volume_bytes += 4 * r_pad * 128
     for rule in program.rules:
         row = c_pad * r_pad * (e_pad if rule.elem_axis is not None else 1)
         cv.logicals += len(rule.conjuncts) * row   # conjunct AND chain

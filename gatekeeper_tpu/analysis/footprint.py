@@ -169,6 +169,7 @@ def _spec_sig(spec) -> tuple:
         tuple((t.name, t.src, t.out, t.src_val, t.regex, t.ext_providers)
               for t in spec.tables),
         tuple((p.name, p.src, p.src_val) for p in spec.ptables),
+        tuple((d.name, d.src, d.pattern) for d in getattr(spec, "dfas", ())),
         tuple((m.name, m.cset, m.keys_path) for m in spec.membs),
         tuple((k.name, k.path) for k in spec.keyed_vals),
         tuple((e.name, e.cset, e.axis) for e in spec.elem_keys),
@@ -252,6 +253,7 @@ def analyze(kind: str, lowered) -> Footprint:
     by_e = {e.name: e for e in spec.e_cols}
     by_t = {t.name: t for t in spec.tables}
     by_pt = {p.name: p for p in spec.ptables}
+    by_d = {d.name: d for d in getattr(spec, "dfas", ())}
     by_m = {m.name: m for m in spec.membs}
     by_kv = {k.name: k for k in spec.keyed_vals}
     by_ek = {e.name: e for e in spec.elem_keys}
@@ -306,6 +308,18 @@ def analyze(kind: str, lowered) -> Footprint:
                 record(src_keys, use)
                 keys = src_keys
                 providers.update(getattr(t, "ext_providers", ()))
+        elif op == "dfa_match":
+            # the in-program DFA reads the interned byte encoding of the
+            # source column: any change to the string's bytes can flip
+            # the verdict, so the claim is the column at string-regex
+            # sensitivity — exactly what the host-table lowering of the
+            # same pattern claims (parity keeps narrow-claim validation
+            # applicable to both paths)
+            d = by_d.get(n.meta[0])
+            if d is not None:
+                src_keys = _col_keys(d.src, spec, by_r, by_e)
+                record(src_keys, "string-regex")
+                keys = src_keys
         elif op == "keyed_val":
             (name,) = n.meta
             kv = by_kv.get(name)

@@ -45,7 +45,8 @@ VERIFY_VIOLATIONS = 0
 
 # arg-count per op (None = checked specially)
 _ARITY = {
-    "const": 0, "input": 0, "table": 1, "ptable_any": 1, "ptable_all": 1,
+    "const": 0, "input": 0, "table": 1, "dfa_match": 1,
+    "ptable_any": 1, "ptable_all": 1,
     "keyed_val": 0, "cmp": 2, "and": 2, "or": 2, "not": 1, "in_cset": 1,
     "cset_not_subset_memb": 0, "cset_subset_memb": 0,
     "elem_keys_missing": 0, "any_e": 1, "all_e": 1, "count_e": 1,
@@ -104,6 +105,7 @@ def verify_program(lowered, providers: "set[str] | None" = None,
     bindings = _spec_bindings(spec)
     tables = {t.name: t for t in spec.tables}
     ptables = {t.name: t for t in spec.ptables}
+    dfas = {d.name: d for d in getattr(spec, "dfas", ())}
     csets = {c.name for c in spec.csets}
     membs = {m.name for m in spec.membs}
     elem_keys = {ek.name for ek in spec.elem_keys}
@@ -192,6 +194,30 @@ def verify_program(lowered, providers: "set[str] | None" = None,
                                     f"node {i} (table {req.name}): "
                                     f"external-data tag {p!r} does not "
                                     "resolve to a declared provider")
+        elif n.op == "dfa_match":
+            if len(n.meta) != 1:
+                err("ir_shape_mismatch",
+                    f"node {i} (dfa_match): meta must be (dfa_name,), "
+                    f"got {n.meta!r}")
+            else:
+                req = dfas.get(n.meta[0])
+                if req is None:
+                    err("ir_dangling_ref",
+                        f"node {i} (dfa_match): dfa {n.meta[0]!r} has no "
+                        "DfaReq in the PrepSpec")
+                else:
+                    if not input_node_named(n.args[0], req.src):
+                        err("ir_shape_mismatch",
+                            f"node {i} (dfa_match {req.name}): gather "
+                            f"index is not the interned source column "
+                            f"{req.src!r}; in-bounds access cannot be "
+                            "proven")
+                    elif acls[0] != "id":
+                        err("ir_type_mismatch",
+                            f"node {i} (dfa_match {req.name}): index "
+                            f"operand must be an interned id column, got "
+                            f"{acls[0]}")
+            cls = "bool"
         elif n.op in ("ptable_any", "ptable_all"):
             if len(n.meta) != 2 or n.meta[0] != n.meta[1]:
                 err("ir_shape_mismatch",

@@ -66,8 +66,8 @@ class _Unshareable(Exception):
 # else (ptable_*, in_cset, cset_*_memb, elem_keys_missing, keyed_val)
 # is inherently per-constraint-parameter and never shared
 _SHAREABLE_OPS = frozenset({
-    "const", "input", "table", "cmp", "and", "or", "not", "arith",
-    "any_e", "all_e", "count_e",
+    "const", "input", "table", "dfa_match", "cmp", "and", "or", "not",
+    "arith", "any_e", "all_e", "count_e",
 })
 
 _SIMPLE_SCALARS = (str, int, float, bool, bytes, type(None))
@@ -122,6 +122,7 @@ def _spec_maps(spec) -> dict:
         "e": {ec.name: ec for ec in spec.e_cols},
         "cv": {cv.name: cv for cv in spec.cvals},
         "t": {t.name: t for t in spec.tables},
+        "d": {d.name: d for d in getattr(spec, "dfas", ())},
         "ij": {ij.name: ij for ij in spec.inv_joins},
     }
 
@@ -206,6 +207,14 @@ class _Canon:
             if fp is None:
                 raise _Unshareable()
             form = ("table", forms[0], t.out, t.src_val, t.regex, fp)
+        elif op == "dfa_match":
+            d = self.maps["d"].get(n.meta[0])
+            if d is None:
+                raise _Unshareable()
+            # fully determined by the source column + pattern: two
+            # templates matching the same regex over the same column
+            # share one devtab gather
+            form = ("dfa", forms[0], d.pattern)
         elif op in ("cmp", "arith"):
             form = (op, n.meta[0], forms[0], forms[1])
         elif op in ("and", "or"):
@@ -478,6 +487,24 @@ class _HostEval:
             ci = np.clip(idx, 0, None)
             return (d_i & self._arr(tname + ".ok")[ci],
                     self._arr(tname + ".v")[ci])
+        if op == "dfa_match":
+            from gatekeeper_tpu.ir.prep import _STR_PREFIX
+            (dname,) = n.meta
+            d_i, idx = self.node(n.args[0])
+            # the numpy twin of veval._dfa_device_table: scan the packed
+            # interner bytes through the transition table, trailing TERM
+            # step, host-fallback xv for device-ineligible ids
+            trans = self._arr(dname + ".trans")
+            payload = self._arr("__strbytes__")[:, len(_STR_PREFIX):]
+            payload = payload.astype(np.int64)
+            state = np.zeros((payload.shape[0],), dtype=np.int64)
+            for j in range(payload.shape[1]):
+                state = trans[state, payload[:, j]]
+            hit = self._arr(dname + ".accept")[trans[state, 0]]
+            devtab = np.where(self._arr("__strdfaok__"), hit,
+                              self._arr(dname + ".xv"))
+            v = devtab[np.clip(idx, 0, None)]
+            return d_i & v, v
         if op == "cmp":
             (cop,) = n.meta
             da, va = self.node(n.args[0])
@@ -690,6 +717,23 @@ def vet_template_cost(lowered, kind: str) -> list[Diagnostic]:
         Location(file=kind))]
 
 
+def dfa_subset_warnings(kind: str, lowered) -> list[Diagnostic]:
+    """regex_off_dfa findings: constant regex/glob patterns of the
+    template that stayed on the host lookup-table path, and why
+    (unsupported construct, DFA state blowup, or GATEKEEPER_DFA=off).
+    Informational — results are identical either way; only the
+    high-cardinality rebuild cost differs."""
+    out: list[Diagnostic] = []
+    for pattern, reason in getattr(lowered, "regex_offdfa", ()) or ():
+        out.append(Diagnostic(
+            "regex_off_dfa", WARNING,
+            f"pattern {pattern!r} is outside the in-program DFA subset "
+            f"({reason}); its matches run as a host lookup table, rebuilt "
+            f"per unique value on churn",
+            Location(file=kind)))
+    return out
+
+
 def duplicate_predicate_warnings(kind: str, lowered,
                                  others: dict) -> list[Diagnostic]:
     """set_duplicate_predicate findings: conjuncts of the new template
@@ -741,6 +785,7 @@ def analyze_policy_set(entries: list, n_rows: int = costmodel.REF_ROWS) -> dict:
         cv = costmodel.estimate(low, n_rows, max(len(cons), 1))
         costs[kind] = cv.as_dict()
     findings: list[Diagnostic] = []
+    dfa_lowering: dict[str, dict] = {}
     for kind, low, cons in entries:
         installed = [((c.get("metadata") or {}).get("name", ""), c)
                      for c in cons]
@@ -748,8 +793,19 @@ def analyze_policy_set(entries: list, n_rows: int = costmodel.REF_ROWS) -> dict:
             others = [(n, d) for n, d in installed if n != cname]
             findings.extend(
                 constraint_set_warnings(kind, cname, cdoc, others))
+        if low is not None:
+            n_dfa = len(getattr(low.spec, "dfas", ()))
+            off = list(getattr(low, "regex_offdfa", ()) or ())
+            if n_dfa or off:
+                dfa_lowering[kind] = {
+                    "in_program": n_dfa,
+                    "off_dfa": [{"pattern": p, "reason": r}
+                                for p, r in off],
+                }
+            findings.extend(dfa_subset_warnings(kind, low))
     return {
         "shared_subprograms": groups,
         "template_costs": costs,
+        "dfa_lowering": dfa_lowering,
         "findings": findings,
     }

@@ -202,8 +202,12 @@ def analyze(kind: str, lowered) -> PartitionPlan:
     binding_names = set(_FRAMEWORK_BINDINGS)
     for group in (spec.r_cols, spec.e_cols, spec.tables, spec.ptables,
                   spec.membs, spec.keyed_vals, spec.elem_keys,
-                  spec.inv_joins):
+                  spec.inv_joins, getattr(spec, "dfas", ())):
         binding_names.update(x.name for x in group)
+    if getattr(spec, "dfas", ()):
+        # in-program DFA framework arrays: the packed interner bytes and
+        # the device-eligibility mask ride along with every dfa table
+        binding_names.update(("__strbytes__", "__strdfaok__"))
     layout: list[tuple[str, tuple]] = []
     for name in sorted(binding_names):
         try:

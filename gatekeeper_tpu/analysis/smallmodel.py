@@ -137,8 +137,19 @@ class ModelPlan:
                 "values": sum(len(s.domain) for s in self.slots)}
 
 
+# DFA-boundary strings for the in-program regex lowering
+# (ops/regex_dfa): the empty string (start-state accept), the widest
+# device-eligible row (raw 124 bytes -> encoded 127 < max_str_len, the
+# trailing-terminator edge of the device scan), one byte past it
+# (encoded 128: ineligible, host-xv route-back), and a non-ASCII
+# payload (also routed back).  Deliberately NO trailing-newline
+# strings: `$` ~ `\Z` on the device is a documented deviation and a
+# counterexample here would pin every regex template to scalar.
+_DFA_EDGE_STRS = ("", "x" * 124, "y" * 125, "café-ü")
+
+
 def _str_domain(pool: LiteralPool) -> tuple:
-    return (ABSENT, *pool.strs, "zzz-novel", 7)
+    return (ABSENT, *pool.strs, "zzz-novel", 7, *_DFA_EDGE_STRS)
 
 
 def _num_domain(pool: LiteralPool) -> tuple:
@@ -151,7 +162,7 @@ def _num_domain(pool: LiteralPool) -> tuple:
 
 def _val_domain(pool: LiteralPool) -> tuple:
     return (ABSENT, *pool.strs[:3], *pool.nums[:2], False,
-            {"httpGet": {}})
+            {"httpGet": {}}, *_DFA_EDGE_STRS)
 
 
 _MODE_DOMAIN = {

@@ -490,6 +490,12 @@ def run_policyset() -> int:
               f"sites={g['sites']}: {', '.join(g['kinds'])}")
     for d in report["findings"]:
         print("  " + d.format())
+    # Stage-6 regex-lowering verdicts: which constant patterns run as
+    # in-program DFAs vs host lookup tables (regex_off_dfa findings
+    # above carry the per-pattern reasons)
+    for kind, info in sorted(report.get("dfa_lowering", {}).items()):
+        print(f"  dfa {kind}: {info['in_program']} in-program, "
+              f"{len(info['off_dfa'])} host-table")
     # Stage-5 row-locality verdicts: cross-row templates are shard_map
     # ineligible and excluded from footprint-driven selective
     # invalidation

@@ -289,13 +289,17 @@ class ReconcileConstraintTemplate(Reconciler):
         program and no device cost to gate."""
         from gatekeeper_tpu.analysis import costmodel, has_errors
         from gatekeeper_tpu.analysis.policyset import (
-            duplicate_predicate_warnings, vet_template_cost)
+            dfa_subset_warnings, duplicate_predicate_warnings,
+            vet_template_cost)
         from gatekeeper_tpu.errors import VetError
 
         lowered = self._lower_instance(instance)
         if lowered is None:
             return
         diags = vet_template_cost(lowered, kind)
+        # regex_off_dfa: constant patterns this template matches through
+        # host lookup tables instead of the in-program DFA, and why
+        diags.extend(dfa_subset_warnings(kind, lowered))
         others = {}
         for st in (getattr(self.client.driver, "state", None) or {}).values():
             for okind, compiled in getattr(st, "templates", {}).items():

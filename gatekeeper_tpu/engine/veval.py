@@ -30,7 +30,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from gatekeeper_tpu.ir.prep import Bindings, binding_axes
+from gatekeeper_tpu.ir.prep import _STR_PREFIX, Bindings, binding_axes
 from gatekeeper_tpu.ir.program import Node, Program, RuleSpec
 
 _3D = (1, 1, 1)
@@ -224,11 +224,65 @@ def _to3(a: jax.Array, axes: str) -> jax.Array:
     raise ValueError(axes)
 
 
+def _dfa_device_table(arrays: dict, dname: str) -> jax.Array:
+    """Per-interned-id regex verdicts [t_pad] bool, computed on device:
+    a ``lax.scan`` of gathers runs the bound [S, 256] transition table
+    over the interner's packed byte matrix (prefix bytes skipped — val
+    columns hold encoded strings, ir/encode).  One trailing TERM step
+    after the scan keeps ``$`` exact for strings that fill the row
+    width (mirrors pack_strings' [U, L+1] terminator column).  Ids the
+    byte rows cannot represent exactly take the host-oracle fallback
+    ``.xv`` — never an approximation."""
+    # asarray: eager callers (transval, explain, delta slices) hand in
+    # numpy arrays, and numpy's fancy indexing would call __array__ on
+    # the scan tracer; inside jit these are no-ops on device arrays
+    trans = jnp.asarray(arrays[dname + ".trans"])
+    accept = jnp.asarray(arrays[dname + ".accept"])
+    payload = jnp.asarray(
+        arrays["__strbytes__"])[:, len(_STR_PREFIX):].astype(jnp.int32)
+
+    def step(state, col):
+        return trans[state, col], None
+
+    init = jnp.zeros((payload.shape[0],), dtype=jnp.int32)
+    state, _ = jax.lax.scan(step, init, payload.T)
+    hit = accept[trans[state, 0]]
+    return jnp.where(jnp.asarray(arrays["__strdfaok__"]), hit,
+                     jnp.asarray(arrays[dname + ".xv"]))
+
+
+def _with_dfa_tables(program: Program,
+                     d: dict[str, jax.Array]) -> dict[str, jax.Array]:
+    """Precompute every ``dfa_match`` verdict table once per evaluation
+    (into a COPY of the arrays dict, keyed ``<name>.devtab``): the
+    chunked mask/top-k paths would otherwise re-run the byte scan in
+    every lax.scan chunk body."""
+    names = sorted({n.meta[0] for n in program.nodes
+                    if n.op == "dfa_match"})
+    if not names:
+        return d
+    d = dict(d)
+    for nm in names:
+        if nm + ".devtab" not in d:
+            d[nm + ".devtab"] = _dfa_device_table(d, nm)
+    return d
+
+
 class _Evaluator:
     def __init__(self, program: Program, arrays: dict[str, jax.Array]):
         self.p = program
         self.arrays = arrays
         self.cache: dict[int, tuple[jax.Array, jax.Array]] = {}
+        self.dfa_memo: dict[str, jax.Array] = {}
+
+    def _dfa_devtab(self, dname: str) -> jax.Array:
+        tab = self.arrays.get(dname + ".devtab")
+        if tab is None:                  # eager paths (transval,
+            tab = self.dfa_memo.get(dname)   # explain, delta slices)
+            if tab is None:
+                tab = _dfa_device_table(self.arrays, dname)
+                self.dfa_memo[dname] = tab
+        return tab
 
     def node(self, i: int) -> tuple[jax.Array, jax.Array]:
         hit = self.cache.get(i)
@@ -262,6 +316,14 @@ class _Evaluator:
             ok = self.arrays[tname + ".ok"][ci]
             val = self.arrays[tname + ".v"][ci]
             return d_i & ok, val
+        if op == "dfa_match":
+            # in-program regex: one gather into the per-id verdict
+            # table.  Verdict doubles as the defined bit exactly like
+            # the bool-table route (`ok` encodes defined AND truthy).
+            (dname,) = n.meta
+            d_i, idx = self.node(n.args[0])
+            v = self._dfa_devtab(dname)[jnp.clip(idx, 0, None)]
+            return d_i & v, v
         if op in ("ptable_any", "ptable_all"):
             # pre-combined per-constraint table (ir/prep.py): one gather,
             # no [C, K, R, E] per-param axis on device
@@ -464,6 +526,7 @@ def _n_chunks(r_pad: int) -> int:
 
 def _eval_mask(program: Program, d: dict[str, jax.Array]) -> jax.Array:
     """Full violation mask [C, R], chunked over R when large."""
+    d = _with_dfa_tables(program, d)
     r_pad = d["__alive__"].shape[0]
     c_pad = d["__cvalid__"].shape[0]
     nc = _n_chunks(r_pad)
@@ -488,6 +551,7 @@ def _eval_topk(program: Program, d: dict[str, jax.Array], k: int,
     comparable across chunks AND across shards: inside shard_map pass
     the GLOBAL r_pad as score_base (the sharded ``__rank__`` carries
     global ranks that can exceed the local slice length)."""
+    d = _with_dfa_tables(program, d)
     r_pad = d["__alive__"].shape[0]
     c_pad = d["__cvalid__"].shape[0]
     base_score = score_base if score_base is not None else r_pad

@@ -48,9 +48,10 @@ import itertools
 from typing import Any, Callable
 
 from gatekeeper_tpu.ir.prep import (
-    CSetReq, CValReq, EColReq, ElemKeysReq, InvJoinReq, KeyedValReq, MembReq,
-    PrepSpec, PTableReq, RColReq, TableReq)
+    CSetReq, CValReq, DfaReq, EColReq, ElemKeysReq, InvJoinReq, KeyedValReq,
+    MembReq, PrepSpec, PTableReq, RColReq, TableReq)
 from gatekeeper_tpu.ir.program import CMP_OPS, Node, Program, RuleSpec
+from gatekeeper_tpu.ops import regex_dfa
 from gatekeeper_tpu.rego import builtins as bi
 from gatekeeper_tpu.rego.ast_nodes import (
     ArrayTerm, Assign, BinOp, Call, Compare, Comprehension, Literal, Module,
@@ -206,6 +207,12 @@ class LoweredProgram:
     spec: PrepSpec
     n_rules_total: int
     n_rules_lowered: int
+    # constant regex/glob patterns this template evaluates that fell
+    # outside the in-program DFA subset (or had the lowering disabled):
+    # ((pattern, reason), ...) — surfaced by probe --policyset and the
+    # reconciler's status warnings.  Defaulted so pickled IR snapshots
+    # from before the field existed still load.
+    regex_offdfa: tuple = ()
 
 
 # ---------------------------------------------------------------------------
@@ -329,6 +336,8 @@ class Lowerer:
         self.e_reqs: list[EColReq] = []
         self.tables: list[TableReq] = []
         self.ptables: list[PTableReq] = []
+        self.dfas: dict[tuple[str, str], DfaReq] = {}   # (src, pattern) ->
+        self.regex_offdfa: dict[str, str] = {}          # pattern -> reason
         self.csets: list[CSetReq] = []
         self.cvals: list[CValReq] = []
         self.membs: list[MembReq] = []
@@ -379,10 +388,12 @@ class Lowerer:
             membs=tuple(self.membs), elem_keys=tuple(self.elem_keys),
             keyed_vals=tuple(self.keyed_vals),
             inv_joins=tuple(self.spec_inv_joins),
+            dfas=tuple(self.dfas.values()),
             cvalid_fns=tuple(self.cvalid_fns))
         return LoweredProgram(
             program=Program(nodes=tuple(self.nodes), rules=tuple(self.rules_out)),
-            spec=spec, n_rules_total=n_total, n_rules_lowered=len(self.rules_out))
+            spec=spec, n_rules_total=n_total, n_rules_lowered=len(self.rules_out),
+            regex_offdfa=tuple(sorted(self.regex_offdfa.items())))
 
     # -- node emission -------------------------------------------------
 
@@ -876,25 +887,70 @@ class Lowerer:
                 return v
             return None
 
-        # pure re_match(<const>, leaf): mark the pattern so prep can
-        # route high-cardinality builds through the batched DFA engine
-        # (ops/regex_dfa) instead of one Python re.search per distinct
-        # string (topdown/regex.go semantics either way)
-        regex = None
-        if out == "bool" and isinstance(term, Call) \
-                and term.name in (("re_match",), ("regex", "match")) \
-                and len(term.args) == 2 \
-                and isinstance(term.args[0], Scalar) \
-                and isinstance(term.args[0].value, str) \
-                and isinstance(term.args[1], Var) \
-                and term.args[1].name == "__leaf0__":
-            regex = term.args[0].value
+        # pure re_match(<const>, leaf) / glob.match(<const>, <const>, leaf):
+        # extract the constant pattern.  When GATEKEEPER_DFA is on and the
+        # pattern compiles (ops/regex_dfa subset), skip the host lookup
+        # table entirely — emit a dfa_match node whose [S, 256] transition
+        # table scans the interner's packed byte matrix inside the jitted
+        # sweep (no per-unique-value host loop, no table rebuild on
+        # churn).  Otherwise mark the TableReq so prep can still route
+        # high-cardinality builds through the batched DFA engine
+        # (topdown/regex.go semantics either way).
+        regex = self._regex_pattern(term) if out == "bool" else None
+        if regex is not None and regex_dfa.dfa_enabled():
+            key = (src, regex)
+            req = self.dfas.get(key)
+            if req is None and regex_dfa.cached_dfa(regex) is not None:
+                req = DfaReq(f"dfa{len(self.dfas)}", src, regex)
+                self.dfas[key] = req
+            if req is not None:
+                idx = self._emit_leaf(sym.leaf, "val")
+                return self._emit("dfa_match", (idx,), (req.name,))
+            self.regex_offdfa.setdefault(
+                regex,
+                regex_dfa.unsupported_reason(regex) or "outside DFA subset")
+        elif regex is not None:
+            self.regex_offdfa.setdefault(regex, "GATEKEEPER_DFA=off")
         self.tables.append(TableReq(tname, src, fn, out=out, src_val=True,
                                     regex=regex,
                                     ext_providers=self._collect_ext_providers(
                                         term)))
         idx = self._emit_leaf(sym.leaf, "val")
         return self._emit("table", (idx,), (tname,))
+
+    def _regex_pattern(self, term: Term) -> str | None:
+        """The constant regex this boolean leaf term applies to
+        ``__leaf0__``, if it is exactly one regex-shaped builtin call:
+        ``re_match``/``regex.match`` directly, ``glob.match`` with
+        constant delimiters via ``_glob_to_regex`` (the translation is
+        ``\\A..\\Z``-anchored, so search and match semantics coincide and
+        the TableReq ``regex=`` batch path stays sound on fallback)."""
+        if not isinstance(term, Call):
+            return None
+        if term.name in (("re_match",), ("regex", "match")) \
+                and len(term.args) == 2 \
+                and isinstance(term.args[0], Scalar) \
+                and isinstance(term.args[0].value, str) \
+                and isinstance(term.args[1], Var) \
+                and term.args[1].name == "__leaf0__":
+            return term.args[0].value
+        if term.name == ("glob", "match") and len(term.args) == 3 \
+                and isinstance(term.args[0], Scalar) \
+                and isinstance(term.args[0].value, str) \
+                and isinstance(term.args[2], Var) \
+                and term.args[2].name == "__leaf0__":
+            d = term.args[1]
+            if isinstance(d, Scalar) and d.value is None:
+                delims: tuple[str, ...] | None = (".",)
+            elif isinstance(d, ArrayTerm) and all(
+                    isinstance(it, Scalar) and isinstance(it.value, str)
+                    for it in d.items):
+                delims = tuple(it.value for it in d.items)
+            else:
+                delims = None          # dynamic delimiters: host path
+            if delims is not None:
+                return bi._glob_to_regex(term.args[0].value, delims)
+        return None
 
     def _ptable_node(self, leaf: LeafId, pred_term: Term, pvar: str,
                      iter_term: Term, iter_env: tuple[str, ...],

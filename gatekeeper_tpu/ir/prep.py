@@ -98,6 +98,14 @@ def binding_axes(name: str) -> tuple:
         return ("r",)                            # inventory join bool [R]
     if base.startswith("t") and base[1:].isdigit():
         return (None,)                           # unary table [T]
+    if name == "__strbytes__":
+        return (None, None)                      # interner bytes [T, W]
+    if name == "__strdfaok__":
+        return (None,)                           # device-DFA eligible [T]
+    if base.startswith("dfa") and base[3:].isdigit():
+        if name.endswith(".trans"):
+            return (None, None)                  # DFA table [S, 256]
+        return (None,)                           # .accept [S] / .xv [T]
     if name.startswith("__shared_e__:"):
         return ("r", None)                       # dedup-injected [R, E]
     if name.startswith("__shared__:"):
@@ -173,6 +181,25 @@ class TableReq:
     src_val: bool = False
     regex: str | None = None
     ext_providers: tuple[str, ...] = ()
+
+
+@dataclasses.dataclass(frozen=True)
+class DfaReq:
+    """In-program device regex (the ``dfa_match`` op): the compiled
+    byte DFA of ``pattern`` (ops/regex_dfa) bound as program constants
+    — ``.trans`` [S, 256] int32 and ``.accept`` [S] bool — plus a host
+    fallback vector ``.xv`` [t_pad] bool for interned ids the device
+    scan cannot represent exactly (non-ASCII, embedded NUL, rows
+    truncated at the interner width).  src names a val-mode id column;
+    matching gathers through the shared ``__strbytes__`` packed byte
+    matrix inside the jitted program — no per-unique-value host loop,
+    no table rebuild on churn.  Unlike TableReq this request is
+    fn-free, so it hashes/pickles and participates in spec signatures
+    and snapshots directly."""
+
+    name: str
+    src: str
+    pattern: str
 
 
 @dataclasses.dataclass(frozen=True)
@@ -293,6 +320,7 @@ class PrepSpec:
     elem_keys: tuple[ElemKeysReq, ...] = ()
     keyed_vals: tuple[KeyedValReq, ...] = ()
     inv_joins: tuple[InvJoinReq, ...] = ()
+    dfas: tuple[DfaReq, ...] = ()
     # constraint-only conjuncts, folded into one validity vector
     cvalid_fns: tuple[Callable[[dict], bool], ...] = ()
 
@@ -440,6 +468,50 @@ def _f32_exact(a) -> bool:
     with np.errstate(invalid="ignore", over="ignore"):
         rt = a.astype(np.float32).astype(np.float64)
         return bool(np.all(np.isnan(a) | (a == rt)))
+
+
+_STR_PREFIX = b"\x00s:"
+"""Interned byte image of an encoded string value (ir/encode:
+``_P + _ser(str)`` = NUL + "s:" + raw).  The device DFA scan skips
+these 3 prefix bytes; only ids carrying the prefix can ever appear in
+a val-mode source column, so everything else is vacuously False."""
+
+
+def _dfa_eligible(mat: np.ndarray, lens: np.ndarray, max_len: int):
+    """(eligible, str_prefixed) bool [n] over interner byte rows.
+
+    eligible: the row is an exact NUL-free ASCII image of an encoded
+    string — the in-jit DFA scan over ``__strbytes__`` reproduces the
+    host ``re.search`` bit-for-bit.  str_prefixed but not eligible
+    (non-ASCII payload, embedded NUL, or a row at the width cap that
+    may be truncated): the per-dfa host fallback vector ``.xv`` serves
+    those few ids instead."""
+    if mat.shape[0] == 0:
+        z = np.zeros((0,), dtype=bool)
+        return z, z
+    pref = ((lens >= 3) & (mat[:, 0] == _STR_PREFIX[0])
+            & (mat[:, 1] == _STR_PREFIX[1]) & (mat[:, 2] == _STR_PREFIX[2]))
+    payload = mat[:, 3:]
+    ascii_ok = (payload <= 127).all(axis=1)
+    no_nul = (payload != 0).sum(axis=1, dtype=np.int64) == (lens - 3)
+    return pref & ascii_ok & no_nul & (lens < max_len), pref
+
+
+def _dfa_xv_fill(pattern: str, interner, xv: np.ndarray,
+                 host_ids: np.ndarray) -> None:
+    """Host-oracle verdicts for the device-ineligible string ids (the
+    exact fallback _regex_table_batch uses for packer-rejected
+    entries).  Non-string decodes stay False — a val column id that
+    decodes to a non-string makes ``re_match`` undefined, and False
+    collapses identically through the fires lattice."""
+    if not len(host_ids):
+        return
+    import re
+    rx = re.compile(pattern)
+    for uid in host_ids.tolist():
+        arg = decode_value(interner.string(uid))
+        if isinstance(arg, str):
+            xv[uid] = rx.search(arg) is not None
 
 
 def _regex_table_batch(tr, uids: list, interner, ok, vals) -> bool:
@@ -949,6 +1021,34 @@ def build_bindings(spec: PrepSpec, table: ResourceTable,
         cvalid[ci] = ok
     out["__cvalid__"] = cvalid
 
+    # ---- in-program regex DFAs (built LAST: every section above may
+    # intern, and the byte matrix must cover the final interner)
+    if spec.dfas:
+        from gatekeeper_tpu.ops import regex_dfa
+        mat, lens = interner.bytes_table()
+        t_pad = bucket(len(interner), minimum=8)
+        sb = np.zeros((t_pad, interner.max_str_len), dtype=np.uint8)
+        sb[: mat.shape[0]] = mat
+        elig, prefixed = _dfa_eligible(mat, lens, interner.max_str_len)
+        okv = np.zeros((t_pad,), dtype=bool)
+        okv[: len(elig)] = elig
+        out["__strbytes__"] = sb
+        out["__strdfaok__"] = okv
+        host_ids = np.nonzero(prefixed & ~elig)[0]
+        for dr in spec.dfas:
+            dfa = regex_dfa.cached_dfa(dr.pattern)
+            if dfa is None:      # lowering only emits dfa_match for
+                # compilable patterns; hitting this means version skew
+                raise ValueError(
+                    f"dfa_match binding {dr.name}: pattern "
+                    f"{dr.pattern!r} no longer DFA-compilable")
+            xv = np.zeros((t_pad,), dtype=bool)
+            _dfa_xv_fill(dr.pattern, interner, xv, host_ids)
+            out[dr.name + ".trans"] = dfa.trans
+            out[dr.name + ".accept"] = np.asarray(dfa.accept, dtype=bool)
+            out[dr.name + ".xv"] = xv
+        state["dfa_size"] = len(interner)
+
     return Bindings(arrays=out, n_constraints=n_con, n_resources=n,
                     c_pad=c_pad, r_pad=r_pad, e_pads=e_pads,
                     delta_state=state, f32_unsafe=f32_unsafe)
@@ -1279,6 +1379,35 @@ def update_bindings(spec: PrepSpec, table: ResourceTable,
         if len(changed):
             out[ij.name] = new_col
             base_dirty[ij.name] = changed
+
+    # ---- in-program regex DFAs: append byte rows + fallback verdicts
+    # for ids interned since prev (existing rows never change, so the
+    # row-sliced delta plan stays sound — append_only, not base_dirty).
+    # Runs LAST among the interning sections for the same reason the
+    # full build does: the byte matrix must cover the final interner.
+    if spec.dfas:
+        old_sz = st0.get("dfa_size", 0)
+        new_sz = len(interner)
+        t_pad = out["__strdfaok__"].shape[0]
+        if new_sz > t_pad:
+            return None                  # interner outgrew the bucket
+        if new_sz > old_sz:
+            mat, lens = interner.bytes_table()
+            sub_e, sub_p = _dfa_eligible(mat[old_sz:new_sz],
+                                         lens[old_sz:new_sz],
+                                         interner.max_str_len)
+            sb = out["__strbytes__"] = out["__strbytes__"].copy()
+            okv = out["__strdfaok__"] = out["__strdfaok__"].copy()
+            sb[old_sz:new_sz] = mat[old_sz:new_sz]
+            okv[old_sz:new_sz] = sub_e
+            append_only.update(("__strbytes__", "__strdfaok__"))
+            host_ids = old_sz + np.nonzero(sub_p & ~sub_e)[0]
+            if len(host_ids):
+                for dr in spec.dfas:
+                    xv = out[dr.name + ".xv"] = out[dr.name + ".xv"].copy()
+                    append_only.add(dr.name + ".xv")
+                    _dfa_xv_fill(dr.pattern, interner, xv, host_ids)
+        state["dfa_size"] = new_sz
 
     # validity: every table-indexed array must still cover the interner
     # (late interning past the bucket would alias clamped device gathers)

@@ -43,6 +43,10 @@ from typing import Any
 #                                          | 'e_id' | 'e_num' | 'e_bool'
 #                                          | 'c_id' | 'c_num' | 'c_bool'
 #   table        args=(idx,) meta=(table_name,)        unary host table
+#   dfa_match    args=(idx,) meta=(dfa_name,)   regex as in-program byte
+#                  DFA: idx is an interned val-mode string-id column; the
+#                  bound [S, 256] transition table scans the interner's
+#                  packed byte matrix on device (no host table rebuild)
 #   ptable_any   args=(idx,) meta=(table_name, cset_name)
 #                  any over the constraint's param-set of tbl[p, idx]
 #   ptable_all   args=(idx,) meta=(table_name, cset_name)

@@ -49,15 +49,67 @@ TERM = 0                    # per-string terminator byte (strings are NUL-free)
 TABLE_MIN_UNIQUES = 4096
 TABLE_DEVICE_MIN_UNIQUES = 262144
 
+DFA_VERSION = 1
+"""Automaton format/semantics version.  Bump whenever compile_dfa's
+output for a given pattern can change — snapshot entries are keyed by
+(pattern, DFA_VERSION), so a stale persisted table can never serve a
+newer engine."""
+
 _dfa_cache: dict = {}
+DFA_CACHE_MAX = 1024
+"""In-process memo bound: patterns come from installed templates (a few
+hundred at most), but probe/what-if tooling can sweep arbitrary
+candidate patterns through ``cached_dfa`` — evict oldest-inserted past
+the cap instead of growing without bound."""
+
+compiles_run = 0
+"""Process-wide count of actual ``compile_dfa`` executions (memo and
+snapshot hits excluded) — the restart-smoke stage asserts this stays 0
+on a warm start, like transval.validations_run for certificates."""
+
+
+def dfa_enabled() -> bool:
+    """``GATEKEEPER_DFA`` gate for the in-program lowering (ir/lower.py
+    emitting ``dfa_match`` nodes).  Default on; ``off``/``0``/``false``
+    keeps the host lookup-table path as a bit-identical parity oracle —
+    the same graduation contract as ``GATEKEEPER_PAGES``."""
+    import os
+    return os.environ.get("GATEKEEPER_DFA", "on").strip().lower() not in (
+        "off", "0", "false")
+
+
+def dfa_digest(pattern: str) -> str:
+    import hashlib
+    return hashlib.sha256(
+        f"dfa-v{DFA_VERSION}\x00{pattern}".encode()).hexdigest()[:24]
 
 
 def cached_dfa(pattern: str):
-    '''compile_dfa with a process-wide memo (None results cached too:
-    unsupported patterns should not re-parse per rebuild).'''
-    if pattern not in _dfa_cache:
-        _dfa_cache[pattern] = compile_dfa(pattern)
-    return _dfa_cache[pattern]
+    """compile_dfa with a bounded process-wide memo (None results
+    cached too: unsupported patterns should not re-parse per rebuild)
+    backed by the snapshot tier: a warm restart loads every compiled
+    table (or negative certificate) instead of re-running subset
+    construction."""
+    global compiles_run
+    if pattern in _dfa_cache:
+        return _dfa_cache[pattern]
+    from gatekeeper_tpu.resilience import snapshot
+    dfa = None
+    got = snapshot.load_dfa(dfa_digest(pattern)) if snapshot.enabled() \
+        else None
+    if got is not None:
+        (dfa,) = got
+        if dfa is not None and not isinstance(dfa, DFA):
+            dfa, got = None, None           # foreign payload: recompile
+    if got is None:
+        compiles_run += 1
+        dfa = compile_dfa(pattern)
+        if snapshot.enabled():
+            snapshot.save_dfa(dfa_digest(pattern), dfa)
+    while len(_dfa_cache) >= DFA_CACHE_MAX:
+        _dfa_cache.pop(next(iter(_dfa_cache)))
+    _dfa_cache[pattern] = dfa
+    return dfa
 MAX_NFA_STATES = 512
 MAX_DFA_STATES = 1024
 MAX_REPEAT_EXPAND = 64
@@ -172,7 +224,10 @@ def _build(nfa: _NFA, tokens, start: int, end: int,
         elif op is _sre.AT:
             # ^ is handled at compile_dfa level (leading token only):
             # a restart edge to a post-^ state would un-anchor it
-            if arg is _sre.AT_END:
+            if arg in (_sre.AT_END, _sre.AT_END_STRING):
+                # `$` ≈ `\Z`: both consume the NUL terminator (known
+                # deviation: `$` before a trailing newline is treated
+                # as \Z — k8s identifier fields never end in \n)
                 nfa.add_edge(cur, frozenset((TERM,)), nxt)
             else:
                 raise _Unsupported(f"anchor {arg}")
@@ -218,24 +273,22 @@ def _build(nfa: _NFA, tokens, start: int, end: int,
         cur = nxt
 
 
-def compile_dfa(pattern: str) -> DFA | None:
-    """Compile to a byte DFA with unanchored-search semantics, or None
-    when the pattern falls outside the supported subset."""
+def _compile(pattern: str) -> DFA:
+    """Compile to a byte DFA with unanchored-search semantics; raises
+    ``_Unsupported`` (with a human-readable reason) when the pattern
+    falls outside the supported subset."""
     try:
         parsed = _sre_parse.parse(pattern)
-    except Exception:
-        return None
+    except Exception as e:                  # noqa: BLE001 - sre raises re.error
+        raise _Unsupported(f"unparseable: {e}") from None
     tokens = list(parsed)
     anchored_left = bool(tokens) and tokens[0][0] is _sre.AT \
-        and tokens[0][1] is _sre.AT_BEGINNING
+        and tokens[0][1] in (_sre.AT_BEGINNING, _sre.AT_BEGINNING_STRING)
     if anchored_left:
         tokens = tokens[1:]
-    try:
-        nfa = _NFA()
-        start, end = nfa.state(), nfa.state()
-        _build(nfa, tokens, start, end, at_start=True)
-    except _Unsupported:
-        return None
+    nfa = _NFA()
+    start, end = nfa.state(), nfa.state()
+    _build(nfa, tokens, start, end, at_start=True)
 
     def closure(states: frozenset) -> frozenset:
         stack, seen = list(states), set(states)
@@ -282,13 +335,33 @@ def compile_dfa(pattern: str) -> DFA | None:
             nxt = closure(nxt)
             if nxt not in dfa_states:
                 if len(dfa_states) >= MAX_DFA_STATES:
-                    return None
+                    raise _Unsupported("too many DFA states")
                 dfa_states[nxt] = len(order)
                 order.append(nxt)
             row[b] = dfa_states[nxt]
         trans_rows.append(row)
     return DFA(trans=np.stack(trans_rows), accept=np.asarray(accept),
                start=0, pattern=pattern)
+
+
+def compile_dfa(pattern: str) -> DFA | None:
+    """``_compile`` with the reason swallowed: None means "keep the
+    per-value host path" (never an error)."""
+    try:
+        return _compile(pattern)
+    except _Unsupported:
+        return None
+
+
+def unsupported_reason(pattern: str) -> str | None:
+    """Why ``pattern`` is outside the DFA subset, or None when it
+    compiles.  Diagnostic-path only (probe --policyset, reconciler
+    status warnings) — runs a full compile, no memo."""
+    try:
+        _compile(pattern)
+        return None
+    except _Unsupported as e:
+        return str(e)
 
 
 def pack_strings(strings, max_len: int | None = None):

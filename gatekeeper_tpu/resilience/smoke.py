@@ -65,6 +65,7 @@ def main() -> int:
     # identical for cold and warm processes and would only dilute the
     # startup ratio the smoke stage asserts on
     from gatekeeper_tpu.analysis import footprint, shardplan, transval
+    from gatekeeper_tpu.ops import regex_dfa
     from gatekeeper_tpu.client.client import Backend
     from gatekeeper_tpu.client.interface import QueryOpts
     from gatekeeper_tpu.engine import jax_driver as jd_mod
@@ -124,6 +125,7 @@ def main() -> int:
         "validations": transval.validations_run,
         "footprints": footprint.analyses_run,
         "shardplans": shardplan.analyses_run,
+        "dfa_compiles": regex_dfa.compiles_run,
     }
     print(json.dumps(out))
     return 0

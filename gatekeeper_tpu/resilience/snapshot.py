@@ -71,7 +71,8 @@ class SnapshotStats:
                "cert_hits", "cert_misses",
                "fp_hits", "fp_misses",
                "sp_hits", "sp_misses",
-               "pg_hits", "pg_misses", "corrupt_discarded",
+               "pg_hits", "pg_misses",
+               "dfa_hits", "dfa_misses", "corrupt_discarded",
                "saves", "save_errors")
 
     def __init__(self):
@@ -275,8 +276,14 @@ def _read_entry(category: str, key: str, root: str | None = None):
 # typed entry points
 
 def template_digest(kind: str, target: str, source: str) -> str:
+    # GATEKEEPER_DFA changes what lower() emits (dfa_match nodes vs host
+    # lookup tables), so IR entries must never cross flag modes — fold
+    # the mode into the digest rather than the VERSION so flipping the
+    # flag back and forth reuses both snapshot populations.
+    from gatekeeper_tpu.ops.regex_dfa import dfa_enabled
+    mode = "dfa" if dfa_enabled() else "nodfa"
     h = hashlib.sha256(
-        f"{kind}\x00{target}\x00{source}\x00v{VERSION}".encode())
+        f"{kind}\x00{target}\x00{source}\x00v{VERSION}\x00{mode}".encode())
     return h.hexdigest()[:24]
 
 
@@ -435,6 +442,32 @@ def save_shardplan(digest: str, plan) -> bool:
     return _write_entry("sp", f"sp:{digest}", payload)
 
 
+def load_dfa(digest: str):
+    """Eighth tier: compiled regex byte-DFA tables (ops/regex_dfa),
+    keyed by the pattern + DFA_VERSION digest.  A warm restart that
+    reuses the snapshotted lowered IR also reuses its DFA tables, so
+    it compiles zero automata (smoke's ``dfa_compiles`` == 0 warm).
+    A hit may carry None — a negative certificate for a pattern known
+    to fall outside the supported subset (skip the compile attempt)."""
+    if not enabled():
+        return None
+    got = _read_entry("dfa", f"dfa:{digest}")
+    stats.bump("dfa_hits" if got is not None else "dfa_misses")
+    return got
+
+
+def save_dfa(digest: str, dfa) -> bool:
+    if not enabled():
+        return False
+    try:
+        payload = dumps(dfa)
+    except Exception as e:   # noqa: BLE001
+        stats.bump("save_errors")
+        _log.warning("dfa table not snapshottable", error=e)
+        return False
+    return _write_entry("dfa", f"dfa:{digest}", payload)
+
+
 def load_store(target: str, root: str | None = None):
     """Load the store tier.  With ``root``, read from that snapshot
     root explicitly (a *historical* snapshot directory, independent of
@@ -492,11 +525,11 @@ def tier_counts(s: dict) -> tuple[int, int]:
     hits = (s["ir_hits"] + s["mod_hits"] + s["plan_hits"]
             + s["store_hits"] + s.get("cert_hits", 0)
             + s.get("fp_hits", 0) + s.get("sp_hits", 0)
-            + s.get("pg_hits", 0))
+            + s.get("pg_hits", 0) + s.get("dfa_hits", 0))
     misses = (s["ir_misses"] + s["mod_misses"] + s["plan_misses"]
               + s["store_misses"] + s.get("cert_misses", 0)
               + s.get("fp_misses", 0) + s.get("sp_misses", 0)
-              + s.get("pg_misses", 0))
+              + s.get("pg_misses", 0) + s.get("dfa_misses", 0))
     return hits, misses
 
 

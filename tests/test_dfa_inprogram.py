@@ -1,0 +1,346 @@
+"""In-program device regex: the ``dfa_match`` IR op.
+
+Covers the lowering route (``re_match``/``regex.match`` and constant
+``glob.match`` emit ``dfa_match`` when the pattern is inside the DFA
+subset, keep the lookup table otherwise, and record WHY in
+``regex_offdfa``), bit-identical verdict parity of the in-jit gather
+engine against the ``GATEKEEPER_DFA=off`` lookup-table oracle under
+seeded 4-round churn with ``GATEKEEPER_PAGES=on`` (regex templates
+stay page-eligible — ISSUE 16's acceptance), the Stage-5 footprint's
+byte-column claim (the dfa lowering claims exactly the same source
+columns as the table lowering — the narrow seam), and glob builtin
+routing parity across all three engines.
+"""
+
+import copy
+import os
+import random
+
+import pytest
+
+from gatekeeper_tpu.analysis import footprint
+from gatekeeper_tpu.api.templates import compile_target_rego
+from gatekeeper_tpu.client.client import Backend
+from gatekeeper_tpu.client.interface import QueryOpts
+from gatekeeper_tpu.client.local_driver import LocalDriver
+from gatekeeper_tpu.ir.lower import lower_template
+from gatekeeper_tpu.library import all_docs, make_mixed
+from gatekeeper_tpu.library.templates import TARGET
+from gatekeeper_tpu.ops import regex_dfa
+from gatekeeper_tpu.target.k8s import K8sValidationTarget, TARGET_NAME
+
+
+@pytest.fixture(autouse=True)
+def _reset_state(monkeypatch):
+    """Lowering reads GATEKEEPER_DFA and the footprint analyzer keeps
+    process-global state — isolate every test."""
+    monkeypatch.setattr(footprint, "_memo", {})
+    monkeypatch.setattr(footprint, "cross_row", {})
+    monkeypatch.setattr(footprint, "violations", {})
+    monkeypatch.setattr(footprint, "analyses_run", 0)
+    monkeypatch.delenv("GATEKEEPER_DFA", raising=False)
+    monkeypatch.delenv("GATEKEEPER_PAGES", raising=False)
+    monkeypatch.delenv("GATEKEEPER_PAGE_ROWS", raising=False)
+    monkeypatch.delenv("GATEKEEPER_SNAPSHOT_DIR", raising=False)
+    yield
+
+
+def _lower_source(rego: str, kind: str = "K8sDfaTest", dfa: str = "on"):
+    os.environ["GATEKEEPER_DFA"] = dfa
+    try:
+        compiled = compile_target_rego(kind, TARGET, rego)
+        return lower_template(compiled.module, compiled.interp)
+    finally:
+        os.environ.pop("GATEKEEPER_DFA", None)
+
+
+def _library_rego(kind: str) -> str:
+    for tdoc, _ in all_docs():
+        if tdoc["spec"]["crd"]["spec"]["names"]["kind"] == kind:
+            return tdoc["spec"]["targets"][0]["rego"]
+    raise LookupError(kind)
+
+
+def _regex_tables(spec):
+    return [t for t in spec.tables if getattr(t, "regex", None)]
+
+
+def _verdicts(results):
+    out = []
+    for r in results:
+        obj = ((r.review or {}).get("object") or r.resource or {})
+        out.append(((r.constraint or {}).get("kind", ""),
+                    ((r.constraint or {}).get("metadata") or {}).get(
+                        "name", ""),
+                    (obj.get("metadata") or {}).get("name", ""),
+                    r.msg))
+    return sorted(out)
+
+
+# ---------------------------------------------------------------------------
+# lowering routes
+
+
+class TestLoweringRoutes:
+    def test_supported_regex_lowers_to_dfa_match(self):
+        lowered = _lower_source(_library_rego("K8sImageDigests"))
+        assert lowered.spec.dfas, "no DfaReq emitted for a supported regex"
+        assert any(n.op == "dfa_match" for n in lowered.program.nodes)
+        # the lookup table is GONE — not kept alongside the DFA
+        assert not _regex_tables(lowered.spec)
+        assert lowered.regex_offdfa == ()
+
+    def test_flag_off_keeps_lookup_table(self):
+        lowered = _lower_source(_library_rego("K8sImageDigests"), dfa="off")
+        assert not getattr(lowered.spec, "dfas", ())
+        assert _regex_tables(lowered.spec)
+        assert dict(lowered.regex_offdfa) == {
+            "@sha256:[a-f0-9]{64}$": "GATEKEEPER_DFA=off"}
+
+    def test_unsupported_pattern_keeps_table_with_reason(self):
+        # a back-reference is outside the DFA subset: the lookup-table
+        # path must survive, with the reason recorded for probe/status
+        rego = """package k8sdfatest
+violation[{"msg": msg}] {
+  container := input.review.object.spec.containers[_]
+  re_match("(a+)\\\\1", container.image)
+  msg := "doubled"
+}
+"""
+        lowered = _lower_source(rego)
+        assert not getattr(lowered.spec, "dfas", ())
+        assert _regex_tables(lowered.spec)
+        off = dict(lowered.regex_offdfa)
+        assert list(off) == ["(a+)\\1"]
+        assert off["(a+)\\1"]  # a human-readable reason, not empty
+
+    def test_dfa_shared_per_source_pattern_pair(self):
+        rego = """package k8sdfatest
+violation[{"msg": msg}] {
+  container := input.review.object.spec.containers[_]
+  re_match("^gcr[.]io/", container.image)
+  msg := "gcr"
+}
+violation[{"msg": msg}] {
+  container := input.review.object.spec.containers[_]
+  re_match("^gcr[.]io/", container.image)
+  re_match(":latest$", container.image)
+  msg := "latest"
+}
+"""
+        lowered = _lower_source(rego)
+        pats = sorted(d.pattern for d in lowered.spec.dfas)
+        assert pats == [":latest$", "^gcr[.]io/"]
+
+
+# ---------------------------------------------------------------------------
+# footprint: the byte-column claim (narrow seam)
+
+
+class TestFootprintClaim:
+    def test_dfa_lowering_claims_same_columns_as_table(self):
+        rego = _library_rego("K8sImageDigests")
+        on = _lower_source(rego, kind="K8sImageDigests")
+        off = _lower_source(rego, kind="K8sImageDigests", dfa="off")
+        assert on.spec.dfas and not getattr(off.spec, "dfas", ())
+        fp_on = footprint.analyze("K8sImageDigests", on)
+        footprint._memo.clear()
+        fp_off = footprint.analyze("K8sImageDigests", off)
+        claims = lambda fp: {(c.path, c.sensitivity) for c in fp.columns}
+        # the dfa_match claim is exactly the table claim: the packed
+        # byte matrix rides the interner, so no wider read-set appears
+        assert claims(fp_on) == claims(fp_off)
+        assert (("spec", "containers", "*", "image"),
+                "string-regex") in claims(fp_on)
+        assert fp_on.row_local
+
+
+# ---------------------------------------------------------------------------
+# oracle parity: 4-round churn with pages on
+
+
+def _mk_client(jd_mod, kinds, dfa: str):
+    os.environ["GATEKEEPER_DFA"] = dfa
+    try:
+        jd = jd_mod.JaxDriver()
+        c = Backend(jd).new_client([K8sValidationTarget()])
+        for tdoc, cdoc in all_docs():
+            if tdoc["spec"]["crd"]["spec"]["names"]["kind"] in kinds:
+                c.add_template(tdoc)
+                c.add_constraint(cdoc)
+        return jd, c
+    finally:
+        os.environ.pop("GATEKEEPER_DFA", None)
+
+
+def _sweep(jd, opts, pages: bool):
+    os.environ["GATEKEEPER_PAGES"] = "on" if pages else "off"
+    try:
+        return jd.query_audit(TARGET_NAME, opts)[0]
+    finally:
+        os.environ.pop("GATEKEEPER_PAGES", None)
+
+
+class TestChurnParity:
+    # three regex-table library templates — all must lower to dfa_match
+    # and stay page-eligible with the DFA engine on
+    KINDS = ("K8sImageDigests", "K8sDisallowedTags", "K8sNoEnvVarSecrets")
+
+    def _churn_rounds(self, resources, rng):
+        pods = [o for o in resources
+                if (o.get("spec") or {}).get("containers")]
+        rounds = []
+        # 1: verdict-flipping edits — pin one image by digest, break one
+        flipped = rng.sample(pods, 2)
+        batch = []
+        o = copy.deepcopy(flipped[0])
+        o["spec"]["containers"][0]["image"] = (
+            "gcr.io/org/app@sha256:" + "ab" * 32)
+        batch.append(("upsert", o))
+        o = copy.deepcopy(flipped[1])
+        o["spec"]["containers"][0]["image"] = "evil.io/app:latest"
+        batch.append(("upsert", o))
+        rounds.append(batch)
+        # 2: DFA edge strings — empty, at/over the device byte width,
+        # non-ASCII (host xv route-back) — interner grows, devtab must
+        # follow without a table rebuild
+        batch = []
+        for i, img in enumerate(("", "x" * 124, "y" * 125, "café-ü")):
+            o = copy.deepcopy(rng.choice(pods))
+            o.setdefault("metadata", {})["name"] = f"dfa-edge-{i}"
+            o["spec"]["containers"][0]["image"] = img
+            batch.append(("upsert", o))
+        rounds.append(batch)
+        # 3: deletes + fresh inserts
+        batch = [("remove", copy.deepcopy(o))
+                 for o in rng.sample(resources, 3)]
+        batch += [("upsert", o) for o in make_mixed(random.Random(77), 5)]
+        rounds.append(batch)
+        # 4: restore the flipped pods
+        rounds.append([("upsert", copy.deepcopy(o)) for o in flipped])
+        return rounds
+
+    def test_dfa_vs_table_oracle_under_churn(self, monkeypatch):
+        import gatekeeper_tpu.engine.jax_driver as jd_mod
+        monkeypatch.setattr(jd_mod, "SMALL_WORKLOAD_EVALS", 0)
+        monkeypatch.setenv("GATEKEEPER_PAGE_ROWS", "16")
+        resources = make_mixed(random.Random(5), 60)
+        jd_d, cd = _mk_client(jd_mod, self.KINDS, dfa="on")
+        jd_o, co = _mk_client(jd_mod, self.KINDS, dfa="off")
+        # only K8sImageDigests carries a CONSTANT regex — the other two
+        # are parameter-driven (endswith / constraint-supplied pattern)
+        # and must keep their non-DFA lowering untouched
+        vec = jd_d.state[TARGET_NAME].templates["K8sImageDigests"].vectorized
+        assert vec is not None and vec.spec.dfas
+        for c in (cd, co):
+            c.add_data_batch(copy.deepcopy(resources))
+        opts = QueryOpts(limit_per_constraint=10_000)
+        rng = random.Random(9)
+        for rnd in [[]] + self._churn_rounds(resources, rng):
+            for op, obj in rnd:
+                for c in (cd, co):
+                    o = copy.deepcopy(obj)
+                    (c.add_data if op == "upsert" else c.remove_data)(o)
+            got = _verdicts(_sweep(jd_d, opts, pages=True))
+            want = _verdicts(_sweep(jd_o, opts, pages=False))
+            assert got == want
+        # the acceptance bar: regex templates stay page-eligible with
+        # the in-jit DFA engine (no scalar/full-kind fallback)
+        pg = dict(jd_d.last_sweep_phases.get("pages") or {})
+        assert pg.get("enabled") is True
+        assert pg.get("kinds_paged") == len(self.KINDS)
+        assert pg.get("kinds_fallback") == 0
+
+
+# ---------------------------------------------------------------------------
+# glob builtin routing
+
+
+GLOB_REGO = """package k8sglobhost
+violation[{"msg": msg}] {
+  host := input.review.object.spec.host
+  not glob.match("*.corp.example.com", ["."], host)
+  msg := sprintf("host <%v> is not a corp host", [host])
+}
+"""
+
+
+class TestGlobRouting:
+    def _hosts(self):
+        hosts = ["a.corp.example.com", "evil.com",
+                 "a.b.corp.example.com",    # * must not cross the "." delim
+                 "corp.example.com", "", 7]  # non-string: rule undefined
+        out = []
+        for i, h in enumerate(hosts):
+            out.append({"apiVersion": "v1", "kind": "Service",
+                        "metadata": {"name": f"s{i}", "namespace": "d"},
+                        "spec": {"host": h}})
+        return out
+
+    def test_constant_glob_lowers_to_dfa(self):
+        lowered = _lower_source(GLOB_REGO, kind="K8sGlobHost")
+        assert len(lowered.spec.dfas) == 1
+        # the glob compiled to a fully anchored regex (match == search)
+        pat = lowered.spec.dfas[0].pattern
+        assert pat.startswith("\\A") and pat.endswith("\\Z")
+        assert not _regex_tables(lowered.spec)
+
+    def test_glob_parity_three_engines(self, monkeypatch):
+        import gatekeeper_tpu.engine.jax_driver as jd_mod
+        monkeypatch.setattr(jd_mod, "SMALL_WORKLOAD_EVALS", 0)
+        tdoc = {"apiVersion": "templates.gatekeeper.sh/v1alpha1",
+                "kind": "ConstraintTemplate",
+                "metadata": {"name": "k8sglobhost"},
+                "spec": {"crd": {"spec": {"names": {"kind": "K8sGlobHost"}}},
+                         "targets": [{"target": TARGET,
+                                      "rego": GLOB_REGO}]}}
+        cdoc = {"apiVersion": "constraints.gatekeeper.sh/v1alpha1",
+                "kind": "K8sGlobHost", "metadata": {"name": "corp-hosts"},
+                "spec": {}}
+        got = {}
+        for leg, drv_dfa in (("dfa", ("jax", "on")),
+                             ("table", ("jax", "off")),
+                             ("scalar", ("local", None))):
+            engine, dfa = drv_dfa
+            if dfa is not None:
+                os.environ["GATEKEEPER_DFA"] = dfa
+            try:
+                drv = (jd_mod.JaxDriver() if engine == "jax"
+                       else LocalDriver())
+                c = Backend(drv).new_client([K8sValidationTarget()])
+                c.add_template(tdoc)
+                c.add_constraint(cdoc)
+                c.add_data_batch(self._hosts())
+                res, _ = drv.query_audit(TARGET_NAME, QueryOpts(full=True))
+                got[leg] = _verdicts(res)
+            finally:
+                os.environ.pop("GATEKEEPER_DFA", None)
+        assert got["dfa"] == got["table"] == got["scalar"]
+        names = {v[2] for v in got["dfa"]}
+        # every non-corp host violates, including the numeric one: the
+        # builtin type error leaves glob.match undefined, and the `not`
+        # flips undefined to true on every engine — the DFA's
+        # defined-false encoding for non-strings preserves exactly that
+        assert names == {"s1", "s2", "s3", "s4", "s5"}
+
+
+# ---------------------------------------------------------------------------
+# snapshot-tier DFA cache
+
+
+class TestDfaCache:
+    def test_cached_dfa_snapshot_roundtrip(self, monkeypatch, tmp_path):
+        monkeypatch.setenv("GATEKEEPER_SNAPSHOT_DIR", str(tmp_path))
+        monkeypatch.setattr(regex_dfa, "_dfa_cache", {})
+        before = regex_dfa.compiles_run
+        d1 = regex_dfa.cached_dfa("^gcr[.]io/app-[0-9]+$")
+        assert d1 is not None
+        assert regex_dfa.compiles_run == before + 1
+        # a fresh process (empty in-memory cache) must load the dfa
+        # snapshot tier instead of recompiling — the warm-restart
+        # contract ci.sh asserts end to end
+        monkeypatch.setattr(regex_dfa, "_dfa_cache", {})
+        d2 = regex_dfa.cached_dfa("^gcr[.]io/app-[0-9]+$")
+        assert d2 is not None
+        assert regex_dfa.compiles_run == before + 1, "warm path recompiled"
+        assert (d2.trans == d1.trans).all() and (d2.accept == d1.accept).all()
