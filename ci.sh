@@ -25,10 +25,12 @@ python -m gatekeeper_tpu.analysis.selflint --locks gatekeeper_tpu/watch gatekeep
 # the reactor's _rx_lock into the graph (client → driver → reactor
 # must stay one-directional)
 python -m gatekeeper_tpu.analysis.selflint --lockorder gatekeeper_tpu/engine gatekeeper_tpu/watch gatekeeper_tpu/externaldata gatekeeper_tpu/enforce
-# rebind-only self-lint: Bindings.arrays / base_dirty are shared with
-# the sweep cache and in-flight futures — engine code must rebind a
-# fresh dict, never mutate in place
-python -m gatekeeper_tpu.analysis.selflint --rebind gatekeeper_tpu/engine
+# rebind-only self-lint: Bindings.arrays / base_dirty (and the
+# device-resident mask / page-table / inventory-join handles of
+# enforce/devpages.py) are shared with the sweep cache and in-flight
+# futures — engine and enforce code must rebind a fresh dict/handle,
+# never mutate in place
+python -m gatekeeper_tpu.analysis.selflint --rebind gatekeeper_tpu/engine gatekeeper_tpu/enforce
 
 echo "== certify (translation validation over the library) =="
 # Stage-4 translation validation: bounded-model Rego<->IR equivalence
@@ -94,6 +96,24 @@ echo "$WI"
   || { echo "whatif stage failed (rc=$WI_RC)" >&2; exit 1; }
 echo "$WI" | grep -q " 0 parity failure(s)" \
   || { echo "whatif stage found parity failures" >&2; exit 1; }
+
+echo "== devpages (device-resident page table, library parity) =="
+# Device-resident paged store (GATEKEEPER_DEVPAGES=on,
+# enforce/devpages.py): per-kind device residency over the library with
+# verdicts bit-identical to the pages-off oracle.  rc=1 is the warning
+# tier (the scalar-pinned template falls back host-side); rc=2 (a
+# parity failure) fails the build.
+DP_RC=0
+DP=$(JAX_PLATFORMS=cpu GATEKEEPER_DEVPAGES=on timeout -k 10 240 \
+     python -m gatekeeper_tpu.client.probe --pages --library \
+     | tail -3) || DP_RC=$?
+echo "$DP"
+[ "$DP_RC" -le 1 ] \
+  || { echo "devpages stage failed (rc=$DP_RC)" >&2; exit 1; }
+echo "$DP" | grep -q " 0 parity failure(s)" \
+  || { echo "devpages stage found parity failures" >&2; exit 1; }
+echo "$DP" | grep -Eq "(4[0-9]|[5-9][0-9]|[0-9]{3,})/[0-9]+ kind\(s\) paged" \
+  || { echo "devpages stage paged < 40 kinds" >&2; exit 1; }
 
 echo "== tests (virtual 8-device CPU mesh) =="
 python -m pytest tests/ -q
@@ -282,6 +302,16 @@ assert isinstance(pc, dict) and pc.get("parity") is True \
     and pc.get("rows_frac", 1) < 0.05 \
     and pc.get("evaluations_saved", 0) > 0, \
     f"no paged_churn row (with oracle parity + O(dirty)) in: {d}"
+# the devpages_churn row must survive the window: the device-resident
+# paged store must be bit-identical to both the host-paged sweep and
+# the pages-off oracle, moving >=10x fewer H2D bytes at 0.1% churn
+# than the full re-stage oracle (comparator legs run with
+# GATEKEEPER_BINDING_DELTA=off so the pages-off leg re-uploads every
+# bound array; H2D proportional to churn is the claim of record)
+dc = d.get("devpages_churn")
+assert isinstance(dc, dict) and dc.get("parity") is True \
+    and dc.get("h2d_reduction", 0) >= 10, \
+    f"no devpages_churn row (parity + >=10x H2D reduction) in: {d}"
 # the watch_latency row must survive the window: every reactor event →
 # page re-eval → ledger delta must land with verdicts bit-identical
 # to the pages-off full-sweep oracle over the same cluster state
@@ -337,6 +367,7 @@ print("bench ok:", d["metric"], round(d["value"], 1), d["unit"],
       f"{cs['kinds_skipped']} kinds, saved "
       f"{cs['evaluations_saved']} evals; paged rows_frac "
       f"{pc['rows_frac']} saved {pc['evaluations_saved']} evals; "
+      f"devpages H2D {dc['h2d_reduction']}x down; "
       f"shard_sim parity "
       f"{sh['parity_digest']} with {sh['kinds_sharded']} kinds sharded; "
       f"shadow {ss.get('ratio')}x parity {ss.get('parity_digest')}; "

@@ -285,6 +285,25 @@ def predict_seconds(units: float, scale: float) -> float:
     return units * scale
 
 
+def scatter_worthwhile(n_changed: int, n_total: int,
+                       row_bytes: int = 4,
+                       dispatch_rows: int = 64) -> bool:
+    """Price a row-sized scatter against a full re-upload for one
+    device-resident column (the devpages churn seam).
+
+    A scatter moves ``n_changed * row_bytes`` over H2D plus a fixed
+    per-dispatch cost (index staging + scatter kernel launch, priced in
+    row-equivalents); a re-upload moves ``n_total * row_bytes`` in one
+    transfer.  The scatter wins while the churned fraction stays under
+    ~50% after the dispatch overhead — at higher churn the dense copy's
+    bandwidth beats the gather/scatter addressing."""
+    if n_changed <= 0:
+        return True
+    if n_total <= 0:
+        return False
+    return (n_changed + dispatch_rows) * 2 <= n_total
+
+
 # ---------------------------------------------------------------------------
 # running calibration store
 #

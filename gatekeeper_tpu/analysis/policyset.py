@@ -553,12 +553,50 @@ class _HostEval:
         raise ValueError(f"unshareable IR op reached the host twin: {op!r}")
 
 
+class _RowSlicedArrays:
+    """Lazy dict-view gathering each bound array's row axis down to a
+    row subset (by ir/prep.binding_axes).  The page-partitioned dedup
+    host-eval reads through this, so a churn-sweep re-eval of a shared
+    conjunct touches O(dirty) rows instead of r_pad.  Arrays without a
+    row axis (tables, cvals) pass through untouched — shared subtrees
+    are constraint-uniform, so their non-row inputs are row-count
+    independent."""
+
+    def __init__(self, arrays: dict, rows: np.ndarray):
+        self._arrays = arrays
+        self._rows = rows
+
+    def __getitem__(self, name: str):
+        a = self._arrays[name]
+        try:
+            from gatekeeper_tpu.ir.prep import binding_axes
+            axes = binding_axes(name)
+        except Exception:   # noqa: BLE001 — injected/unknown binding
+            return a
+        if "r" not in axes:
+            return a
+        return np.take(np.asarray(a), self._rows,
+                       axis=axes.index("r"))
+
+    def get(self, name: str, default=None):
+        if name not in self._arrays:
+            return default
+        return self[name]
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._arrays
+
+
 def eval_shared_host(program: Program, node_idx: int, arrays: dict,
-                     ekind: str) -> np.ndarray:
+                     ekind: str, rows: np.ndarray | None = None
+                     ) -> np.ndarray:
     """Fires lattice of one shared conjunct, computed once on the host
     over the bound arrays of any member kind.  Returns bool [r_pad]
     (ekind 'r') or [r_pad, e_pad] (ekind 'e') — the injected value the
-    rewritten programs read."""
+    rewritten programs read.  With ``rows``, evaluates only that row
+    subset (the caller splices the result into a cached column)."""
+    if rows is not None:
+        arrays = _RowSlicedArrays(arrays, rows)
     ev = _HostEval(program, arrays)
     f = _np_fires(ev.node(node_idx))
     f = np.broadcast_to(f, (1,) + f.shape[1:]) if f.ndim == 3 else f

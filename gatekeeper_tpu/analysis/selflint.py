@@ -98,9 +98,11 @@ _LOCK_BLOCKING_ATTRS = {"fetch", "fetch_keys", "urlopen", "result"}
 _LOCK_BLOCKING_QUALIFIED = {("time", "sleep")}
 
 # rebind-only rule set (--rebind): attributes that alias shared state
-# (sweep cache, bindings cache, in-flight futures) and therefore must be
-# rebound to a fresh dict, never mutated in place
-_REBIND_ATTRS = {"arrays", "base_dirty"}
+# (sweep cache, bindings cache, in-flight futures, device-resident
+# page state — KindPages.mask/page_table/ij_dev hold live device
+# buffers the next delta sweep reads) and therefore must be rebound to
+# a fresh object, never mutated in place
+_REBIND_ATTRS = {"arrays", "base_dirty", "mask", "page_table", "ij_dev"}
 _DICT_MUTATORS = {"update", "setdefault", "pop", "clear", "popitem"}
 
 

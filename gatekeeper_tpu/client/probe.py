@@ -1069,13 +1069,30 @@ def run_pages(paths: list[str], use_library: bool = False) -> int:
     st = jd_p._state(h_p.name)
     table = st.table
     n_warn = 0
+    from gatekeeper_tpu.enforce.devpages import devpages_mode
+    dv_on = devpages_mode()
+    dv_report = jd_p.devpages_report(h_p.name)
     for kind in sorted(st.templates):
         reason = jd_p._pages_ineligible(st, kind, st.templates[kind])
+        dv_reason = dv_report.get(kind, "unknown")
+        resid = ("device-resident" if dv_reason is None
+                 else f"host ({dv_reason})")
         if reason is None:
-            print(f"  ok   {kind}: paged (delta-maintained)")
+            print(f"  ok   {kind}: paged (delta-maintained, {resid})")
         else:
             n_warn += 1
-            print(f"  warn {kind}: full-kind fallback — {reason}")
+            print(f"  warn {kind}: full-kind fallback — {reason} "
+                  f"[{resid}]")
+    if dv_on:
+        dv = (jd_p.last_sweep_phases or {}).get("devpages", {})
+        n_dev = sum(1 for r in dv_report.values() if r is None)
+        print(f"  devpages: {n_dev}/{len(dv_report)} kind(s) "
+              f"device-eligible; last sweep "
+              f"{dv.get('kinds_device', 0)} on device, "
+              f"{dv.get('h2d_bytes', 0)} H2D byte(s), "
+              f"{dv.get('scatter_rows', 0)} scattered row(s), "
+              f"{dv.get('delta_events', 0)} in-jit delta event(s), "
+              f"{dv.get('direct_clears', 0)} direct clear(s)")
     led = st.ledger
     occ = table.n_rows / max(1, table.n_pages * table.page_rows)
     wall = _time.perf_counter() - t0

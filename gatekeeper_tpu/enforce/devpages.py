@@ -1,0 +1,173 @@
+"""Device-resident paged store (GATEKEEPER_DEVPAGES).
+
+PR 14/15 made continuous enforcement O(dirty) *on the host*: dirty
+bits live in the Python path log, the paged sweep re-evaluates dirty
+pages through the scalar oracle, and the VerdictLedger re-diffs rows in
+Python.  This module is the device half (ROADMAP item 4, the Ragged
+Paged Attention pattern): each eligible kind's column buffers stay
+resident on device as fixed-geometry page arrays behind an on-device
+page table (row -> slot indirection, free-list slots reused in place),
+churn arrives as host-staged *row-sized* update records applied by a
+jitted scatter (veval._scatter_rows), and the paged sweep computes the
+violation mask AND its delta against the previous resident mask inside
+one jitted call (veval.ProgramExecutor.eval_mask_delta) — a compact
+(constraint, row, ±) stream the ledger consumes directly.
+
+Soundness rests on the established over-approximation contract: a mask
+bit 0 means *definitely no violation* (so 1→0 transitions are direct
+ledger clears with no host eval), a mask bit 1 is a candidate the host
+scalar oracle confirms (exact messages).  The device mask deliberately
+excludes the ``__match__`` gate: every match input is row-local (own
+labels/name/ns/kind; namespaceSelector churn forces a rebuild
+upstream), so a match flip always dirties its own row and the dirty-row
+confirm covers it — and the [C, R] match matrix never rides H2D.
+
+``GATEKEEPER_DEVPAGES=off`` (the default) keeps the bit-identical
+host-paged oracle — the same graduation pattern ``GATEKEEPER_PAGES``
+followed: the device path must hold the randomized-churn and chaos-soak
+event-stream parity gates before it defaults on.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any
+
+import numpy as np
+
+DELTA_K_MIN = 256
+"""Smallest compiled width of the compact delta stream."""
+
+DELTA_K_LADDER = 4
+"""Overflow growth factor: a sweep whose changed-bit count exceeds the
+compiled width k re-runs at bucket(count)*LADDER — one recompile per
+bucket ever, never per sweep."""
+
+
+def devpages_mode() -> bool:
+    """GATEKEEPER_DEVPAGES: device-resident page table + in-jit verdict
+    deltas.  Default OFF — ``=off`` is the host-paged oracle every
+    parity gate diffs against (exactly how GATEKEEPER_PAGES graduated:
+    soak first, default-on later)."""
+    return os.environ.get("GATEKEEPER_DEVPAGES", "off").lower() in (
+        "on", "1", "true")
+
+
+def delta_bucket(n: int) -> int:
+    """Power-of-two width for the compact delta stream."""
+    k = DELTA_K_MIN
+    while k < n:
+        k <<= 1
+    return k
+
+
+@dataclasses.dataclass
+class KindPages:
+    """One kind's device-resident paged state.
+
+    ``mask`` is the resident [c_pad, r_pad] violation mask the next
+    sweep deltas against; ``page_table`` the on-device row->slot
+    indirection ([slots] int32, identity while remap_generation is
+    stable — rebuilt, not mutated, on remap); ``free`` mirrors the
+    table's free slot list at last build (reused slots keep their
+    device storage; the delta stream reports the clear+appear pair when
+    a different identity lands in a freed slot).  All device handles
+    here are REBOUND on update, never mutated in place — the only
+    mutation seam is the jitted scatter inside veval (selflint
+    --rebind enforces this for engine/ and enforce/)."""
+
+    kind: str
+    mask: Any = None              # device [c_pad, r_pad] bool
+    page_table: Any = None        # device [slots] int32
+    c_pad: int = 0
+    slots: int = 0                # r_pad: fixed page-array capacity
+    page_rows: int = 0
+    n_pages: int = 0
+    free: tuple = ()              # free-slot mirror at last build
+    gen: int = -1                 # table generation the mask reflects
+    remap: int = -1
+    conver: int = -1
+    k: int = DELTA_K_MIN          # compiled delta-stream width
+    ij_dev: dict = dataclasses.field(default_factory=dict)
+    #   inv-join input records: name -> device array (r:ij.<join>.*)
+    ij_host: dict = dataclasses.field(default_factory=dict)
+    #   the numpy twins the scatter staged from (identity-compared)
+    geometry_adopted: bool = False
+
+    def geometry(self) -> dict:
+        """Plain-data device-pagemap geometry for the pg snapshot tier:
+        enough for a warm restart to adopt the paged layout (slot
+        capacity, page shape, free list) with zero rebuilds — the mask
+        itself is NOT persisted (it is re-derived on the first delta
+        sweep from the adopted ledger's baseline)."""
+        return {"slots": int(self.slots), "c_pad": int(self.c_pad),
+                "page_rows": int(self.page_rows),
+                "n_pages": int(self.n_pages),
+                "free": [int(f) for f in self.free]}
+
+    def adopt_geometry(self, geom: dict) -> bool:
+        """Seed the paged layout from a snapshot geometry payload; the
+        first device sweep then builds its mask into the adopted shape
+        instead of deriving geometry cold."""
+        try:
+            self.slots = int(geom["slots"])
+            self.c_pad = int(geom["c_pad"])
+            self.page_rows = int(geom["page_rows"])
+            self.n_pages = int(geom["n_pages"])
+            self.free = tuple(int(f) for f in geom.get("free", ()))
+            self.geometry_adopted = True
+            return True
+        except (KeyError, TypeError, ValueError):
+            return False
+
+
+def fresh_stats() -> dict:
+    """Per-sweep devpages accounting (the ``devpages`` stanza)."""
+    return {"kinds_device": 0, "kinds_fallback": 0,
+            "fallback_reasons": {}, "scatter_rows": 0,
+            "h2d_bytes": 0, "h2d_scatter_bytes": 0,
+            "delta_events": 0, "delta_overflows": 0,
+            "rows_confirmed": 0, "direct_clears": 0,
+            "inv_joins_device": 0, "geometry_adopted": 0,
+            "mask_builds": 0}
+
+
+def inv_join_binding_names(join_name: str) -> tuple[str, str, str, str]:
+    """The four device input records backing one in-jit inventory join
+    (src ids, inventory ids, inventory-side row filter, name ids).
+    The ``r:`` prefix keys them into ir/prep.binding_axes as
+    row-axis arrays so the scatter seam and R-chunking see them."""
+    return (f"r:ij.{join_name}.src", f"r:ij.{join_name}.inv",
+            f"r:ij.{join_name}.sel", f"r:ij.{join_name}.names")
+
+
+def build_inv_join_inputs(req, table, r_pad: int) -> dict[str, np.ndarray]:
+    """Host twins of one join's device input records, padded to the
+    slot capacity.  Column extraction is table-cached (O(dirty) after
+    the first build); padding fills MISSING / False so padded slots can
+    never join."""
+    from gatekeeper_tpu.store.columns import ColSpec
+    from gatekeeper_tpu.store.interner import MISSING
+    n = table.n_rows
+    ident = table.identity()
+    kid = table.interner.lookup(req.kind)
+
+    def _pad(a: np.ndarray, fill) -> np.ndarray:
+        out = np.full((r_pad,), fill, dtype=a.dtype)
+        out[:n] = a[:n]
+        return out
+
+    src = table.column(ColSpec(req.src_path, "val")).ids
+    inv = table.column(ColSpec(req.inv_path, "val")).ids
+    sel = ident.alive & (ident.kind_ids == kid)
+    if req.namespaced_only:
+        sel = sel & (ident.ns_ids != MISSING)
+    if kid == MISSING:
+        sel = np.zeros_like(sel)
+    names = ident.name_ids
+    s, i, f, m = inv_join_binding_names(req.name)
+    return {s: _pad(src.astype(np.int32), MISSING),
+            i: _pad(inv.astype(np.int32), MISSING),
+            f: _pad(sel.astype(bool), False),
+            m: _pad(names.astype(np.int32), MISSING)}

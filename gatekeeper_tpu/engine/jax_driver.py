@@ -201,6 +201,15 @@ class JaxTargetState(TargetState):
         self.ledger = None
         self.ledger_restored: dict | None = None
         self.dirtylog_overflows_seen = 0
+        # device-resident paged store (enforce/devpages.py): per-kind
+        # KindPages (resident mask + page table + inv-join inputs),
+        # and snapshot geometry awaiting adoption on warm restart
+        self.devpages: dict[str, object] = {}
+        self.devpages_geom: dict | None = None
+        # dedup shared-conjunct columns carried across full sweeps:
+        # digest -> (gen, remap, shape, col) — churn re-evaluates only
+        # the dirty-row slice (policyset.eval_shared_host rows=...)
+        self.dedup_shared_cache: dict = {}
 
     def bump(self, kind: str) -> None:
         self.con_version[kind] = self.con_version.get(kind, 0) + 1
@@ -341,6 +350,13 @@ class JaxDriver(LocalDriver):
                 wm_max = max(wm.values(), default=0)
                 for kind, p in payload.items():
                     p["rv"] = max(int(p.get("rv", 0) or 0), wm_max)
+                    # device-pagemap geometry rides the pg tier: a warm
+                    # restart adopting the verdicts also adopts the
+                    # paged layout (slot capacity, page shape, free
+                    # list) so the first device sweep rebuilds nothing
+                    kp = st.devpages.get(kind)
+                    if kp is not None and getattr(kp, "slots", 0):
+                        p["devpages"] = kp.geometry()
                 if wm:
                     payload["__rv__"] = dict(wm)
                 _snap.save_pagemap(target, payload)
@@ -746,6 +762,17 @@ class JaxDriver(LocalDriver):
         st.mask_cache[kind] = ((gen, conver), (conver, remap), padded, None)
         return padded[:n_con, :n], None, padded
 
+    @staticmethod
+    def _binding_delta_on() -> bool:
+        """GATEKEEPER_BINDING_DELTA: the incremental update_bindings
+        chain (O(dirty) host work + row-sized device scatters).  ``off``
+        rebuilds bindings whole on every store generation — the
+        bit-identical oracle for the delta chain, and the re-stage
+        comparator the devpages_churn bench measures H2D against."""
+        import os
+        return os.environ.get(
+            "GATEKEEPER_BINDING_DELTA", "on").lower() not in ("off", "0")
+
     def _kind_bindings(self, st: JaxTargetState, kind: str,
                        compiled: CompiledTemplate, constraints: list[dict]):
         """Per-kind bindings with incremental churn updates.  Retired
@@ -759,7 +786,8 @@ class JaxDriver(LocalDriver):
         hit = st.bindings_cache.get(kind)
         if hit is not None and hit[0] == key:
             return hit[1]
-        if hit is not None and hit[0][1] == key[1]:
+        if hit is not None and hit[0][1] == key[1] \
+                and self._binding_delta_on():
             retired = st.bindings_retired.get(kind)
             recycle = retired[1] if retired is not None \
                 and retired[0] == key[1] else None
@@ -862,28 +890,317 @@ class JaxDriver(LocalDriver):
                 "conver": self.con_version_of(st, kind), "limit": limit,
             }
 
+    def _devpages_active(self, compiled: CompiledTemplate) -> bool:
+        """Device-resident pages are usable for this template right
+        now: GATEKEEPER_DEVPAGES on, a lowered program to evaluate, and
+        a live device backend (scalar-only degradation keeps every kind
+        on the host-paged oracle)."""
+        from gatekeeper_tpu.enforce.devpages import devpages_mode
+        return devpages_mode() and compiled.vectorized is not None \
+            and not self.scalar_only
+
+    @staticmethod
+    def _inv_join_only(fp, compiled: CompiledTemplate) -> bool:
+        """True when a template is cross-row SOLELY through lowered
+        inventory joins (spec.inv_joins) — the one cross-row shape the
+        devpages delta kernel evaluates in-jit (_inv_join_mask), which
+        is what makes e.g. K8sUniqueIngressHost page-eligible.  Any
+        other cross-row reason (or an inventory read the lowering did
+        not capture as a join) keeps the kind ineligible."""
+        if compiled.vectorized is None:
+            return False
+        if not getattr(compiled.vectorized.spec, "inv_joins", ()):
+            return False
+        reasons = tuple(getattr(fp, "cross_row_reasons", ()) or ())
+        return bool(reasons) and all(
+            r.startswith("inventory join") for r in reasons)
+
     def _pages_ineligible(self, st: JaxTargetState, kind: str,
                           compiled: CompiledTemplate) -> str | None:
         """None when the kind can serve from the VerdictLedger, else
         the fallback reason.  Same gates as footprint selective reuse:
         only a row-local template with no provider/inventory reads has
-        verdicts that per-page re-evaluation can maintain exactly."""
+        verdicts that per-page re-evaluation can maintain exactly.
+        Under GATEKEEPER_DEVPAGES one relaxation: a kind whose only
+        cross-row dependency is a lowered inventory join is admitted —
+        the in-jit join sees the whole table every delta sweep, so page
+        locality is not assumed (and on devpages fallback such a kind
+        takes a full rebuild, never the host page loop)."""
         if compiled.vectorized is None:
             return "scalar-pin"
         fp = st.footprints.get(kind)
         if fp is None:
             return "no-footprint"
-        if not fp.row_local:
+        dev_ij = self._devpages_active(compiled) \
+            and self._inv_join_only(fp, compiled)
+        if not fp.row_local and not dev_ij:
             return "cross-row"
         if fp.providers:
             return "external-providers"
-        if compiled.uses_inventory:
+        if compiled.uses_inventory and not dev_ij:
             return "inventory-read"
         return None
 
+    @staticmethod
+    def _observable_kinds(compiled: CompiledTemplate,
+                          constraints: list[dict]) -> frozenset | None:
+        """Resource kinds whose churn can change this template kind's
+        verdicts: the union of every constraint's ``spec.match.kinds``
+        plus the kinds its inventory joins read.  None = wildcard
+        (some constraint matches every kind — cannot scope).  Drives
+        the per-kind widen scoping: a dirty-log widen marker whose
+        churned-kind union is disjoint from this set is skippable."""
+        out: set[str] = set()
+        if compiled.vectorized is not None:
+            for ij in getattr(compiled.vectorized.spec, "inv_joins", ()):
+                out.add(ij.kind)
+        for c in constraints:
+            match = (c.get("spec") or {}).get("match") or {}
+            kl = match.get("kinds")
+            if not isinstance(kl, list):
+                return None         # absent/malformed kinds: wildcard
+            for ks in kl:
+                knames = (ks or {}).get("kinds") or []
+                if "*" in knames:
+                    return None
+                out.update(k for k in knames if isinstance(k, str))
+        return frozenset(out)
+
+    def _devpages_reject(self, dv: dict, kind: str, reason: str) -> None:
+        """Record one kind falling back from the device-resident path
+        (stats + flight recorder + labeled counter)."""
+        dv["kinds_fallback"] += 1
+        dv["fallback_reasons"][kind] = reason
+        self.metrics.counter("devpages_fallbacks", kind=kind).inc()
+        from gatekeeper_tpu.obs.flightrecorder import record_event
+        record_event("devpages_fallback", kind=kind, reason=reason)
+
+    def _devpaged_kind(self, st, target, handler, compiled, constraints,
+                       kind, led, ent, conver, rcache, pg, dv,
+                       refresh_only: bool = False) -> bool:
+        """One kind's sweep on the device-resident paged store.
+
+        The kind's columns stay resident as fixed-geometry page arrays
+        (the bindings delta chain scatters row-sized records to dirty
+        slots — veval._scatter_rows), inventory-join input records ride
+        the same discipline, and ONE jitted call (eval_mask_delta)
+        computes the violation mask, gathers it through the on-device
+        page table and returns the compact appear/clear delta stream
+        against the previous resident mask.  Consumption preserves the
+        ledger's exact-event contract:
+
+          * dirty rows with any candidate bit, and every ``+`` delta
+            row, re-confirm through the exact scalar path
+            (_ledger_apply_row) — messages stay oracle-identical;
+          * dirty rows with NO candidate bit are direct full-row clears
+            (mask bit 0 = definitely no violation — sound by the
+            over-approximation contract);
+          * ``-`` deltas on non-dirty rows (cross-row inventory-join
+            flips) drop just that constraint's verdicts — same
+            identity, so no phantom clear+appear pair.
+
+        The resident mask deliberately excludes ``__match__``: every
+        match input is row-local (a flip dirties its own row, which the
+        confirm covers; namespaceSelector churn rebuilt upstream), so
+        the [C, R] match matrix never rides H2D on churn.
+
+        Returns True when the ledger was brought current (or, with
+        ``refresh_only``, the resident state rebuilt after a host full
+        build); False = caller falls back.  Raising is also a fallback
+        — the caller drops the kind's device state and recovers."""
+        from gatekeeper_tpu.enforce import devpages as _dvp
+        import jax.numpy as jnp
+        table = st.table
+        ex = self.executor
+        kp = st.devpages.get(kind)
+        if kp is None:
+            kp = _dvp.KindPages(kind=kind)
+            if st.devpages_geom:
+                geom = st.devpages_geom.pop(kind, None)
+                if isinstance(geom, dict) and kp.adopt_geometry(geom):
+                    dv["geometry_adopted"] += 1
+            st.devpages[kind] = kp
+        h2d0 = ex.h2d_bytes
+        sc0, sr0 = ex.h2d_scatter_bytes, ex.h2d_scatter_rows
+        try:
+            bindings = self._kind_bindings(st, kind, compiled, constraints)
+        except ExternalDataError:
+            self._devpages_reject(dv, kind, "external-data-failure")
+            return False
+        if bindings.f32_unsafe:
+            self._devpages_reject(dv, kind, "f32-unsafe")
+            return False
+        r_pad, c_pad = bindings.r_pad, bindings.c_pad
+        # inventory-join device input records (r:ij.<join>.*): cold
+        # upload once, then row-sized scatters of just the changed
+        # entries — rebound per update, never mutated in place
+        spec = compiled.vectorized.spec
+        ij_specs_raw = tuple(getattr(spec, "inv_joins", ()))
+        ij_dev: dict = {}
+        for req in ij_specs_raw:
+            host = _dvp.build_inv_join_inputs(req, table, r_pad)
+            for nm, arr in host.items():
+                prev_h = kp.ij_host.get(nm)
+                prev_d = kp.ij_dev.get(nm)
+                if prev_h is None or prev_d is None \
+                        or prev_h.shape != arr.shape:
+                    dev = ex._put(nm, arr, False)
+                elif np.array_equal(prev_h, arr):
+                    dev = prev_d
+                else:
+                    changed = np.nonzero(prev_h != arr)[0]
+                    from gatekeeper_tpu.analysis.costmodel import \
+                        scatter_worthwhile
+                    if scatter_worthwhile(len(changed), arr.shape[0]):
+                        dev = ex._scatter_rows(nm, prev_d, arr, changed,
+                                               False)
+                    else:
+                        dev = ex._put(nm, arr, False)
+                kp.ij_host = {**kp.ij_host, nm: arr}
+                kp.ij_dev = {**kp.ij_dev, nm: dev}
+                ij_dev[nm] = dev
+        if ij_specs_raw:
+            dv["inv_joins_device"] += len(ij_specs_raw)
+        # on-device page table: row -> slot indirection ([r_pad] int32,
+        # identity while row ids are stable); rebuilt — rebound, not
+        # mutated — on remap or slot-capacity change
+        if kp.page_table is None or kp.slots != r_pad \
+                or kp.remap != table.remap_generation:
+            kp.page_table = ex._put(
+                "__pagetable__", np.arange(r_pad, dtype=np.int32), False)
+            kp.slots = r_pad
+            kp.page_rows = table.page_rows
+            kp.n_pages = table.n_pages
+        kp.free = tuple(table.free_slots())
+        mask_valid = (kp.mask is not None and kp.gen == ent.gen
+                      and kp.remap == table.remap_generation
+                      and kp.conver == conver
+                      and kp.c_pad == c_pad and kp.slots == r_pad
+                      and tuple(kp.mask.shape) == (c_pad, r_pad))
+        if refresh_only or not mask_valid:
+            old_mask = jnp.zeros((c_pad, r_pad), dtype=bool)
+        else:
+            old_mask = kp.mask
+        ij_sig = tuple((req.name, bool(req.exclude_same_name))
+                       for req in ij_specs_raw)
+        k = max(kp.k, _dvp.DELTA_K_MIN)
+        dirty = table.dirty_rows_since(ent.gen) \
+            if not refresh_only else np.empty((0,), dtype=np.int64)
+        new_mask, idx, signs, count, row_any = ex.eval_mask_delta(
+            compiled.vectorized.program, bindings, None, old_mask,
+            kp.page_table, k, ij_sig, ij_dev)
+        if count > k and not refresh_only:
+            # compact stream overflowed the compiled width: one
+            # recompile at the next bucket, then re-dispatch
+            dv["delta_overflows"] += 1
+            k = _dvp.delta_bucket(count) * _dvp.DELTA_K_LADDER
+            if k > (c_pad * r_pad):
+                k = c_pad * r_pad
+            kp.k = k
+            new_mask, idx, signs, count, row_any = ex.eval_mask_delta(
+                compiled.vectorized.program, bindings, None, old_mask,
+                kp.page_table, k, ij_sig, ij_dev)
+            if count > k:
+                self._devpages_reject(dv, kind, "delta-overflow")
+                return False
+        if not refresh_only:
+            n_rows = table.n_rows
+            cnames = [(c.get("metadata") or {}).get("name", "")
+                      for c in constraints]
+            valid = int(min(count, k))
+            plus_rows: set[int] = set()
+            plus_bits: set[tuple[int, int]] = set()
+            minus_by_row: dict[int, list[str]] = {}
+            for i in range(valid):
+                flat = int(idx[i])
+                if flat < 0:
+                    continue
+                ci, row = flat // r_pad, flat % r_pad
+                if ci >= len(cnames) or row >= n_rows:
+                    continue    # padded constraint/row space
+                if bool(signs[i]):
+                    plus_rows.add(row)
+                    plus_bits.add((ci, row))
+                else:
+                    minus_by_row.setdefault(row, []).append(cnames[ci])
+            dirty_set = set(int(r) for r in dirty)
+            confirm = {r for r in dirty_set if bool(row_any[r])} \
+                | plus_rows
+            n_evals = 0
+            involved = sorted(dirty_set | plus_rows | set(minus_by_row))
+            for row in involved:
+                if row in confirm:
+                    n_evals += self._ledger_apply_row(
+                        st, target, handler, compiled, constraints,
+                        kind, led, rcache, row, pg)
+                elif row in dirty_set:
+                    # no candidate bit anywhere on a dirty row: the
+                    # device proved no constraint can violate — direct
+                    # full-row clear, no scalar eval
+                    meta = table.meta_at(row)
+                    ident = () if meta is None \
+                        else (meta.namespace, meta.name)
+                    pg["events"] += len(led.set_row(kind, row, ident, {}))
+                    dv["direct_clears"] += 1
+                else:
+                    # '-' delta on a clean row: that constraint's bit
+                    # went definitely-no-violation — drop exactly its
+                    # verdicts, same identity (no clear+appear pair)
+                    old = ent.rows.get(row)
+                    if old is None:
+                        continue
+                    ident, by_c = old
+                    drop = set(minus_by_row[row])
+                    new_by_c = {cn: rs for cn, rs in by_c.items()
+                                if cn not in drop}
+                    if len(new_by_c) != len(by_c):
+                        pg["events"] += len(
+                            led.set_row(kind, row, ident, new_by_c))
+                        dv["direct_clears"] += 1
+            if not mask_valid and ent.rows:
+                # reconcile sweep (restart/resize/toggle): the previous
+                # resident mask is unknown, so '-' deltas don't exist —
+                # prune stale ledger verdicts by the new mask's bits
+                # instead (vs zeros, every 1-bit is in the '+' stream)
+                cset = set(cnames)
+                cidx = {cn: i for i, cn in enumerate(cnames)}
+                for row, (ident, by_c) in list(ent.rows.items()):
+                    if row in confirm or row in dirty_set:
+                        continue
+                    new_by_c = {cn: rs for cn, rs in by_c.items()
+                                if cn in cset
+                                and (cidx[cn], row) in plus_bits}
+                    if len(new_by_c) != len(by_c):
+                        pg["events"] += len(
+                            led.set_row(kind, row, ident, new_by_c))
+                        dv["direct_clears"] += 1
+            dv["delta_events"] += int(count)
+            dv["scatter_rows"] += ex.h2d_scatter_rows - sr0
+            dv["rows_confirmed"] += len(confirm)
+            pg["rows_reevaluated"] += len(confirm)
+            pg["evaluations_saved"] += \
+                max(0, n_rows - len(confirm)) * len(constraints)
+            pg["pages_skipped"] += max(
+                0, table.n_pages
+                - len({r // table.page_rows for r in involved}))
+        else:
+            dv["mask_builds"] += 1
+        kp.mask = new_mask
+        kp.gen = table.generation
+        kp.remap = table.remap_generation
+        kp.conver = conver
+        kp.c_pad = c_pad
+        kp.n_pages = table.n_pages
+        kp.page_rows = table.page_rows
+        dv["kinds_device"] += 1
+        dv["h2d_bytes"] += (ex.h2d_bytes - h2d0) \
+            + (ex.h2d_scatter_bytes - sc0)
+        dv["h2d_scatter_bytes"] += ex.h2d_scatter_bytes - sc0
+        return True
+
     def _paged_kind(self, st, target, handler, compiled, constraints,
                     ordered_rows, row_order, kind, limit, tagged, rcache,
-                    pg, dirty_pages_out) -> None:
+                    pg, dirty_pages_out, dv=None) -> None:
         """Serve one kind from the VerdictLedger, first applying the
         deltas for every page dirtied since the entry's generation.
         Rows re-evaluate through the exact scalar path (match + oracle
@@ -909,6 +1226,29 @@ class JaxDriver(LocalDriver):
             if payload is not None and led.adopt(kind, payload, condigest,
                                                  table, conver):
                 ent = led.entry(kind)
+                geom = payload.get("devpages") \
+                    if isinstance(payload, dict) else None
+                if isinstance(geom, dict):
+                    if dv is not None:
+                        # adopt the device-pagemap geometry now: a
+                        # clean warm restart may have nothing dirty, so
+                        # the first devpages sweep (which would pop a
+                        # stash) can be arbitrarily far away
+                        from gatekeeper_tpu.enforce import \
+                            devpages as _dvp_mod
+                        kp = st.devpages.get(kind)
+                        if kp is None:
+                            kp = _dvp_mod.KindPages(kind=kind)
+                            st.devpages[kind] = kp
+                        if kp.adopt_geometry(geom):
+                            dv["geometry_adopted"] += 1
+                    else:
+                        # devpages off this sweep: stash for the first
+                        # devpages sweep to adopt instead of deriving
+                        # the paged layout cold
+                        if st.devpages_geom is None:
+                            st.devpages_geom = {}
+                        st.devpages_geom[kind] = geom
         rebuild = None
         if ent.gen < 0:
             rebuild = "cold"
@@ -924,20 +1264,47 @@ class JaxDriver(LocalDriver):
         if rebuild is None and table.generation != ent.gen:
             entries = table.dirty_page_entries_since(ent.gen)
             if entries is None:
-                # window predates the log or spans an overflow widen
-                # marker: the dirty PAGES are unattributable, but the
-                # row space itself is intact (a shrink would have
-                # bumped remap_generation and been caught above), so
-                # rebuild the kind page-by-page through the normal
-                # delta path below — every page re-evaluates, warming
-                # the review cache incrementally and clearing dead rows
-                # via their own page's re-eval — instead of one
-                # monolithic whole-kind build
+                # window predates the log floor: the dirty PAGES are
+                # unattributable, but the row space itself is intact
+                # (a shrink would have bumped remap_generation and been
+                # caught above), so rebuild the kind page-by-page
+                # through the normal delta path below — every page
+                # re-evaluates, warming the review cache incrementally
+                # and clearing dead rows via their own page's re-eval —
+                # instead of one monolithic whole-kind build.  (A cap-
+                # overflow widen no longer lands here: the log keeps a
+                # paths=None marker carrying the dropped half's exact
+                # page/kind unions, scoped per kind in the loop below.)
                 pg["widen_fallbacks"] += 1
+                self.metrics.counter("widen_fallbacks", kind=kind).inc()
                 entries = [(table.generation, None,
-                            frozenset(range(table.n_pages)))]
+                            frozenset(range(table.n_pages)), None)]
+        dev_done = False
+        if rebuild is None and entries and dv is not None \
+                and self._devpages_active(compiled):
+            # device-resident delta path: scatter-update the resident
+            # columns, compute mask + delta in one jitted call, consume
+            # the compact stream.  Falls back to the host page loop on
+            # any failure — except for cross-row (inventory-join)
+            # kinds, whose verdicts the page loop cannot maintain
+            # (page locality is exactly what the device delta waived),
+            # so those take one full rebuild instead.
+            try:
+                dev_done = self._devpaged_kind(
+                    st, target, handler, compiled, constraints, kind,
+                    led, ent, conver, rcache, pg, dv)
+            except Exception as e:  # noqa: BLE001 — devpages is the
+                st.devpages.pop(kind, None)         # gated experiment
+                self._devpages_reject(dv, kind, f"error: {e!r}")
+                dev_done = False
+            if not dev_done:
+                fp = st.footprints.get(kind)
+                if fp is None or not fp.row_local:
+                    rebuild = "devpages-fallback"
         n_evals = 0
-        if rebuild is not None:
+        if dev_done:
+            pass        # ledger brought current on the device path
+        elif rebuild is not None:
             # full build: clear rows that died since (sorted — the
             # canonical event order puts dead-row clears first), then
             # every live row in rank order
@@ -952,12 +1319,38 @@ class JaxDriver(LocalDriver):
             pg["full_builds"] += 1
             pg["pages_evaluated"] += table.n_pages
             pg["rows_reevaluated"] += len(ordered_rows)
+            if dv is not None and self._devpages_active(compiled):
+                # refresh the device-resident mask after a host full
+                # build so the NEXT sweep deltas instead of reconciling
+                try:
+                    self._devpaged_kind(
+                        st, target, handler, compiled, constraints,
+                        kind, led, ent, conver, rcache, pg, dv,
+                        refresh_only=True)
+                except Exception:   # noqa: BLE001 — refresh is advisory
+                    st.devpages.pop(kind, None)
         elif entries:
             fp = st.footprints[kind]
             read = set(fp.object_paths()) | set(MATCH_PATHS)
+            obs_kinds = self._observable_kinds(compiled, constraints)
             kgen_changed = ent.kgen != table.key_generation
             pages: set[int] = set()
-            for _g, paths, pgs in entries:
+            for _g, paths, pgs, ekinds in entries:
+                if paths is None:
+                    # cap-overflow widen marker: its paths are
+                    # unattributable (treat as every path), but its
+                    # resource-kind union is exact — a template whose
+                    # observable kinds (match criteria + inventory
+                    # joins) are disjoint skips the dropped half
+                    # outright instead of re-evaluating its pages
+                    if ekinds is not None and obs_kinds is not None \
+                            and not (obs_kinds & ekinds):
+                        continue
+                    pg["widen_fallbacks"] += 1
+                    self.metrics.counter("widen_fallbacks",
+                                         kind=kind).inc()
+                    pages |= pgs
+                    continue
                 # page filtering by read-set intersection is only exact
                 # for pure replaces: a bulk entry mixing inserts (empty
                 # paths) with non-intersecting edits can't attribute
@@ -1081,6 +1474,9 @@ class JaxDriver(LocalDriver):
               "rows_reevaluated": 0, "evaluations_saved": 0,
               "widen_fallbacks": 0, "full_builds": 0, "events": 0}
         dirty: set[int] = set()
+        from gatekeeper_tpu.enforce.devpages import (
+            devpages_mode as _dv_mode, fresh_stats as _dv_fresh)
+        dv = _dv_fresh() if (_dv_mode() and not self.scalar_only) else None
         reacted = 0
         with self._prep_lock:
             ordered_rows, row_order = self._ensure_order(st)
@@ -1097,12 +1493,14 @@ class JaxDriver(LocalDriver):
                     continue
                 self._paged_kind(st, target, handler, compiled,
                                  constraints, ordered_rows, row_order, k,
-                                 None, None, rcache, pg, dirty)
+                                 None, None, rcache, pg, dirty, dv)
                 reacted += 1
         if reacted == 0:
             return None
         pg["kinds"] = reacted
         pg["dirty_pages"] = len(dirty)
+        if dv is not None:
+            pg["devpages"] = dv
         m = self.metrics
         m.counter("reactor_reacts_total").inc()
         if st.ledger is not None:
@@ -1139,6 +1537,32 @@ class JaxDriver(LocalDriver):
         # not reentrant)
         out = self.react_kind(target, kind)
         self.metrics.counter("reactor_resyncs_total").inc()
+        return out
+
+    @locked_read
+    def devpages_report(self, target: str) -> dict:
+        """Per-kind device-residency eligibility for ``probe --pages``:
+        kind -> None (device-resident eligible) or the blocking
+        reason.  Reflects the live gates — with GATEKEEPER_DEVPAGES
+        off the cross-row relaxation is off too, so an inventory-join
+        kind reports its host-path reason."""
+        from gatekeeper_tpu.enforce.devpages import devpages_mode
+        st = self._state(target)
+        out: dict[str, str | None] = {}
+        if not isinstance(st, JaxTargetState):
+            return out
+        on = devpages_mode()
+        for kind in sorted(st.templates):
+            compiled = st.templates[kind]
+            reason = self._pages_ineligible(st, kind, compiled)
+            if reason is None:
+                if not on:
+                    reason = "devpages-off"
+                elif self.scalar_only:
+                    reason = "scalar-only"
+                elif compiled.vectorized is None:
+                    reason = "not-vectorized"
+            out[kind] = reason
         return out
 
     @locked_read
@@ -1526,6 +1950,12 @@ class JaxDriver(LocalDriver):
                 plan = build_dedup_plan(dkinds)
                 _snap.save_dedup_plan(pdigest, plan)
             self._dedup_plan_memo[target] = (pdigest, plan)
+            # plan changed: drop cross-sweep shared columns whose
+            # digest is no longer in the live group set
+            live = set(plan.groups)
+            for d in list(st.dedup_shared_cache):
+                if d not in live:
+                    del st.dedup_shared_cache[d]
             return plan
         except Exception:
             # dedup is an optimization; the original programs are
@@ -1548,9 +1978,51 @@ class JaxDriver(LocalDriver):
         with self._prep_lock:
             return self._audit_dedup_plan(st, target) is not None
 
-    @staticmethod
-    def _apply_dedup(plan, kind: str, bindings, shared_cols: dict,
-                     applied: dict):
+    def _shared_col(self, st, plan, kind: str, digest: str, bindings):
+        """One shared conjunct's host column, page-partitioned ACROSS
+        sweeps: a geometry-stable cache hit re-evaluates only the rows
+        the store dirtied since the cached generation and splices them
+        into a COPY (the previous sweep's bindings may still reference
+        the cached array).  Sound because shared subtrees are
+        row-local by construction (_SHAREABLE_OPS: own columns +
+        digest-stable interner tables; the interner is append-only, so
+        an unchanged row's ids resolve identically) — a changed row is
+        always in dirty_rows_since.  Eviction is the key itself: a
+        constraint-set change changes the digest, a remap or resize
+        misses the guards."""
+        from gatekeeper_tpu.analysis.policyset import eval_shared_host
+        g = plan.groups[digest]
+        member = g.members[kind]
+        table = st.table
+        want_shape = (bindings.r_pad, bindings.e_pads.get(g.axis)) \
+            if g.ekind == "e" else (bindings.r_pad,)
+        hit = st.dedup_shared_cache.get(digest)
+        if hit is not None:
+            c_gen, c_remap, c_col = hit
+            if c_remap == table.remap_generation \
+                    and c_col.shape == want_shape:
+                if c_gen == table.generation:
+                    return c_col
+                dirty = table.dirty_rows_since(c_gen)
+                if len(dirty) <= max(64, table.n_rows // 4):
+                    sub = eval_shared_host(
+                        plan.originals[kind], member.node_idx,
+                        bindings.arrays, g.ekind, rows=dirty)
+                    col = c_col.copy()
+                    col[dirty] = sub
+                    st.dedup_shared_cache[digest] = (
+                        table.generation, table.remap_generation, col)
+                    self.metrics.counter(
+                        "dedup_shared_delta_evals").inc()
+                    return col
+        col = eval_shared_host(plan.originals[kind], member.node_idx,
+                               bindings.arrays, g.ekind)
+        st.dedup_shared_cache[digest] = (
+            table.generation, table.remap_generation, col)
+        return col
+
+    def _apply_dedup(self, st, plan, kind: str, bindings,
+                     shared_cols: dict, applied: dict):
         """Swap one kind's program for its dedup rewrite
         (analysis/policyset.py), injecting the shared predicate columns
         as plain bool bindings.  The column for a digest is computed
@@ -1558,20 +2030,20 @@ class JaxDriver(LocalDriver):
         bound arrays (the numpy twin of the device evaluator) — and
         handed to every member; member kinds bind identical arrays for
         identical canonical inputs (same inventory, same interner, same
-        row bucket), which the shape guard re-checks per kind.  Any
-        mismatch or twin failure keeps the kind on its original
-        program.  Returns the rewritten Program or None."""
-        from gatekeeper_tpu.analysis.policyset import eval_shared_host
+        row bucket), which the shape guard re-checks per kind.  Across
+        sweeps the per-digest column is cached and churn re-evals only
+        dirty rows (_shared_col), so shared-conjunct host-eval is
+        O(dirty), not O(rows/sweep).  Any mismatch or twin failure
+        keeps the kind on its original program.  Returns the rewritten
+        Program or None."""
         add: dict = {}
         try:
             for digest in plan.kind_digests[kind]:
                 g = plan.groups[digest]
                 col = shared_cols.get(digest)
                 if col is None:
-                    member = g.members[kind]
-                    col = eval_shared_host(
-                        plan.originals[kind], member.node_idx,
-                        bindings.arrays, g.ekind)
+                    col = self._shared_col(st, plan, kind, digest,
+                                           bindings)
                     shared_cols[digest] = col
                 if g.ekind == "e":
                     if col.shape != (bindings.r_pad,
@@ -1883,6 +2355,10 @@ class JaxDriver(LocalDriver):
                         "evaluations_saved": 0, "widen_fallbacks": 0,
                         "full_builds": 0, "events": 0}
             pg_dirty_pages: set[int] = set()
+            from gatekeeper_tpu.enforce.devpages import (
+                devpages_mode as _dv_mode, fresh_stats as _dv_fresh)
+            dv_on = pg_on and _dv_mode() and not self.scalar_only
+            dv_stats = _dv_fresh() if dv_on else None
             # what-if twin sharing (whatif/shadow.py): when shadow
             # kinds are staged, an unchanged twin aliases the live
             # kind's dispatch instead of re-running it on device.
@@ -2029,7 +2505,7 @@ class JaxDriver(LocalDriver):
                                     kind in dedup_plan.rewritten:
                                 _t_dd = _time.perf_counter()
                                 prog2 = self._apply_dedup(
-                                    dedup_plan, kind, bindings,
+                                    st, dedup_plan, kind, bindings,
                                     dedup_shared_cols, dedup_applied)
                                 if prog2 is not None:
                                     prog = prog2
@@ -2107,11 +2583,20 @@ class JaxDriver(LocalDriver):
                         handle = _ResolvedHandle(payload)
                     try:
                         if mode == "pages":
-                            self._paged_kind(st, target, handler, compiled,
-                                             constraints, ordered_rows,
-                                             row_order, kind, limit, tagged,
-                                             rcache, pg_stats,
-                                             pg_dirty_pages)
+                            # the sweep formats pages-mode kinds outside
+                            # _prep_lock; the devpages path fills the
+                            # reader-side caches (_kind_bindings) the
+                            # lock serializes against the reactor —
+                            # take it here (react_kind's own _paged_kind
+                            # call already holds it; plain Lock, so it
+                            # must not be re-acquired deeper down)
+                            with self._prep_lock:
+                                self._paged_kind(st, target, handler,
+                                                 compiled, constraints,
+                                                 ordered_rows, row_order,
+                                                 kind, limit, tagged,
+                                                 rcache, pg_stats,
+                                                 pg_dirty_pages, dv_stats)
                         elif mode == "topk":
                             self._format_topk(st, target, handler, compiled,
                                               constraints, prog, bindings,
@@ -2318,6 +2803,15 @@ class JaxDriver(LocalDriver):
                 if _led is not None else 0,
                 "events": int(pg_stats["events"]),
             }
+            self.last_sweep_phases["devpages"] = {"enabled": dv_on} \
+                if dv_stats is None else {"enabled": True, **dv_stats}
+            if dv_stats is not None:
+                m.counter("store_h2d_bytes_total").inc(
+                    int(dv_stats["h2d_bytes"]))
+                m.gauge("devpages_scatter_rows").set(
+                    float(dv_stats["scatter_rows"]))
+                m.gauge("devpages_delta_events").set(
+                    float(dv_stats["delta_events"]))
             m.gauge("store_pages_total").set(float(st.table.n_pages))
             if pg_kinds:
                 m.gauge("store_pages_dirty").set(float(len(pg_dirty_pages)))

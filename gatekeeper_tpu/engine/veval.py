@@ -542,6 +542,63 @@ def _eval_mask(program: Program, d: dict[str, jax.Array]) -> jax.Array:
     return jnp.moveaxis(ys, 0, 1).reshape(c_pad, r_pad)
 
 
+def _inv_join_mask(src: jax.Array, inv: jax.Array, sel: jax.Array,
+                   names: jax.Array, exclude_same_name: bool) -> jax.Array:
+    """Device twin of ir/prep.build_inv_join — the duplicate-detection
+    inventory join (K8sUniqueIngressHost) as an on-device
+    segment-reduce: sort the selected inventory values once, then
+    per-row occurrence counts are two ``searchsorted`` gathers
+    (``right - left``), with the same-name exclusion counted by a
+    merged lexsort over (value, name) pairs — int64 pair keys are NOT
+    available (default jax is 32-bit; jnp.int64 silently truncates,
+    ``1 << 32`` becomes 0).  All shapes are static ([r_pad]), so this
+    fuses into the violation-mask program — the join stops being a
+    host-computed bool column and becomes part of the jitted sweep,
+    which is what makes the cross-row kind devpages-eligible.
+
+    ``sel`` is the inventory-side row filter (alive & joined-kind
+    [& namespaced]); ``src``/``inv``/``names`` are int32 id columns
+    with MISSING = -1.  Mirrors the host builder bit-for-bit: missing
+    names still participate on the inventory side (encoded as
+    ``value*big - 1``), the review side counts own-pairs only for
+    present src AND name ids."""
+    sentinel = jnp.int32(np.iinfo(np.int32).max)
+    invsel = sel & (inv >= 0)
+    sh = jnp.sort(jnp.where(invsel, inv, sentinel))
+    left = jnp.searchsorted(sh, src, side="left")
+    right = jnp.searchsorted(sh, src, side="right")
+    total = jnp.where(src >= 0, right - left, 0)
+    if not exclude_same_name:
+        return total > 0
+    # own-pair counts: merge the inventory pairs with the review
+    # queries into one lexsort keyed (value, name, flag) — the flag
+    # axis breaks ties, deciding whether equal inventory pairs sort
+    # before the query (right bound) or after it (left bound), so the
+    # exclusive inventory prefix-count at each query's sorted position
+    # IS the bound and own = right - left.  Counting this way needs no
+    # composite integer key, so it survives 32-bit jax.
+    n = src.shape[0]
+    inm = jnp.where(invsel, names, sentinel)
+    iv = jnp.where(invsel, inv, sentinel)
+    comb_v = jnp.concatenate([iv, jnp.where(src >= 0, src, sentinel)])
+    comb_n = jnp.concatenate([inm, names])
+    is_q = jnp.concatenate([jnp.zeros((n,), bool), jnp.ones((n,), bool)])
+
+    def _bound(q_first: bool) -> jax.Array:
+        flag = jnp.where(is_q == q_first, 0, 1)
+        order = jnp.lexsort((flag, comb_n, comb_v))
+        inv_sorted = ~is_q[order]
+        cum_excl = jnp.cumsum(inv_sorted.astype(jnp.int32)) \
+            - inv_sorted.astype(jnp.int32)
+        qpos = jnp.where(order >= n, order - n, 0)
+        contrib = jnp.where(order >= n, cum_excl, 0)
+        return jnp.zeros((n,), jnp.int32).at[qpos].add(contrib)
+
+    own = jnp.where((src >= 0) & (names >= 0),
+                    _bound(False) - _bound(True), 0)
+    return (total - own) > 0
+
+
 def _eval_topk(program: Program, d: dict[str, jax.Array], k: int,
                score_base: int | None = None):
     """Violation top-k, chunked over R: per-chunk lax.top_k merged into
@@ -763,6 +820,15 @@ class ProgramExecutor:
         self.trace_seconds = 0.0    # cumulative jit-trace (GIL-bound)
         self.compile_seconds = 0.0  # cumulative XLA compile (parallel)
         self.upgrades = 0      # background full-opt recompiles landed
+        # H2D accounting: bytes staged to device through _put (whole
+        # arrays) and _scatter_rows (row-sized update records), split
+        # so the devpages stanza can show churn shipping records
+        # instead of columns.  Plain int adds under the GIL — read by
+        # the driver per sweep as (h2d_bytes, h2d_scatter_bytes,
+        # h2d_scatter_rows) deltas.
+        self.h2d_bytes = 0
+        self.h2d_scatter_bytes = 0
+        self.h2d_scatter_rows = 0
         self._upgrade_q: list = []
         self._upgrade_thread = None
         # multi-chip: a (c, r) jax.sharding.Mesh — bindings device_put
@@ -947,6 +1013,7 @@ class ProgramExecutor:
             self._mesh_divides(bindings.arrays)
 
     def _put(self, name: str, host: np.ndarray, sharded: bool) -> jax.Array:
+        self.h2d_bytes += int(host.nbytes)
         if sharded:
             return jax.device_put(host, self._sharding_of(name))
         import os
@@ -967,18 +1034,22 @@ class ProgramExecutor:
                     # executable cache keys on dtype, so a column later
                     # outgrowing the narrow range simply compiles the
                     # int32 twin once.
-                    return jax.device_put(host.astype(dt))
+                    narrow = host.astype(dt)
+                    self.h2d_bytes += int(narrow.nbytes) - int(host.nbytes)
+                    return jax.device_put(narrow)
         return jax.device_put(host)
 
     def _scatter_rows(self, name: str, dev: jax.Array, host: np.ndarray,
-                      rows: np.ndarray, sharded: bool) -> jax.Array:
+                      rows: np.ndarray, sharded: bool,
+                      axis: int | None = None) -> jax.Array:
         """Device-side delta: replace `rows` along the resource axis of
-        the cached device array with the new host values.  Ships
-        O(|dirty|) bytes instead of the whole column — behind a
+        the cached device array (or an explicit `axis` — id-axis for
+        interner-indexed append-only tables) with the new host values.
+        Ships O(|dirty|) bytes instead of the whole column — behind a
         high-latency tunnel this is what keeps churned steady-state
         sweeps from re-paying full column uploads."""
         from gatekeeper_tpu.ir.prep import bucket
-        ax = _r_axis(name)
+        ax = _r_axis(name) if axis is None else axis
         # pad the dirty set to a power-of-two bucket (repeat the first
         # row; duplicate scatter of identical values is a no-op) so the
         # scatter kernel compiles once per bucket, not once per sweep
@@ -1000,6 +1071,8 @@ class ProgramExecutor:
                 vals = vals.astype(dev.dtype)
             else:
                 return self._put(name, host, sharded)
+        self.h2d_scatter_bytes += int(vals.nbytes) + int(rows.nbytes)
+        self.h2d_scatter_rows += int(len(rows))
         out = dev.at[tuple(idx)].set(jax.device_put(vals))
         if sharded:
             # scatter output placement follows XLA's choice; pin it back
@@ -1023,6 +1096,7 @@ class ProgramExecutor:
         # empty one (RWLock contract: reader-side fills must be benign)
         base = bindings.base
         base_dirty = bindings.base_dirty
+        append_rows = getattr(bindings, "base_append_rows", None) or {}
         arrays = bindings.arrays
         cache = {}
         if base is not None and depth < 8:
@@ -1034,13 +1108,24 @@ class ProgramExecutor:
                     continue
                 if cur is href:
                     cache[name] = (href, dev)
-                elif name in base_dirty and cur.shape == dev.shape \
-                        and href is base.arrays.get(name):
+                elif cur.shape != dev.shape \
+                        or href is not base.arrays.get(name):
+                    continue
+                elif name in base_dirty:
                     cache[name] = (cur, self._scatter_rows(
                         name, dev, cur, base_dirty[name], sharded))
+                elif name in append_rows and len(append_rows[name]):
+                    # append-only interner-indexed array: only the
+                    # newly interned id rows differ from the device
+                    # copy — scatter them along axis 0 instead of
+                    # re-uploading the whole (padded) table
+                    cache[name] = (cur, self._scatter_rows(
+                        name, dev, cur, append_rows[name], sharded,
+                        axis=0))
         cache = caches.setdefault(id(self), cache)
         bindings.base = None          # sever the chain; keep memory flat
         bindings.base_dirty = {}
+        bindings.base_append_rows = {}
         return cache
 
     def _arrays(self, bindings: Bindings, match: np.ndarray | None,
@@ -1089,6 +1174,76 @@ class ProgramExecutor:
         path depends on that), and a donated buffer would be invalidated
         under the cache's feet."""
         self._arrays(bindings, None, None)
+
+    def eval_mask_delta(self, program: Program, bindings: Bindings,
+                        match: np.ndarray | None, old_mask: jax.Array,
+                        page_table: jax.Array, k: int,
+                        ij_specs: tuple = (),
+                        ij_arrays: dict | None = None):
+        """Violation mask AND its delta against the previous resident
+        mask in ONE jitted call — the devpages sweep kernel.
+
+        Evaluates the program over the bindings' device-resident arrays
+        (plus optional in-jit inventory-join columns, computed by
+        :func:`_inv_join_mask` from ``ij_arrays`` input records and
+        injected under their join binding names), gathers through the
+        on-device page table (row -> slot indirection), XORs against
+        ``old_mask``, and compacts the changed bits to a fixed-width
+        (flat index, sign) stream via ``jnp.nonzero(size=k)``.
+
+        Returns ``(new_mask, idx, signs, count, row_any)``: the new
+        mask STAYS ON DEVICE (the caller keeps it resident for the next
+        delta), ``idx`` [k] int32 flat indices into [c_pad * r_pad]
+        (-1 = fill), ``signs`` [k] bool (True = appeared), ``count``
+        the true changed-bit count (> k means the stream overflowed —
+        the caller must fall back to a host re-diff), and ``row_any``
+        [r_pad] bool = any constraint violates the row (the host
+        confirm set for dirty rows) — all but the mask as host numpy.
+
+        H2D here is only what ``_arrays`` stages: unchanged device
+        copies are reused, churned rows arrive as row-sized scatter
+        records (``_scatter_rows``), so transfer bytes scale with
+        churn, never with pages x row width."""
+        arrays = self._arrays(bindings, match)
+        if ij_arrays:
+            arrays = {**arrays, **ij_arrays}
+        names = tuple(sorted(arrays))
+        ij_sig = tuple((nm, bool(ex)) for nm, ex in ij_specs)
+        key = ("devdelta", program.cache_key(), k, ij_sig, R_CHUNK,
+               tuple((nm,) + tuple(arrays[nm].shape)
+                     + (str(arrays[nm].dtype),) for nm in names))
+        with self._lock:
+            fn = self._cache.get(key)
+        if fn is None:
+            def raw(args: tuple, old: jax.Array, pt: jax.Array):
+                args = _widen_args(args)
+                d = dict(zip(names, args))
+                for nm, ex in ij_sig:
+                    d[nm] = _inv_join_mask(
+                        d[f"r:ij.{nm}.src"], d[f"r:ij.{nm}.inv"],
+                        d[f"r:ij.{nm}.sel"], d[f"r:ij.{nm}.names"], ex)
+                new = _eval_mask(program, d)
+                new = jnp.take(new, pt, axis=1)     # slot indirection
+                diff = new ^ old
+                flat = diff.ravel()
+                idx = jnp.nonzero(flat, size=k, fill_value=-1)[0]
+                idx = idx.astype(jnp.int32)
+                signs = jnp.take(new.ravel(), jnp.clip(idx, 0, None))
+                count = jnp.sum(flat, dtype=jnp.int32)
+                return new, idx, signs, count, jnp.any(new, axis=0)
+            with self._trace_lock:
+                fn = jax.jit(raw)
+            with self._lock:
+                fn = self._cache.setdefault(key, fn)
+                self.compiles += 1
+        else:
+            with self._lock:
+                self.cache_hits += 1
+        args = tuple(arrays[nm] for nm in names)
+        new_mask, idx, signs, count, row_any = fn(args, old_mask,
+                                                  page_table)
+        return (new_mask, np.asarray(idx), np.asarray(signs),
+                int(count), np.asarray(row_any))
 
     def _compiled(self, program: Program, arrays: dict, topk: int | None,
                   sharded: bool = False):

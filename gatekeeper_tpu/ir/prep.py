@@ -48,6 +48,18 @@ def bucket(n: int, minimum: int = 8) -> int:
     return b
 
 
+def interner_bucket(n: int) -> int:
+    """Shape bucket for interner-indexed arrays (.ok/.vmap/__strbytes__),
+    sized with growth headroom: the interner is global and append-only,
+    so a bucket sized exactly to ``len(interner)`` at build time is
+    outgrown by the FIRST post-build string anyone interns — which
+    permanently exiles every table-reading kind from the
+    ``update_bindings`` delta path (the in-capacity delta sections
+    host-eval only the new ids; the capacity bail rebuilds everything).
+    25% + 8 slack keeps churn-era interning inside the bucket."""
+    return bucket(n + (n >> 2) + 8, minimum=8)
+
+
 def audit_pads(n_rows: int, n_constraints: int) -> tuple[int, int]:
     """(r_pad, c_pad) device shape buckets for an audit matrix — the
     single source of the padding formulas (build_bindings and the
@@ -66,7 +78,7 @@ def binding_axes(name: str) -> tuple:
     base = name.split(".")[0]
     if name == "__match__":
         return ("c", "r")
-    if name in ("__alive__", "__rank__"):
+    if name in ("__alive__", "__rank__", "__pagetable__"):
         return ("r",)
     if name == "__cvalid__":
         return ("c",)
@@ -440,6 +452,14 @@ class Bindings:
     # append-only way (value tables gaining entries for ids that only
     # dirty rows reference) — row-sliced delta evaluation stays sound
     base_append_only: set = dataclasses.field(default_factory=set)
+    # axis-0 indices appended per append-only array whose existing
+    # entries are untouched: the executor scatters just these rows
+    # into its cached device copy (a full __strbytes__ re-upload per
+    # newly interned string would dwarf the churn itself).  Arrays in
+    # ``base_append_only`` but not here (ptable .any/.all, which grow
+    # along the value axis) re-upload whole — they are tiny.
+    base_append_rows: dict[str, np.ndarray] = \
+        dataclasses.field(default_factory=dict)
     # True when some numeric value bound for the device is not exactly
     # representable in float32 (|v| past 2^24 off the even lattice):
     # device ordering compares could silently mis-order such values
@@ -781,7 +801,7 @@ def build_bindings(spec: PrepSpec, table: ResourceTable,
         src_ids = _src_ids(out, tr.src)
         uniq = np.unique(src_ids)
         uniq = uniq[uniq >= 0]
-        t_pad = bucket(len(interner), minimum=8)
+        t_pad = interner_bucket(len(interner))
         ok = np.zeros((t_pad,), dtype=bool)
         if tr.out == "num":
             vals = np.zeros((t_pad,), dtype=np.float32)
@@ -848,7 +868,7 @@ def build_bindings(spec: PrepSpec, table: ResourceTable,
         src_ids = _src_ids(out, pt.src)
         uniq = np.unique(src_ids)
         uniq = uniq[uniq >= 0]
-        t_pad = bucket(len(interner), minimum=8)
+        t_pad = interner_bucket(len(interner))
         u_pad = bucket(len(uniq) + 1, minimum=2)   # +1: sentinel slot
         vmap = np.full((t_pad,), u_pad - 1, dtype=np.int32)
         vmap[uniq] = np.arange(len(uniq), dtype=np.int32)
@@ -958,7 +978,7 @@ def build_bindings(spec: PrepSpec, table: ResourceTable,
                     B[ci, local[gid]] = True
             out[cs.name + ".B"] = B
         else:
-            t_pad = bucket(len(interner), minimum=8)
+            t_pad = interner_bucket(len(interner))
             u_pad = bucket(len(needed) + 1, minimum=2)   # +1: sentinel
             vmap = np.full((t_pad,), u_pad - 1, dtype=np.int32)
             for gid, li in local.items():
@@ -1026,7 +1046,7 @@ def build_bindings(spec: PrepSpec, table: ResourceTable,
     if spec.dfas:
         from gatekeeper_tpu.ops import regex_dfa
         mat, lens = interner.bytes_table()
-        t_pad = bucket(len(interner), minimum=8)
+        t_pad = interner_bucket(len(interner))
         sb = np.zeros((t_pad, interner.max_str_len), dtype=np.uint8)
         sb[: mat.shape[0]] = mat
         elig, prefixed = _dfa_eligible(mat, lens, interner.max_str_len)
@@ -1109,6 +1129,7 @@ def update_bindings(spec: PrepSpec, table: ResourceTable,
     out = dict(prev.arrays)
     base_dirty: dict[str, np.ndarray] = {}
     append_only: set = set()
+    append_rows: dict[str, np.ndarray] = {}
     state: dict = {"gen": table.generation, "remap": table.remap_generation,
                    "tables": {}, "ptables": {}, "csets": st0["csets"],
                    "elem_counts": {}, "interner_size": 0}
@@ -1116,7 +1137,8 @@ def update_bindings(spec: PrepSpec, table: ResourceTable,
         st1 = dict(st0)
         st1["gen"] = table.generation
         return dataclasses.replace(prev, delta_state=st1, base=prev,
-                                   base_dirty={})
+                                   base_dirty={}, base_append_only=set(),
+                                   base_append_rows={})
     dirty_objs = [objs[int(i)] for i in dirty]
 
     def cow(name: str) -> np.ndarray:
@@ -1249,6 +1271,9 @@ def update_bindings(spec: PrepSpec, table: ResourceTable,
             ok = out[tr.name + ".ok"] = out[tr.name + ".ok"].copy()
             vals = out[tr.name + ".v"] = out[tr.name + ".v"].copy()
             append_only.update((tr.name + ".ok", tr.name + ".v"))
+            id_rows = np.asarray(sorted(new_ids), dtype=np.int64)
+            append_rows[tr.name + ".ok"] = id_rows
+            append_rows[tr.name + ".v"] = id_rows
             if _regex_table_batch(tr, list(new_ids), interner, ok, vals):
                 state["tables"][tr.name] = evaluated | set(new_ids)
                 continue
@@ -1300,6 +1325,10 @@ def update_bindings(spec: PrepSpec, table: ResourceTable,
             t_all = out[pt.name + ".all"] = out[pt.name + ".all"].copy()
             append_only.update((pt.name + ".vmap", pt.name + ".any",
                                 pt.name + ".all"))
+            # .vmap appends id-axis rows; .any/.all grow along the
+            # value-slot axis and stay whole-upload (they are [C, u_pad])
+            append_rows[pt.name + ".vmap"] = \
+                np.asarray(sorted(new_ids), dtype=np.int64)
             distinct = pst["distinct"]
             for gid in new_ids:
                 u = len(u_of)
@@ -1401,11 +1430,15 @@ def update_bindings(spec: PrepSpec, table: ResourceTable,
             sb[old_sz:new_sz] = mat[old_sz:new_sz]
             okv[old_sz:new_sz] = sub_e
             append_only.update(("__strbytes__", "__strdfaok__"))
+            dfa_rows = np.arange(old_sz, new_sz, dtype=np.int64)
+            append_rows["__strbytes__"] = dfa_rows
+            append_rows["__strdfaok__"] = dfa_rows
             host_ids = old_sz + np.nonzero(sub_p & ~sub_e)[0]
             if len(host_ids):
                 for dr in spec.dfas:
                     xv = out[dr.name + ".xv"] = out[dr.name + ".xv"].copy()
                     append_only.add(dr.name + ".xv")
+                    append_rows[dr.name + ".xv"] = dfa_rows
                     _dfa_xv_fill(dr.pattern, interner, xv, host_ids)
         state["dfa_size"] = new_sz
 
@@ -1426,7 +1459,8 @@ def update_bindings(spec: PrepSpec, table: ResourceTable,
                     n_resources=n, c_pad=c_pad, r_pad=r_pad,
                     e_pads=prev.e_pads, delta_state=state,
                     base=prev, base_dirty=base_dirty,
-                    base_append_only=append_only, f32_unsafe=f32_unsafe)
+                    base_append_only=append_only,
+                    base_append_rows=append_rows, f32_unsafe=f32_unsafe)
 
 
 _META_FIELDS = {

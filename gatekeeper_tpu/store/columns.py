@@ -304,3 +304,54 @@ def delta_column(spec: ColSpec, old, objs: list, dirty: np.ndarray,
         return PresenceColumn(present=pres)
     # CSR modes
     return _splice_csr(old, n, dirty, sub)
+
+
+@dataclasses.dataclass(frozen=True)
+class RowRecord:
+    """One column's host-staged row-sized update record: the dirty row
+    indices plus exactly those rows' values, contiguous and ready for a
+    device scatter.  This is the H2D unit of the device-resident paged
+    store (GATEKEEPER_DEVPAGES): churn ships records, never whole
+    columns or whole pages, so transfer bytes scale with churned rows ×
+    read-set columns — the same append-only discipline the interner's
+    byte matrix established, extended to numeric/bitmap columns."""
+
+    name: str
+    rows: np.ndarray           # int [k] dirty row indices
+    values: np.ndarray         # [k, ...] the rows' new values
+
+    @property
+    def nbytes(self) -> int:
+        return int(self.rows.nbytes) + int(self.values.nbytes)
+
+
+def build_row_records(arrays: dict[str, Any], rows: np.ndarray,
+                      axes: dict[str, int | None]) -> \
+        tuple[list[RowRecord], int]:
+    """Stage row-sized update records for every row-axis array.
+
+    ``arrays`` are the bound host arrays (name -> ndarray), ``rows``
+    the dirty row indices, ``axes`` maps each name to the index of its
+    resource axis (None = replicated/table array, not row-addressed —
+    skipped; a change to one invalidates the whole binding set
+    upstream, so records would be meaningless).  Returns the records
+    plus the total staged byte count — the number the
+    ``store_h2d_bytes_total`` metric and the devpages_churn bench row
+    account against whole-page re-upload."""
+    records: list[RowRecord] = []
+    total = 0
+    for name, arr in arrays.items():
+        ax = axes.get(name)
+        if ax is None:
+            continue
+        a = np.asarray(arr)
+        if ax >= a.ndim or a.shape[ax] <= (int(rows.max()) if len(rows)
+                                           else 0):
+            continue
+        idx = [slice(None)] * a.ndim
+        idx[ax] = rows
+        vals = np.ascontiguousarray(a[tuple(idx)])
+        rec = RowRecord(name=name, rows=rows, values=vals)
+        records.append(rec)
+        total += rec.nbytes
+    return records, total

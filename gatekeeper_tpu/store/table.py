@@ -130,20 +130,24 @@ class ResourceTable:
         self._elem_cache: dict[tuple, tuple] = {}   # base -> (gen, counts, cols)
         self._identity_cache: tuple[int, int, IdentityColumns] | None = None
         self._ns_items_cache: tuple[int, dict] | None = None
-        # dirty COLUMN paths + dirty PAGES per write generation.
-        # Replace-upserts log the changed column paths; inserts/removes
-        # log an empty path set (they bump key_generation, which every
-        # path consumer guards on) but DO log their page — the paged
-        # sweep needs delete/insert locality too.  Entries are
-        # (generation, frozenset(paths) | None, frozenset(pages)); a
-        # ``paths=None`` entry is a generation-stamped "widen" marker
-        # left behind when the cap trips — windows spanning it degrade
-        # to "unknown" (full re-sweep) for exactly that interval
-        # instead of silently forever-after.
-        self._path_log: list[tuple[int, frozenset | None, frozenset]] = []
+        # dirty COLUMN paths + dirty PAGES + touched resource KINDS per
+        # write generation.  Replace-upserts log the changed column
+        # paths; inserts/removes log an empty path set (they bump
+        # key_generation, which every path consumer guards on) but DO
+        # log their page — the paged sweep needs delete/insert locality
+        # too.  Entries are (generation, frozenset(paths) | None,
+        # frozenset(pages), frozenset(kinds)); a ``paths=None`` entry
+        # is a generation-stamped "widen" marker left behind when the
+        # cap trips.  The marker carries the UNION of the dropped
+        # half's pages and resource kinds, so consumers degrade to
+        # "all paths of those pages" scoped to templates that can match
+        # one of those kinds — not to a whole-table re-sweep.
+        self._path_log: list[
+            tuple[int, frozenset | None, frozenset, frozenset]] = []
         self._path_floor = 0          # windows starting below: unknown
         self._pending_paths: set[tuple] = set()
         self._pending_pages: set[int] = set()
+        self._pending_kinds: set[str] = set()
         self.page_rows = page_rows_env()
         self.dirtylog_overflows = 0   # widen markers recorded (ever)
 
@@ -164,6 +168,12 @@ class ResourceTable:
 
     def page_of(self, row: int) -> int:
         return row // self.page_rows
+
+    def free_slots(self) -> tuple[int, ...]:
+        """Currently-free row slots (tombstoned, awaiting reuse) — the
+        device pagemap mirrors this so a warm restart adopts the paged
+        layout without a rebuild."""
+        return tuple(self._free)
 
     def _ensure_ver(self, n: int) -> None:
         if len(self._ver) < n:
@@ -202,6 +212,7 @@ class ResourceTable:
             self._objs[row] = obj
             self._metas[row] = meta
         self._pending_pages.add(row // self.page_rows)
+        self._pending_kinds.add(meta.kind)
         if meta.kind == "Namespace" and meta.api_version == "v1":
             self._ns_rows.add(row)
             self._ns_touched = True
@@ -214,21 +225,31 @@ class ResourceTable:
         if self._pending_paths or self._pending_pages:
             self._path_log.append((self.generation,
                                    frozenset(self._pending_paths),
-                                   frozenset(self._pending_pages)))
+                                   frozenset(self._pending_pages),
+                                   frozenset(self._pending_kinds)))
             self._pending_paths = set()
             self._pending_pages = set()
+            self._pending_kinds = set()
             if len(self._path_log) > PATH_LOG_CAP:
                 # Cap trip: drop the older half, but leave a widen
                 # marker (paths=None) stamped with the last dropped
-                # generation.  Windows that span the marker degrade to
-                # "unknown" — the paged sweep falls back to full-kind
-                # for exactly the overflowed interval, counted via
-                # store_dirtylog_overflow_total — instead of the old
-                # behavior of moving the floor (unknown forever after).
+                # generation and carrying the union of the dropped
+                # half's pages and resource kinds.  Windows spanning
+                # the marker degrade to "all paths of those pages" —
+                # and only for templates matching one of those kinds
+                # (store_dirtylog_overflow_total counts the trips) —
+                # instead of a whole-table unknown.
                 drop = len(self._path_log) // 2
                 widen_gen = self._path_log[drop - 1][0]
+                w_pages: set[int] = set()
+                w_kinds: set[str] = set()
+                for _g, _paths, pgs, kinds in self._path_log[:drop]:
+                    w_pages |= pgs
+                    w_kinds |= kinds
                 del self._path_log[:drop]
-                self._path_log.insert(0, (widen_gen, None, frozenset()))
+                self._path_log.insert(0, (widen_gen, None,
+                                          frozenset(w_pages),
+                                          frozenset(w_kinds)))
                 self.dirtylog_overflows += 1
 
     def upsert(self, key: str, obj: dict, meta: ResourceMeta) -> int:
@@ -256,6 +277,9 @@ class ResourceTable:
         row = self._rows.pop(key, None)
         if row is None:
             return False
+        old_meta = self._metas[row]
+        if old_meta is not None:
+            self._pending_kinds.add(old_meta.kind)
         self._objs[row] = None
         self._metas[row] = None
         self._free.append(row)
@@ -285,6 +309,7 @@ class ResourceTable:
         self._path_log.clear()
         self._pending_paths.clear()
         self._pending_pages.clear()
+        self._pending_kinds.clear()
         self.generation += 1
         self.remap_generation += 1
         self.key_generation += 1
@@ -303,6 +328,7 @@ class ResourceTable:
         self._path_log.clear()
         self._pending_paths.clear()
         self._pending_pages.clear()
+        self._pending_kinds.clear()
         self.generation += 1
         self.remap_generation += 1
         self.key_generation += 1
@@ -377,7 +403,7 @@ class ResourceTable:
         if gen < self._path_floor:
             return None
         out: set = set()
-        for g, paths, _pages in reversed(self._path_log):
+        for g, paths, _pages, _kinds in reversed(self._path_log):
             if g <= gen:
                 break
             if paths is None:       # widen marker inside the window
@@ -386,34 +412,38 @@ class ResourceTable:
         return frozenset(out)
 
     def dirty_page_entries_since(self, gen: int) \
-            -> list[tuple[int, frozenset, frozenset]] | None:
+            -> list[tuple[int, frozenset | None,
+                          frozenset, frozenset]] | None:
         """Log entries newer than generation ``gen`` in write order —
-        each ``(generation, paths, pages)`` — or None when the window
-        predates the log or spans a widen marker.  Watch events are
-        one-row-per-entry, so a consumer can intersect each entry's
-        paths with a kind's read-set and collect only the pages whose
-        changes that kind can observe."""
+        each ``(generation, paths, pages, kinds)`` — or None when the
+        window predates the log.  Watch events are one-row-per-entry,
+        so a consumer can intersect each entry's paths with a kind's
+        read-set and collect only the pages whose changes that kind can
+        observe.  A cap-overflow widen marker inside the window comes
+        back as a ``paths=None`` entry whose pages/kinds are the
+        dropped half's unions: its paths are unattributable (treat as
+        "every path"), but a consumer whose matched resource kinds are
+        disjoint from the entry's kinds can skip it outright."""
         if gen < self._path_floor:
             return None
         newer: list = []
-        for g, paths, pages in reversed(self._path_log):
+        for g, paths, pages, kinds in reversed(self._path_log):
             if g <= gen:
                 break
-            if paths is None:       # widen marker inside the window
-                return None
-            newer.append((g, paths, pages))
+            newer.append((g, paths, pages, kinds))
         newer.reverse()
         return newer
 
     def dirty_pages_since(self, gen: int) -> frozenset | None:
         """Union of pages touched after generation ``gen`` (upserts,
-        inserts AND removes), or None on floor/widen — see
-        ``dirty_page_entries_since``."""
+        inserts AND removes), or None when the window predates the log
+        — see ``dirty_page_entries_since``.  Widen markers contribute
+        their dropped-half page unions (exact, just unattributed)."""
         entries = self.dirty_page_entries_since(gen)
         if entries is None:
             return None
         out: set = set()
-        for _g, _paths, pages in entries:
+        for _g, _paths, pages, _kinds in entries:
             out |= pages
         return frozenset(out)
 

@@ -282,6 +282,46 @@ class TestDriverChurnParity:
         deltas = jx.driver.metrics.counter("bindings_delta_updates").value
         assert deltas > deltas0, "delta path never engaged"
 
+    def test_binding_delta_off_is_bit_identical_oracle(self, monkeypatch):
+        """GATEKEEPER_BINDING_DELTA=off rebuilds bindings whole every
+        generation — the delta chain's off-switch oracle (and the
+        re-stage comparator the devpages_churn bench measures H2D
+        against).  Verdicts must match the delta-on driver exactly
+        across churn, and the delta counter must stay at zero."""
+        rng = random.Random(31)
+        _, jx_on = self._clients()
+        monkeypatch.setenv("GATEKEEPER_BINDING_DELTA", "off")
+        _, jx_off = self._clients()
+        for t, c in all_docs():
+            for cl in (jx_on, jx_off):
+                cl.add_template(t)
+                cl.add_constraint(c)
+        objs = make_mixed(rng, 60)
+        for o in objs:
+            jx_on.add_data(o)
+            jx_off.add_data(o)
+        monkeypatch.delenv("GATEKEEPER_BINDING_DELTA")
+        monkeypatch.setenv("GATEKEEPER_BINDING_DELTA", "on")
+        r_on = self._results(jx_on)
+        monkeypatch.setenv("GATEKEEPER_BINDING_DELTA", "off")
+        assert r_on == self._results(jx_off)
+        for round_ in range(2):
+            upd = make_mixed(rng, 5)
+            for o in upd:
+                o["metadata"]["name"] = f"pod{rng.randrange(60)}"
+                o["kind"] = "Pod"
+                o["apiVersion"] = "v1"
+                jx_on.add_data(o)
+                jx_off.add_data(o)
+            monkeypatch.setenv("GATEKEEPER_BINDING_DELTA", "on")
+            r_on = self._results(jx_on)
+            monkeypatch.setenv("GATEKEEPER_BINDING_DELTA", "off")
+            assert r_on == self._results(jx_off), f"round {round_}"
+        assert jx_off.driver.metrics.counter(
+            "bindings_delta_updates").value == 0
+        assert jx_on.driver.metrics.counter(
+            "bindings_delta_updates").value > 0
+
 
 class TestDeviceBatchReview:
     """query_review_batch's [C, B] device pass must match per-review

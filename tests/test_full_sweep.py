@@ -136,7 +136,7 @@ def test_full_sweep_device_path_parity_and_phases(monkeypatch):
     c.audit()
     assert jd.last_sweep_phases["full"] is False
     assert set(jd.last_sweep_phases) <= {"full", "footprint", "shard",
-                                         "pages"}
+                                         "pages", "devpages"}
 
     # oracle parity for the same workload
     ld = LocalDriver()

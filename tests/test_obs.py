@@ -312,7 +312,7 @@ class TestAttribution:
         _audit(jd, full=False)
         assert jd.last_sweep_phases["full"] is False
         assert set(jd.last_sweep_phases) <= {"full", "footprint", "shard",
-                                             "pages"}
+                                             "pages", "devpages"}
 
 
 # ----------------------------------------------------------------------

@@ -118,10 +118,12 @@ class TestPageDirtyBits:
         t.upsert("p0", {"kind": "Pod", "spec": {"a": -1}}, _meta("p0"))
         t.upsert("p9", {"kind": "Pod", "spec": {"a": -2}}, _meta("p9"))
         entries = t.dirty_page_entries_since(g)
-        assert [pages for _g, _p, pages in entries] == \
+        assert [pages for _g, _p, pages, _k in entries] == \
             [frozenset({0}), frozenset({2})]
         assert all(p == frozenset({("spec", "a")})
-                   for _g, p, _pg in entries)
+                   for _g, p, _pg, _k in entries)
+        assert all(k == frozenset({"Pod"})
+                   for _g, _p, _pg, k in entries)
 
     def test_compact_floors_the_page_log(self, monkeypatch):
         t = self._table(monkeypatch)
@@ -137,9 +139,17 @@ class TestPageDirtyBits:
         for i in range(20):                     # spill the log
             t.upsert("p1", {"kind": "Pod", "spec": {"a": i}}, _meta("p1"))
         assert t.dirtylog_overflows > 0
-        # a window spanning the marker degrades to "unknown"...
-        assert t.dirty_pages_since(g) is None
+        # a window spanning the marker loses PATH attribution but
+        # keeps the dropped half's page/kind unions exact — consumers
+        # re-eval those pages (for matching kinds) instead of the world
         assert t.dirty_paths_since(g) is None
+        spanning = t.dirty_pages_since(g)
+        assert spanning is not None
+        assert t.page_of(t.lookup("p1")) in spanning
+        entries = t.dirty_page_entries_since(g)
+        widens = [e for e in entries if e[1] is None]
+        assert widens and all(k == frozenset({"Pod"})
+                              for _g, _p, _pg, k in widens)
         # ...but a window after it is exact again
         g2 = t.generation
         t.upsert("p6", {"kind": "Pod", "spec": {"a": 0}}, _meta("p6"))
@@ -333,7 +343,13 @@ class TestPagedSweep:
         want = _verdicts(_sweep(jd_o, opts, pages=False))
         assert got == want
         pg = dict(jd_p.last_sweep_phases.get("pages") or {})
-        assert pg["rows_padded"] > 0
+        dvp = dict(jd_p.last_sweep_phases.get("devpages") or {})
+        if dvp.get("kinds_device"):
+            # device-resident path: rows ride fixed slot arrays — no
+            # host page padding happens, the delta kernel covers it
+            assert pg["rows_reevaluated"] >= 0
+        else:
+            assert pg["rows_padded"] > 0
 
 
 # ---------------------------------------------------------------------------
@@ -370,7 +386,13 @@ class TestOverflowWiden:
         want = _verdicts(_sweep(jd_o, opts, pages=False))
         assert got == want                      # parity through the widen
         pg = dict(jd_p.last_sweep_phases.get("pages") or {})
-        assert pg["widen_fallbacks"] == len(self.KINDS)
+        dvp = dict(jd_p.last_sweep_phases.get("devpages") or {})
+        if dvp.get("kinds_device"):
+            # the device path's dirty set is _ver-exact (log-free):
+            # a path-log widen never forces it anywhere
+            assert pg["widen_fallbacks"] == 0
+        else:
+            assert pg["widen_fallbacks"] == len(self.KINDS)
         # the next (small) churn is back on the exact paged path
         o = copy.deepcopy(resources[0])
         o.setdefault("metadata", {}).setdefault(
