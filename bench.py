@@ -220,12 +220,12 @@ def _slim_headline() -> dict:
         slim["watch_latency"] = {k: wl.get(k) for k in
                                  ("parity", "p50_ms", "p99_ms")
                                  if wl.get(k) is not None}
+    # headline budget: only ci-asserted keys ride in slim stanzas from
+    # here on — everything measured stays in BENCH_partial.json
     tv = DETAIL.get("transval")
     if isinstance(tv, dict):
         slim["transval"] = {k: tv.get(k) for k in
-                            ("certify_wall_seconds",
-                             "templates_certified", "counterexamples",
-                             "models_checked")
+                            ("templates_certified", "counterexamples")
                             if tv.get(k) is not None}
     sh = DETAIL.get("shard_sim")
     if isinstance(sh, dict):
@@ -245,14 +245,23 @@ def _slim_headline() -> dict:
     rp = DETAIL.get("replay")
     if isinstance(rp, dict):
         slim["replay"] = {k: rp.get(k) for k in
-                          ("parity", "parity_digest", "stream_match")
+                          ("parity", "stream_match")
                           if rp.get(k) is not None}
     fs2 = DETAIL.get("fleet_stack")
     if isinstance(fs2, dict):
         slim["fleet_stack"] = {k: fs2.get(k) for k in
-                               ("clusters", "parity", "kinds_stacked",
-                                "device_dispatches")
+                               ("clusters", "parity", "kinds_stacked")
                                if fs2.get(k) is not None}
+    pm = DETAIL.get("promotion")
+    if isinstance(pm, dict):
+        pr = {k: pm.get(k) for k in
+              ("replay_speedup", "parity", "final_rung",
+               "fleet_graduated")
+              if pm.get(k) is not None}
+        if pm.get("parity_digest"):
+            pr["digest"] = pm["parity_digest"]
+        if pr:
+            slim["promotion"] = pr
     rx = DETAIL.get("regex_high_cardinality")
     rh = DETAIL.get("regex_heavy")
     if isinstance(rx, dict) or isinstance(rh, dict):
@@ -1967,6 +1976,216 @@ def bench_whatif(detail):
             f"fleet parity mismatch: {frep.digests} vs {digests}")
 
 
+def bench_promotion(detail):
+    """Policy promotion pipeline rows (ROADMAP item 5, PR 18):
+
+    - ``replay_speedup``: the shadow→replayed evidence gate's batched
+      corpus replay (``client.review_batch``, the device micro-batch
+      seam forced eligible) vs the scalar per-event oracle — gate ≥3x,
+      with the sha256 stream digests bit-identical on both paths.
+      Measured on the regime the micro-batcher exists for: a
+      constraint-dense policy set (200 constraints, the
+      admission_device_batch shape) over a recorded-ALLOWED corpus —
+      the promotion gate's own precondition — where the device mask
+      over-approximation gates nearly every (constraint, review) pair
+      out and host re-verify collapses; a violator-heavy corpus makes
+      both paths re-verify everything and measures nothing;
+    - ``promote``: end-to-end PromotionController run candidate→deny
+      over a mixed recorded corpus on the full library client (wall +
+      replay-gate evidence);
+    - ``fleet``: 4-cluster ``graduate_fleet`` map-reduce promotion wall.
+
+    Deliberately sized ≤2k rows: the gates are RATIOS and digests, not
+    absolute walls, so the row also validates on the CPU fallback —
+    the north-star-sized phases are where absolute numbers live."""
+    import gatekeeper_tpu.engine.jax_driver as jd_mod
+    from gatekeeper_tpu.obs import flightrecorder as fr
+    from gatekeeper_tpu.rollout import PromotionController, graduate_fleet
+    from gatekeeper_tpu.webhook.policy import ValidationHandler
+    from gatekeeper_tpu.whatif import make_cluster
+    from gatekeeper_tpu.whatif.replay import (replay_admissions,
+                                              replay_admissions_batched)
+
+    n = sized(2_000, 400, 2_000)
+    log(f"[promotion] n={n}, replay gate / controller / 4-cluster fleet")
+    templates = [t for t, _c in all_docs()]
+    constraints = [c for _t, c in all_docs()]
+    candidate = constraints[1:]
+
+    def _record(client, objs, directory):
+        """Record ``objs`` through the webhook handler into the durable
+        capture log at ``directory`` (the same store probe --rollout
+        health-checks) and return the decoded corpus."""
+        os.environ["GATEKEEPER_FLIGHT_DIR"] = directory
+        os.environ["GATEKEEPER_FLIGHT_ADMISSION"] = "1"
+        wh = ValidationHandler(client)
+        rec = fr.FlightRecorder(ring=64)
+        saved_rec, fr._recorder = fr._recorder, rec
+        try:
+            for obj in objs:
+                wh.handle({"uid": "u", "operation": "CREATE",
+                           "kind": {"group": "", "version": "v1",
+                                    "kind": obj.get("kind", "")},
+                           "userInfo": {"username": "bench", "groups": []},
+                           "object": obj})
+        finally:
+            fr._recorder = saved_rec
+            try:
+                if rec._capture is not None:
+                    rec._capture.close()
+            except Exception:   # noqa: BLE001
+                pass
+        return fr.load_admission_corpus(directory)
+
+    saved_env = {k: os.environ.get(k) for k in
+                 ("GATEKEEPER_FLIGHT_DIR", "GATEKEEPER_FLIGHT_ADMISSION",
+                  "GATEKEEPER_SNAPSHOT_DIR")}
+    work = tempfile.mkdtemp(prefix="gk-promotion-")
+    saved_thresh = jd_mod.REVIEW_BATCH_MIN_EVALS
+    try:
+        os.environ["GATEKEEPER_SNAPSHOT_DIR"] = os.path.join(work, "snaps")
+
+        # --- replay_speedup: the evidence-gate hot path ----------------
+        # (device-path measurement: with a dead backend review_batch
+        # routes to the scalar loop and the ratio measures nothing —
+        # skip it like admission_device_batch does)
+        if FALLBACK:
+            revents, srep = [], None
+            s_s = b_s = speedup = parity = None
+            log("[promotion] replay gate skipped "
+                "(device backend unavailable)")
+        else:
+            rng = random.Random(5)
+            rjd = JaxDriver()
+            rc = Backend(rjd).new_client([K8sValidationTarget()])
+            rc.add_template(template_doc("K8sRequiredLabels",
+                                         REQUIRED_LABELS))
+            rc.add_template(template_doc("K8sAllowedRepos", ALLOWED_REPOS))
+            for j in range(100):
+                rc.add_constraint(constraint_doc(
+                    "K8sRequiredLabels", f"lab-{j:03d}",
+                    {"labels": rng.sample([f"l{x}" for x in range(10)],
+                                          k=2)}))
+                rc.add_constraint(constraint_doc(
+                    "K8sAllowedRepos", f"rep-{j:03d}",
+                    {"repos": ["gcr.io/",
+                               rng.choice(["docker.io/", "quay.io/",
+                                           "ghcr.io/"])]}))
+            rc.add_data_batch(make_mixed(random.Random(29), min(n, 500)))
+            clean = [{"apiVersion": "v1", "kind": "Pod",
+                      "metadata": {"name": f"clean-{i:03d}",
+                                   "namespace": "default",
+                                   "labels": {f"l{x}": "v"
+                                              for x in range(10)}},
+                      "spec": {"containers": [
+                          {"name": "app",
+                           "image": f"gcr.io/proj/app:{i}"}]}}
+                     for i in range(64)]
+            revents = _record(rc, clean, os.path.join(work, "replay")) * 8
+            jd_mod.REVIEW_BATCH_MIN_EVALS = 1   # force the [B, C] pass
+            brep = replay_admissions_batched(revents, rc,
+                                             batch_size=len(revents))
+            b_s = math.inf
+            for _ in range(2):
+                t0 = time.perf_counter()
+                brep = replay_admissions_batched(revents, rc,
+                                                 batch_size=len(revents))
+                b_s = min(b_s, time.perf_counter() - t0)
+            srep = replay_admissions(revents, rc)                  # warm
+            s_s = math.inf
+            for _ in range(2):
+                t0 = time.perf_counter()
+                srep = replay_admissions(revents, rc)
+                s_s = min(s_s, time.perf_counter() - t0)
+            speedup = s_s / max(b_s, 1e-9)
+            parity = (srep.digest == brep.digest
+                      and srep.replayed == brep.replayed)
+            log(f"[promotion] replay: {len(revents)} events scalar "
+                f"{s_s:.3f}s batched {b_s:.3f}s ({speedup:.1f}x) "
+                f"parity={parity} digest={srep.digest}")
+            del rc, rjd
+
+        # --- promote: candidate → deny on the library client -----------
+        jd = JaxDriver()
+        c = Backend(jd).new_client([K8sValidationTarget()])
+        for tdoc, cdoc in all_docs():
+            c.add_template(tdoc)
+            c.add_constraint(cdoc)
+        c.add_data_batch(make_mixed(random.Random(29), n))
+        events = _record(c, make_mixed(random.Random(31), 48),
+                         os.path.join(work, "promo")) * 4
+        ctrl = PromotionController(c, templates, candidate,
+                                   name="bench", events=events,
+                                   limit_per_constraint=CAP)
+        t0 = time.perf_counter()
+        final = ctrl.run(target_rung="deny")
+        promote_s = time.perf_counter() - t0
+        gate = ctrl.evidence.get("replay_gate", {})
+        rungs = [h["to"] for h in ctrl.history]
+        log(f"[promotion] promote: {' -> '.join(rungs)} in {promote_s:.2f}s "
+            f"({gate.get('unexpected_denials', '?')} unexpected denials)")
+    finally:
+        jd_mod.REVIEW_BATCH_MIN_EVALS = saved_thresh
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        shutil.rmtree(work, ignore_errors=True)
+    del ctrl, c, jd
+    import gc as _gc
+    _gc.collect()
+
+    # --- fleet: 4-cluster map-reduce graduation ------------------------
+    n_clusters = 4
+    per = max(n // (n_clusters * 2), 50)
+    fleet = [make_cluster(f"p{i}", templates, constraints,
+                          objs=make_mixed(random.Random(200 + i), per))
+             for i in range(n_clusters)]
+    graduate_fleet(fleet, templates, candidate,
+                   limit_per_constraint=CAP, block_size=2)   # compile/warm
+    frep = graduate_fleet(fleet, templates, candidate,
+                          limit_per_constraint=CAP, block_size=2)
+    log(f"[promotion] {frep.headline()}")
+
+    detail["promotion"] = {
+        "n_resources": n,
+        "replay_events": len(revents),
+        "promo_events": len(events),
+        "scalar_seconds": s_s if s_s is None else round(s_s, 3),
+        "batched_seconds": b_s if b_s is None else round(b_s, 3),
+        "replay_speedup": speedup if speedup is None
+        else round(speedup, 2),
+        "parity": parity,
+        "parity_digest": srep.digest if srep is not None else None,
+        "final_rung": final,
+        "rungs": rungs,
+        "unexpected_denials": gate.get("unexpected_denials"),
+        "promote_wall_s": round(promote_s, 3),
+        "fleet_clusters": n_clusters,
+        "fleet_rows_per_cluster": per,
+        "fleet_graduated": frep.graduated,
+        "fleet_blocks": frep.n_blocks,
+        "fleet_dispatches": frep.device_dispatches,
+        "fleet_wall_s": round(frep.wall_s, 3),
+    }
+    if parity is False:
+        raise AssertionError(
+            f"promotion replay parity mismatch: scalar {srep.digest} vs "
+            f"batched {brep.digest}")
+    if final != "deny" or gate.get("unexpected_denials") != 0:
+        raise AssertionError(
+            f"promotion did not graduate cleanly: final={final} "
+            f"gate={gate}")
+    if frep.graduated != n_clusters:
+        raise AssertionError(f"fleet graduation incomplete: "
+                             f"{frep.headline()}")
+    if speedup is not None and speedup < 3.0:
+        raise AssertionError(
+            f"batched replay speedup {speedup:.2f}x below the 3x gate "
+            f"(scalar {s_s:.3f}s vs batched {b_s:.3f}s)")
+
+
 def bench_transval(detail):
     """Stage-4 translation validation at library scale: certify every
     device-lowered built-in template against the interpreter on its
@@ -2697,6 +2916,8 @@ def main():
     run_phase("shard_sim", bench_shard_sim, 300)
     quiesce_upgrades()
     run_phase("whatif", bench_whatif, 400)
+    quiesce_upgrades()
+    run_phase("promotion", bench_promotion, 300)
     quiesce_upgrades()
     run_phase("regex_heavy", bench_regex_heavy, 300)
     run_phase("selector_heavy", bench_selector_heavy, 300)

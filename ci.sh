@@ -97,6 +97,27 @@ echo "$WI"
 echo "$WI" | grep -q " 0 parity failure(s)" \
   || { echo "whatif stage found parity failures" >&2; exit 1; }
 
+echo "== rollout (policy promotion pipeline probe) =="
+# Promotion pipeline self-check (rollout/): a seeded candidate must
+# graduate candidate → shadow → replayed → dryrun → warn → deny on
+# recorded evidence alone — capture-log health (0 drops / torn tails /
+# write errors), batched-replay digest parity with the scalar oracle,
+# zero unexpected denials — and the 4-cluster graduation plan must
+# land with every cluster graduated.  rc=1 is the warning tier (scalar
+# fallback — the evidence gates still hold); rc=2 fails the build.
+RO_RC=0
+RO=$(JAX_PLATFORMS=cpu timeout -k 10 240 \
+     python -m gatekeeper_tpu.client.probe --rollout | tail -12) || RO_RC=$?
+echo "$RO"
+[ "$RO_RC" -le 1 ] \
+  || { echo "rollout stage failed (rc=$RO_RC)" >&2; exit 1; }
+echo "$RO" | grep -q "0 unexpected denial(s)" \
+  || { echo "rollout stage saw unexpected denials" >&2; exit 1; }
+echo "$RO" | grep -q " 0 gate failure(s)" \
+  || { echo "rollout stage reported gate failures" >&2; exit 1; }
+echo "$RO" | grep -Eq "fleet: [0-9]+/[0-9]+ graduated, 0 blocked" \
+  || { echo "rollout stage fleet plan incomplete" >&2; exit 1; }
+
 echo "== devpages (device-resident page table, library parity) =="
 # Device-resident paged store (GATEKEEPER_DEVPAGES=on,
 # enforce/devpages.py): per-kind device residency over the library with
@@ -359,6 +380,18 @@ assert rx.get("in_jit_vs_host_loop", 0) >= 10, \
 ov = d.get("overload")
 assert isinstance(ov, dict) and ov.get("within_budget") is True, \
     f"no within-budget overload row in the trailing headline: {d}"
+# the promotion row must survive the window: the rollout evidence
+# gate's batched corpus replay must beat the scalar replay oracle by
+# >=3x with bit-identical sha256 verdict digests, the controller must
+# graduate to deny, and the 4-cluster fleet plan must fully graduate
+pm = d.get("promotion")
+assert isinstance(pm, dict) and pm.get("parity") is True \
+    and pm.get("replay_speedup", 0) >= 3 \
+    and pm.get("final_rung") == "deny" \
+    and pm.get("fleet_graduated", 0) >= 4 \
+    and pm.get("digest"), \
+    f"no promotion row (>=3x replay, parity digest, deny, 4-cluster " \
+    f"fleet) in the trailing headline: {d}"
 print("bench ok:", d["metric"], round(d["value"], 1), d["unit"],
       f"({len(line)} headline chars; external_data warm "
       f"{xd['warm_seconds']}s vs baseline {xd['baseline_seconds']}s; "
@@ -373,6 +406,9 @@ print("bench ok:", d["metric"], round(d["value"], 1), d["unit"],
       f"shadow {ss.get('ratio')}x parity {ss.get('parity_digest')}; "
       f"fleet {fs.get('clusters')} clusters parity ok; overload 2x p99 "
       f"{ov.get('p99_2x_ms')}ms within budget; regex "
-      f"{rx.get('in_jit_vs_host_loop')}x parity {rx.get('parity_digest')})")
+      f"{rx.get('in_jit_vs_host_loop')}x parity {rx.get('parity_digest')}; "
+      f"promotion replay {pm.get('replay_speedup')}x parity "
+      f"{pm.get('digest')} -> {pm.get('final_rung')} with "
+      f"{pm.get('fleet_graduated')} clusters graduated)")
 EOF
 echo "CI PASS"

@@ -873,14 +873,17 @@ def run_shardplan(paths: list[str], use_library: bool = False) -> int:
 
 
 def run_whatif() -> int:
-    """``--whatif``: self-validate the what-if engine's three parity
+    """``--whatif``: self-validate the what-if engine's four parity
     contracts over the built-in library (ROADMAP item 5) —
 
     - shadow: one combined live ∪ candidate sweep, candidate half
       bit-identical to a standalone candidate install;
     - replay: a store-snapshot re-audit reproduces the live verdicts;
     - fleet: a 2-cluster stacked mega-sweep matches the per-cluster
-      loop oracle.
+      loop oracle;
+    - stream: a webhook-recorded admission corpus replays exactly both
+      scalar and through the device micro-batcher (identical digests),
+      with byte-capped events surfaced in ``skipped_oversize``.
 
     Exit contract (:func:`_severity_rc`): 2 on any parity break, 1 when
     parity held but only on the scalar fallback (semantics validated,
@@ -888,6 +891,7 @@ def run_whatif() -> int:
     0 clean on the device path."""
     import os as _os
     import random
+    import tempfile
 
     from gatekeeper_tpu.client.client import Backend
     from gatekeeper_tpu.engine.jax_driver import JaxDriver
@@ -895,7 +899,9 @@ def run_whatif() -> int:
     from gatekeeper_tpu.target.k8s import K8sValidationTarget
     from gatekeeper_tpu.whatif import (ShadowSession, fleet_audit,
                                        fleet_loop_oracle, make_cluster,
-                                       normalize_results, replay_snapshot,
+                                       normalize_results, replay_admissions,
+                                       replay_admissions_batched,
+                                       replay_snapshot,
                                        standalone_candidate_verdicts,
                                        verdict_digest)
 
@@ -949,6 +955,62 @@ def run_whatif() -> int:
           f"{frep.device_dispatches} dispatch(es), digests="
           f"{','.join(frep.digests)}")
 
+    # admission-stream replay: record a small corpus through the
+    # webhook handler into a throwaway capture log, replay it scalar
+    # AND through the device micro-batcher, and demand exact
+    # reproduction with bit-identical stream digests; one synthetic
+    # byte-capped event must land in skipped_oversize, not be guessed
+    # at (rollout's promotion gate consumes exactly this report).
+    from gatekeeper_tpu.obs import flightrecorder as fr
+    from gatekeeper_tpu.webhook.policy import ValidationHandler
+    with tempfile.TemporaryDirectory() as tmp:
+        saved_env = {k: _os.environ.get(k)
+                     for k in ("GATEKEEPER_FLIGHT_DIR",
+                               "GATEKEEPER_FLIGHT_ADMISSION")}
+        saved_rec = fr._recorder
+        _os.environ["GATEKEEPER_FLIGHT_DIR"] = tmp
+        _os.environ["GATEKEEPER_FLIGHT_ADMISSION"] = "1"
+        fr._recorder = None
+        try:
+            vh = ValidationHandler(client)
+            recorded = make_mixed(random.Random(11), min(n, 48))
+            for obj in recorded:
+                vh.handle({
+                    "uid": "u", "operation": "CREATE",
+                    "kind": {"group": "", "version": "v1",
+                             "kind": obj.get("kind", "")},
+                    "name": (obj.get("metadata") or {}).get("name", ""),
+                    "userInfo": {"username": "probe", "groups": []},
+                    "object": obj})
+            events = fr.load_admission_corpus(tmp)
+        finally:
+            tmp_rec = fr._recorder
+            fr._recorder = saved_rec
+            for k, v in saved_env.items():
+                if v is None:
+                    _os.environ.pop(k, None)
+                else:
+                    _os.environ[k] = v
+            try:
+                if tmp_rec is not None and tmp_rec._capture is not None:
+                    tmp_rec._capture.close()
+            except Exception:   # noqa: BLE001 — probe hygiene only
+                pass
+    events.append({"request": {"object": {"__truncated__": True,
+                                          "metadata": {"name": "big"}}},
+                   "allowed": True, "verdicts": []})
+    srep = replay_admissions(events, client)
+    brep = replay_admissions_batched(events, client)
+    ok = (srep.exact and brep.exact
+          and srep.replayed == brep.replayed == len(recorded)
+          and srep.digest == brep.digest
+          and srep.skipped_oversize == brep.skipped_oversize == 1)
+    n_err += 0 if ok else 1
+    print(f"  {'ok  ' if ok else 'FAIL'} stream: {brep.replayed} "
+          f"event(s) replayed, {brep.skipped_oversize} oversize "
+          f"skipped, {brep.skipped} error(s), scalar={srep.digest} "
+          f"batched={brep.digest}")
+
     scalar = bool(getattr(driver, "scalar_only", False))
     if scalar:
         print("  warn scalar-only backend: parity validated on the "
@@ -956,6 +1018,158 @@ def run_whatif() -> int:
     print(f"whatif: {n_err} parity failure(s) "
           f"({'scalar-fallback' if scalar else 'device'})")
     return _severity_rc(n_err, 1 if scalar else 0)
+
+
+def run_rollout(use_library: bool = False) -> int:
+    """``--rollout [--library]``: self-contained candidate promotion
+    against a seeded corpus (ROADMAP item 5, PR 18).  Builds a live
+    client (a 4-template subset by default, the full builtin library
+    with ``--library``), records an admission corpus through the
+    webhook handler into a throwaway capture log, then drives a
+    constraint-only candidate through the full promotion ladder —
+    shadow sweep → batched corpus replay (scalar-oracle parity) →
+    dryrun → warn → deny — and prints the per-rung evidence, the
+    capture-log health counters, and a 4-cluster fleet graduation
+    plan.  All snapshot/flight side effects land in a temp dir.
+
+    Exit contract (:func:`_severity_rc`): 2 when the candidate fails
+    to graduate (or the fleet plan blocks/holds a cluster), 1 when it
+    graduated but only on the scalar fallback, 0 clean on device."""
+    import os as _os
+    import random
+    import sys
+    import tempfile
+    import time as _time
+
+    from gatekeeper_tpu.client.client import Backend
+    from gatekeeper_tpu.engine.jax_driver import JaxDriver
+    from gatekeeper_tpu.library import all_docs, make_mixed
+    from gatekeeper_tpu.obs import flightrecorder as fr
+    from gatekeeper_tpu.rollout import PromotionController, graduate_fleet
+    from gatekeeper_tpu.target.k8s import K8sValidationTarget
+    from gatekeeper_tpu.webhook.policy import ValidationHandler
+    from gatekeeper_tpu.whatif import make_cluster
+
+    t0 = _time.perf_counter()
+    n = int(_os.environ.get("GATEKEEPER_ROLLOUT_PROBE_N", "200"))
+    pairs = all_docs() if use_library else all_docs()[:4]
+    templates = [t for t, _c in pairs]
+    constraints = [c for _t, c in pairs]
+    driver = JaxDriver()
+    handler = K8sValidationTarget()
+    client = Backend(driver).new_client([handler])
+    for d in templates:
+        client.add_template(d)
+    for d in constraints:
+        client.add_constraint(d)
+    client.add_data_batch(make_mixed(random.Random(7), n))
+    n_err = n_warn = 0
+
+    with tempfile.TemporaryDirectory() as tmp:
+        saved_env = {k: _os.environ.get(k)
+                     for k in ("GATEKEEPER_FLIGHT_DIR",
+                               "GATEKEEPER_FLIGHT_ADMISSION",
+                               "GATEKEEPER_SNAPSHOT_DIR")}
+        saved_rec = fr._recorder
+        _os.environ["GATEKEEPER_FLIGHT_DIR"] = tmp
+        _os.environ["GATEKEEPER_FLIGHT_ADMISSION"] = "1"
+        _os.environ["GATEKEEPER_SNAPSHOT_DIR"] = \
+            _os.path.join(tmp, "snaps")
+        fr._recorder = None
+        try:
+            vh = ValidationHandler(client)
+            for obj in make_mixed(random.Random(23), min(n, 64)):
+                vh.handle({
+                    "uid": "u", "operation": "CREATE",
+                    "kind": {"group": "", "version": "v1",
+                             "kind": obj.get("kind", "")},
+                    "name": (obj.get("metadata") or {}).get("name", ""),
+                    "userInfo": {"username": "probe", "groups": []},
+                    "object": obj})
+            events = fr.load_admission_corpus(tmp)
+            st = fr.get_flight_recorder().capture_stats() or {}
+            ok = bool(events) and st.get("dropped", 0) == 0 \
+                and st.get("write_errors", 0) == 0
+            n_err += 0 if ok else 1
+            print(f"  {'ok  ' if ok else 'FAIL'} capture: "
+                  f"{len(events)} event(s) in "
+                  f"{st.get('segments', 0)} segment(s), "
+                  f"{st.get('dropped', 0)} drop(s), "
+                  f"{st.get('torn_truncated', 0)} torn tail(s), "
+                  f"{st.get('write_errors', 0)} write error(s)")
+
+            # the candidate drops one constraint — a shrink can never
+            # deny what the recorded set allowed, so the evidence
+            # gates must all pass
+            candidate = constraints[1:]
+            ctrl = PromotionController(
+                client, templates, candidate, name="probe",
+                events=events, verify_parity=True)
+            final = ctrl.run(target_rung="deny")
+            for h in ctrl.history:
+                ev = ctrl.evidence.get(h["to"], {})
+                keys = ("added", "cleared", "replayed",
+                        "skipped_oversize", "parity", "enforcement")
+                detail = ", ".join(f"{k}={ev[k]}" for k in keys
+                                   if k in ev)
+                print(f"    {h['frm']} -> {h['to']}: {h['reason']}"
+                      f"{'  [' + detail + ']' if detail else ''}")
+            g = ctrl.evidence.get("replay_gate", {})
+            ok = final == "deny" and g.get("parity") is True
+            n_err += 0 if ok else 1
+            print(f"  {'ok  ' if ok else 'FAIL'} promote: "
+                  f"state={final} rung={ctrl.installed} — "
+                  f"{g.get('replayed', 0)} event(s) replayed, "
+                  f"{g.get('unexpected_denials', '?')} unexpected "
+                  f"denial(s), {g.get('skipped_oversize', 0)} "
+                  f"oversize, scalar={g.get('scalar_digest', '')} "
+                  f"batched={g.get('batched_digest', '')}")
+            enforced = all(
+                ((client.constraints.get(c["kind"]) or {})
+                 .get(c["metadata"]["name"]) or {})
+                .get("spec", {}).get("enforcementAction") == "deny"
+                for c in candidate)
+            n_err += 0 if enforced else 1
+            if not enforced:
+                print("  FAIL promote: live constraints not at deny",
+                      file=sys.stderr)
+
+            # fleet graduation plan: the same candidate across a
+            # 4-cluster fleet, map-reduce blocks of 2
+            fleet = [make_cluster(
+                f"c{i}", templates, constraints,
+                objs=make_mixed(random.Random(200 + i), max(n // 4, 8)))
+                for i in range(4)]
+            frep = graduate_fleet(fleet, templates, candidate,
+                                  limit_per_constraint=20, block_size=2)
+            ok = frep.graduated == frep.n_clusters
+            n_err += 0 if ok else 1
+            print(f"  {'ok  ' if ok else 'FAIL'} plan: "
+                  f"{frep.headline()}")
+        finally:
+            tmp_rec = fr._recorder
+            fr._recorder = saved_rec
+            for k, v in saved_env.items():
+                if v is None:
+                    _os.environ.pop(k, None)
+                else:
+                    _os.environ[k] = v
+            try:
+                if tmp_rec is not None and tmp_rec._capture is not None:
+                    tmp_rec._capture.close()
+            except Exception:   # noqa: BLE001 — probe hygiene only
+                pass
+
+    scalar = bool(getattr(driver, "scalar_only", False))
+    if scalar:
+        n_warn += 1
+        print("  warn scalar-only backend: promotion gates validated "
+              "on the oracle path, device NOT")
+    wall = _time.perf_counter() - t0
+    print(f"rollout: {n_err} gate failure(s) "
+          f"({'scalar-fallback' if scalar else 'device'}) "
+          f"in {wall:.1f}s")
+    return _severity_rc(n_err, n_warn)
 
 
 def run_pages(paths: list[str], use_library: bool = False) -> int:
@@ -1174,6 +1388,7 @@ def _run_subcommand(argv: list[str]) -> int | None:
         del pos[i:i + 2]
     table = (
         ("--whatif", lambda rest: run_whatif()),
+        ("--rollout", lambda rest: run_rollout(use_library=use_library)),
         ("--policyset", lambda rest: run_policyset()),
         ("--cost", lambda rest: run_cost()),
         ("--trace", lambda rest: run_trace(out)),

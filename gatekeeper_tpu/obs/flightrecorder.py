@@ -14,14 +14,18 @@ artifact under ``GATEKEEPER_FLIGHT_DIR`` (default
 automatically on supervisor degradation, ``GATEKEEPER_FAULT=*`` trips,
 and bench rc-3 exits — PR-7's "fail loudly" with evidence attached.
 
-Admission corpus (whatif/replay.py): with
-``GATEKEEPER_FLIGHT_ADMISSION=1`` the webhook also persists one JSONL
-line per AdmissionReview — payload capped at
+Admission corpus (whatif/replay.py, rollout/): with
+``GATEKEEPER_FLIGHT_ADMISSION=1`` the webhook also persists each
+AdmissionReview — payload capped at
 ``GATEKEEPER_FLIGHT_PAYLOAD_BYTES`` (default 8192) and redacted
 (``metadata.managedFields`` stripped, secret-shaped values replaced)
-BEFORE anything touches disk — as ``admission-*.jsonl`` files under
-the flight dir, pruned by the same ``GATEKEEPER_FLIGHT_KEEP`` policy.
-``load_admission_corpus`` reads them back for replay.
+BEFORE anything touches disk — into the durable capture log
+(rollout/capture.py): segmented, CRC-framed ``capture-*.seg`` files
+under ``<flight dir>/capture``, fed through a bounded queue so the
+admission path never blocks on disk (drops are counted, committed
+records survive crashes).  ``load_admission_corpus`` reads the capture
+segments back (plus any legacy ``admission-*.jsonl`` files from older
+recordings) for replay.
 """
 
 from __future__ import annotations
@@ -134,7 +138,30 @@ class FlightRecorder:
         self._lock = threading.Lock()
         self._events: collections.deque[dict] = collections.deque(maxlen=ring)
         self._dump_seq = 0
-        self._corpus_path: Optional[str] = None
+        self._capture = None           # lazy rollout.capture.CaptureLog
+        self._capture_dir: Optional[str] = None
+
+    def _capture_log(self):
+        """The durable capture log under the CURRENT flight dir,
+        re-opened if GATEKEEPER_FLIGHT_DIR moved (tests point each case
+        at a fresh tmpdir).  Called under self._lock."""
+        from gatekeeper_tpu.rollout.capture import CaptureLog
+        d = os.path.join(_flight_dir(), "capture")
+        if self._capture is None or self._capture_dir != d:
+            if self._capture is not None:
+                try:
+                    self._capture.close()
+                except Exception:
+                    pass
+            self._capture = CaptureLog(d)
+            self._capture_dir = d
+        return self._capture
+
+    def capture_stats(self) -> Optional[dict]:
+        """Capture-log health (segments, drops, queue depth); None when
+        nothing was ever captured by this recorder."""
+        with self._lock:
+            return self._capture.stats() if self._capture else None
 
     def record(self, etype: str, **fields: Any) -> None:
         """Append one event; never raises."""
@@ -229,9 +256,9 @@ class FlightRecorder:
 
         The ring always gets a small summary event.  When the corpus is
         enabled (GATEKEEPER_FLIGHT_ADMISSION=1) the full — redacted,
-        byte-capped — request is appended as one JSONL line to this
-        recorder's ``admission-*.jsonl`` file, pruned under the same
-        GATEKEEPER_FLIGHT_KEEP policy as the dump artifacts.  Never
+        byte-capped — request is enqueued onto this recorder's durable
+        capture log (rollout/capture.py): the admission path only pays
+        a queue put, the background writer owns the disk.  Never
         raises: recording must not become an admission failure mode."""
         try:
             obj = (request.get("object") or {})
@@ -260,17 +287,8 @@ class FlightRecorder:
                      "msg": v.msg}
                     for v in (verdicts or ())],
             }
-            d = _flight_dir()
-            os.makedirs(d, exist_ok=True)
             with self._lock:
-                if self._corpus_path is None:
-                    stamp = time.strftime("%Y%m%dT%H%M%S", time.gmtime())
-                    self._corpus_path = os.path.join(
-                        d, f"admission-{stamp}-{os.getpid()}.jsonl")
-                    self._prune(d, prefix="admission-", suffix=".jsonl")
-                with open(self._corpus_path, "a") as f:
-                    f.write(json.dumps(event, sort_keys=True,
-                                       default=str) + "\n")
+                self._capture_log().append(event)
         except Exception:  # pragma: no cover - best effort
             pass
 
@@ -294,12 +312,25 @@ def record_event(etype: str, **fields: Any) -> None:
 
 
 def load_admission_corpus(directory: Optional[str] = None) -> list[dict]:
-    """Read every ``admission-*.jsonl`` corpus file (oldest file first,
-    append order within a file) back into replayable events.  Unparsable
-    lines are skipped — a torn final line from a crashed writer must not
-    sink the rest of the corpus."""
+    """Read the recorded admission corpus back into replayable events.
+
+    Primary source is the durable capture log's segments under
+    ``<directory>/capture`` (committed records, in segment order across
+    process restarts — open in-process writers are flushed first so a
+    same-process record-then-replay flow sees everything it enqueued).
+    Legacy ``admission-*.jsonl`` files from older recordings are still
+    read, torn/unparsable lines skipped."""
     d = directory or _flight_dir()
     events: list[dict] = []
+    try:
+        from gatekeeper_tpu.rollout import capture as _capture
+        cap_dir = os.path.join(d, "capture")
+        _capture.flush_all()
+        recs, _report = _capture.scan(cap_dir)
+        events.extend(ev for ev in recs
+                      if isinstance(ev, dict) and "request" in ev)
+    except Exception:   # noqa: BLE001 — capture dir may not exist yet
+        pass
     try:
         names = sorted(f for f in os.listdir(d)
                        if f.startswith("admission-") and f.endswith(".jsonl"))

@@ -44,6 +44,18 @@ schedule pool.  Three more invariants:
 8. **The reactor recovers** — after the schedule disarms, the state
    machine returns to ``live`` within the recovery budget.
 
+The rollout PR added ``promotion_storm``: the event runs a real
+PromotionController ladder on a side client (its own policy set and
+synthesized corpus, so the soak's live verdicts stay untouched) and
+pins the brownout ladder ≥ SHED_WARN mid-rollout.  Two more
+invariants:
+
+9. **A rollout never ends above its evidence-supported rung** — the
+   brownout must abort the in-flight promotion (``rolled_back``), and
+   a rejected candidate never has a rung installed.
+10. **Every rollback restores live enforcement exactly** — the
+    post-rollback policy-set fingerprint equals the pre-rollout one.
+
 Everything is seeded: ``build_schedule(seed, duration)`` is a pure
 function of its arguments (the determinism test in
 ``tests/test_chaos.py`` pins this), so a failing soak replays with the
@@ -72,7 +84,7 @@ import time
 FAULTS = ("probe_hang", "device_lost", "snapshot_corrupt",
           "slow_provider", "queue_storm",
           "watch_stall", "watch_gap", "watch_duplicate",
-          "watch_reorder", "watch_flood")
+          "watch_reorder", "watch_flood", "promotion_storm")
 
 # one-shot (``faults.take``) seams the scheduler re-arms between events
 ONE_SHOT = ("device_lost", "snapshot_corrupt", "queue_storm",
@@ -238,6 +250,120 @@ def _deny_lines(resp: dict) -> list[str]:
 
 
 # ---------------------------------------------------------------------------
+# promotion_storm: a brownout lands mid-rollout
+
+
+def _storm_fixture(box: dict) -> dict:
+    """Build (once, lazily) the promotion-storm side stack: its own
+    client over the label policy set (no external data — the storm
+    must not depend on the soak's provider runtime) plus a corpus
+    synthesized from that client's own review verdicts, so the replay
+    gate passes by construction and the storm exercises the install +
+    rollback rungs, not the evidence gates."""
+    if box:
+        return box
+    from gatekeeper_tpu.client.client import Backend
+    from gatekeeper_tpu.engine.jax_driver import JaxDriver
+    from gatekeeper_tpu.target.k8s import K8sValidationTarget
+    tdocs = [_template_doc("K8sChaosLabels", _DENY_LABELS_REGO),
+             _template_doc("K8sChaosWarnTeam", _WARN_TEAM_REGO),
+             _template_doc("K8sChaosDryrunCost", _DRYRUN_COST_REGO)]
+    cdocs = [_constraint_doc("K8sChaosLabels", "ns-must-have-gk",
+                             params={"labels": ["gatekeeper"]}),
+             _constraint_doc("K8sChaosWarnTeam", "ns-team-warn",
+                             action="warn"),
+             _constraint_doc("K8sChaosDryrunCost", "ns-cost-dryrun",
+                             action="dryrun")]
+    client = Backend(JaxDriver()).new_client([K8sValidationTarget()])
+    for d in tdocs:
+        client.add_template(d)
+    for d in cdocs:
+        client.add_constraint(d)
+    for i in range(8):
+        client.add_data(_ns_obj(
+            f"ro-{i}", {"gatekeeper": "on"} if i % 2 else None))
+    events = []
+    for req in _build_corpus(12):
+        if req["object"].get("kind") != "Namespace":
+            continue
+        results = client.review(dict(req)).results()
+        allowed = not any(r.enforcement_action not in ("warn", "dryrun")
+                          for r in results)
+        events.append({
+            "request": {k: req[k] for k in ("object", "kind", "name",
+                                            "operation")},
+            "allowed": allowed,
+            "verdicts": [{"kind": (r.constraint or {}).get("kind"),
+                          "name": ((r.constraint or {}).get("metadata")
+                                   or {}).get("name"),
+                          "action": r.enforcement_action,
+                          "msg": r.msg} for r in results]})
+    box.update(client=client, templates=tdocs, constraints=cdocs,
+               candidate=cdocs[:-1], events=events)   # drop the dryrun one
+    return box
+
+
+def _promotion_storm(report, violation, box: dict) -> None:
+    """Run one storm event: start a real promotion on the side client,
+    wait for an enforcement rung to install, then pin the brownout
+    ladder ≥ SHED_WARN (the pin is process-wide for the fault window —
+    the soak's own ladder feeling it too IS the storm) and check
+    invariants 9 and 10."""
+    from gatekeeper_tpu.rollout import (ROLLED_BACK, PromotionController,
+                                        live_enforcement_fingerprint)
+    from gatekeeper_tpu.webhook.overload import OverloadController
+    fix = _storm_fixture(box)
+    client = fix["client"]
+    report.promotion_storms += 1
+    before = live_enforcement_fingerprint(client)
+    ctrl = PromotionController(
+        client, fix["templates"],
+        [copy.deepcopy(c) for c in fix["candidate"]],
+        name=f"storm-{report.promotion_storms}",
+        events=fix["events"], soak_s=30.0)
+    ovl = OverloadController(lambda: 0, capacity=10)
+    ctrl.attach_overload(ovl)
+    t = threading.Thread(target=ctrl.run, kwargs={"target_rung": "deny"},
+                         daemon=True, name="chaos-promotion")
+    t.start()
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline and ctrl.installed is None \
+            and ctrl.state not in ("rejected", ROLLED_BACK):
+        time.sleep(0.01)
+    if ctrl.installed is None:
+        # a candidate that never installed must not have touched
+        # enforcement either (invariant 9's rejected half)
+        if live_enforcement_fingerprint(client) != before:
+            violation("promotion_rejected_but_mutated",
+                      state=ctrl.state)
+        else:
+            violation("promotion_never_installed", state=ctrl.state,
+                      history=ctrl.history[-4:])
+        return
+    prev = os.environ.get("GATEKEEPER_BROWNOUT")
+    os.environ["GATEKEEPER_BROWNOUT"] = "2"
+    try:
+        ovl.rung()                   # escalate -> listener -> rollback
+    finally:
+        if prev is None:
+            os.environ.pop("GATEKEEPER_BROWNOUT", None)
+        else:
+            os.environ["GATEKEEPER_BROWNOUT"] = prev
+    t.join(timeout=10.0)
+    if ctrl.state != ROLLED_BACK:
+        violation("promotion_storm_no_rollback", state=ctrl.state,
+                  installed=ctrl.installed)
+        return
+    report.promotion_rollbacks += 1
+    ev = ctrl.evidence.get(ROLLED_BACK, {})
+    if not ev.get("restored"):
+        violation("promotion_enforcement_not_restored", evidence=ev)
+    if live_enforcement_fingerprint(client) != before:
+        violation("promotion_fingerprint_drift", before=before,
+                  after=live_enforcement_fingerprint(client))
+
+
+# ---------------------------------------------------------------------------
 # the soak
 
 
@@ -266,6 +392,8 @@ class SoakReport:
     ledger_checks: int = 0       # mirror==state==oracle checkpoints
     ledger_events: int = 0       # appear/clear deltas emitted
     churn_ops: int = 0
+    promotion_storms: int = 0    # promotion_storm events run
+    promotion_rollbacks: int = 0  # storms that rolled back cleanly
     violations: list = dataclasses.field(default_factory=list)
     warnings: list = dataclasses.field(default_factory=list)
 
@@ -281,6 +409,8 @@ class SoakReport:
                 f"pathologies={sum(self.watch_pathologies.values())} "
                 f"resyncs={self.reactor_resyncs} "
                 f"ledger_checks={self.ledger_checks} "
+                f"storms={self.promotion_rollbacks}/"
+                f"{self.promotion_storms} "
                 f"{len(self.warnings)} warning(s) "
                 f"{len(self.violations)} invariant violation(s)")
 
@@ -613,6 +743,7 @@ def run_soak(seed: int = 7, duration_s: float = 30.0, rps: float = 150.0,
         t.start()
 
     # ---------------- the schedule ------------------------------------
+    storm_box: dict = {}
     try:
         for ev in schedule:
             if stop.is_set():
@@ -620,6 +751,19 @@ def run_soak(seed: int = 7, duration_s: float = 30.0, rps: float = 150.0,
             delay = t_start + ev.t - time.monotonic()
             if delay > 0 and stop.wait(delay):
                 break
+            if ev.fault == "promotion_storm":
+                # not a faults.py seam: the event runs a real rollout
+                # on the side stack and browns it out mid-flight
+                record_event("chaos_event", fault=ev.fault,
+                             action="arm", t=ev.t, duration=ev.duration)
+                try:
+                    _promotion_storm(report, violation, storm_box)
+                except Exception as e:   # noqa: BLE001 — a storm crash
+                    violation("promotion_storm_exception",   # is a bug
+                              error=repr(e))
+                record_event("chaos_event", fault=ev.fault,
+                             action="disarm", t=ev.t + ev.duration)
+                continue
             if ev.fault in ONE_SHOT:
                 faults.rearm(ev.fault)
             os.environ["GATEKEEPER_FAULT"] = ev.fault

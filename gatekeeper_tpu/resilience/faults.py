@@ -22,6 +22,11 @@ consults this module at the exact seams a real failure would hit:
                          overload ladder engages (a simulated consumer
                          stall: slow device, GC pause, noisy
                          neighbor).
+- ``fleet_straggler``  — fires ONCE, inside the fleet graduator's
+                         candidate-twin build (rollout/fleet.py),
+                         failing exactly one cluster of the fleet; the
+                         map-reduce isolation contract marks only that
+                         cluster ``held``, never the fleet.
 
 Watch-class faults (consumed at the reactor's ingest edge,
 ``enforce/reactor.py`` — each models one way a watch stream breaks):

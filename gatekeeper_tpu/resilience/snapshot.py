@@ -72,7 +72,8 @@ class SnapshotStats:
                "fp_hits", "fp_misses",
                "sp_hits", "sp_misses",
                "pg_hits", "pg_misses",
-               "dfa_hits", "dfa_misses", "corrupt_discarded",
+               "dfa_hits", "dfa_misses",
+               "ro_hits", "ro_misses", "corrupt_discarded",
                "saves", "save_errors")
 
     def __init__(self):
@@ -515,6 +516,30 @@ def save_pagemap(target: str, payload_obj) -> bool:
     return _write_entry("pg", f"pg:{target}", payload)
 
 
+def load_rollout(name: str, root: str | None = None):
+    """Ninth tier: promotion-rollout state (rollout/controller.py),
+    keyed by rollout name.  A warm restart resumes an in-flight
+    promotion at the same rung — state machine position, installed
+    enforcement rung, and the prior-doc set a rollback would restore."""
+    if root is None and not enabled():
+        return None
+    got = _read_entry("ro", f"ro:{name}", root=root)
+    stats.bump("ro_hits" if got is not None else "ro_misses")
+    return got
+
+
+def save_rollout(name: str, payload_obj) -> bool:
+    if not enabled():
+        return False
+    try:
+        payload = dumps(payload_obj)
+    except Exception as e:   # noqa: BLE001
+        stats.bump("save_errors")
+        _log.warning("rollout state not snapshottable", error=e)
+        return False
+    return _write_entry("ro", f"ro:{name}", payload)
+
+
 # ----------------------------------------------------------------------
 # the combined restart counter (the keying-bug fix)
 
@@ -525,11 +550,13 @@ def tier_counts(s: dict) -> tuple[int, int]:
     hits = (s["ir_hits"] + s["mod_hits"] + s["plan_hits"]
             + s["store_hits"] + s.get("cert_hits", 0)
             + s.get("fp_hits", 0) + s.get("sp_hits", 0)
-            + s.get("pg_hits", 0) + s.get("dfa_hits", 0))
+            + s.get("pg_hits", 0) + s.get("dfa_hits", 0)
+            + s.get("ro_hits", 0))
     misses = (s["ir_misses"] + s["mod_misses"] + s["plan_misses"]
               + s["store_misses"] + s.get("cert_misses", 0)
               + s.get("fp_misses", 0) + s.get("sp_misses", 0)
-              + s.get("pg_misses", 0) + s.get("dfa_misses", 0))
+              + s.get("pg_misses", 0) + s.get("dfa_misses", 0)
+              + s.get("ro_misses", 0))
     return hits, misses
 
 

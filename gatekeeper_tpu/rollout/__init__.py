@@ -1,0 +1,47 @@
+"""Policy promotion pipeline (ROADMAP item 5, PR 18).
+
+Composes the PR-12 what-if engine and the PR-13 graduated-enforcement
+machinery into an evidence-gated rollout subsystem:
+
+- ``controller`` — the promotion state machine (candidate → shadow →
+  replayed → dryrun → warn → deny, plus ``rejected``/``rolled_back``),
+  gated on shadow-sweep + batched-corpus-replay evidence, installed by
+  rewriting ``enforcementAction`` on live constraints, aborted by the
+  brownout ladder, persisted as the ninth snapshot tier.
+- ``capture`` — the durable admission capture log: segmented,
+  CRC-framed, bounded-queue background writer; the flight recorder's
+  corpus store and the replay gate's evidence source.
+- ``fleet`` — DrJAX-style map-reduce graduation across device-sized
+  cluster blocks with per-cluster evidence and straggler isolation.
+
+Attribute access is lazy so the flight recorder can import
+``rollout.capture`` (pure stdlib) from the admission path without
+dragging the numpy/jax halves in.
+"""
+
+_EXPORTS = {
+    "CaptureLog": "gatekeeper_tpu.rollout.capture",
+    "PromotionController": "gatekeeper_tpu.rollout.controller",
+    "ReplayGate": "gatekeeper_tpu.rollout.controller",
+    "live_enforcement_fingerprint": "gatekeeper_tpu.rollout.controller",
+    "PROMOTION_RUNGS": "gatekeeper_tpu.rollout.controller",
+    "ENFORCE_RUNGS": "gatekeeper_tpu.rollout.controller",
+    "REJECTED": "gatekeeper_tpu.rollout.controller",
+    "ROLLED_BACK": "gatekeeper_tpu.rollout.controller",
+    "graduate_fleet": "gatekeeper_tpu.rollout.fleet",
+    "FleetGraduationReport": "gatekeeper_tpu.rollout.fleet",
+    "ClusterEvidence": "gatekeeper_tpu.rollout.fleet",
+    "GRADUATED": "gatekeeper_tpu.rollout.fleet",
+    "BLOCKED": "gatekeeper_tpu.rollout.fleet",
+    "HELD": "gatekeeper_tpu.rollout.fleet",
+}
+
+__all__ = list(_EXPORTS)
+
+
+def __getattr__(name: str):
+    mod = _EXPORTS.get(name)
+    if mod is None:
+        raise AttributeError(name)
+    import importlib
+    return getattr(importlib.import_module(mod), name)

@@ -89,7 +89,18 @@ class OverloadController:
         self.max_rung = HEALTHY        # high-water mark, for reports
         self._scalar_inflight = 0      # SCALAR_ONLY bypasses in flight
         self._calm_since: float | None = None
+        self._listeners: list = []     # fn(frm, to, pressure) on change
         self._gauge(HEALTHY)
+
+    def add_listener(self, fn) -> None:
+        """Register ``fn(frm, to, pressure)`` to fire on every rung
+        transition.  Listeners are invoked under the controller lock
+        (keep them cheap and never call back into ``rung()``) and are
+        best-effort: one failing listener cannot wedge the ladder.
+        The rollout PromotionController uses this to roll a candidate
+        back when a brownout escalation lands mid-promotion."""
+        with self._lock:
+            self._listeners.append(fn)
 
     # ------------------------------------------------------------------
 
@@ -203,6 +214,11 @@ class OverloadController:
                          to=RUNG_NAMES[to], pressure=round(pressure, 3))
         except Exception:   # noqa: BLE001
             pass
+        for fn in self._listeners:
+            try:
+                fn(frm, to, pressure)
+            except Exception:   # noqa: BLE001 — listeners are advisory
+                pass
 
     # ------------------------------------------------------------------
     # what a rung means for evaluation

@@ -219,12 +219,27 @@ class ValidationHandler:
 
     def _record_admission(self, request, out, results, warnings) -> None:
         """Feed the flight recorder's replayable admission corpus
-        (opt-in, GATEKEEPER_FLIGHT_ADMISSION=1); never raises."""
+        (opt-in, GATEKEEPER_FLIGHT_ADMISSION=1); never raises.  The
+        corpus now lands in the durable capture log (rollout/capture)
+        via a bounded queue — this seam only pays an enqueue, and the
+        log's health (segments written, records dropped under flood)
+        is surfaced as webhook gauges so a starved capture path is
+        visible before a promotion gate starves with it."""
         try:
             from gatekeeper_tpu.obs.flightrecorder import get_flight_recorder
-            get_flight_recorder().record_admission(
+            rec = get_flight_recorder()
+            rec.record_admission(
                 request, bool(out.get("allowed")), verdicts=results,
                 warnings=warnings)
+            st = rec.capture_stats()
+            if st is not None:
+                self.metrics.gauge(
+                    "admission_capture_segments",
+                    "capture-log segments on disk").set(st["segments"])
+                self.metrics.gauge(
+                    "admission_capture_drops",
+                    "corpus records dropped by the bounded capture "
+                    "queue").set(st["dropped"])
         except Exception:
             pass
 

@@ -61,7 +61,9 @@ from gatekeeper_tpu.whatif.shadow import (ShadowReport, ShadowSession,  # noqa: 
                                           standalone_candidate_verdicts)
 from gatekeeper_tpu.whatif.replay import (ReplayReport, StreamReplayReport,  # noqa: E402
                                           load_historical_store,
-                                          replay_admissions, replay_snapshot)
+                                          replay_admissions,
+                                          replay_admissions_batched,
+                                          replay_snapshot)
 from gatekeeper_tpu.whatif.fleet import (FleetReport, fleet_audit,  # noqa: E402
                                          fleet_loop_oracle, make_cluster)
 
@@ -69,6 +71,6 @@ __all__ = [
     "normalize_result", "normalize_results", "verdict_digest",
     "ShadowSession", "ShadowReport", "standalone_candidate_verdicts",
     "ReplayReport", "StreamReplayReport", "load_historical_store",
-    "replay_snapshot", "replay_admissions",
+    "replay_snapshot", "replay_admissions", "replay_admissions_batched",
     "FleetReport", "fleet_audit", "fleet_loop_oracle", "make_cluster",
 ]

@@ -71,10 +71,13 @@ def replay_snapshot(templates: list[dict], constraints: list[dict],
 @dataclasses.dataclass
 class StreamReplayReport:
     replayed: int
-    skipped: int                 # truncated/unreplayable corpus events
+    skipped: int                 # unreplayable corpus events (errors)
     matched: int
     mismatches: list[dict]       # per-event recorded-vs-replayed delta
     wall_s: float
+    skipped_oversize: int = 0    # byte-capped to the identifying envelope
+    digest: str = ""             # sha256[:16] over per-event verdict rows
+    batched: bool = False        # went through the device micro-batcher
 
     @property
     def exact(self) -> bool:
@@ -110,6 +113,33 @@ def _truncated(request: dict) -> bool:
     return False
 
 
+def _stream_digest(rows_per_event: list[list[tuple]]) -> str:
+    """The replay parity currency: one digest over the ordered
+    per-event verdict rows.  The scalar and batched paths must agree
+    bit-for-bit, so this is computed from the same normalized rows on
+    both."""
+    import hashlib
+    import json
+    blob = json.dumps(rows_per_event, sort_keys=True,
+                      separators=(",", ":"))
+    return hashlib.sha256(blob.encode("utf-8")).hexdigest()[:16]
+
+
+def _compare_event(event: dict, request: dict, results, allowed: bool,
+                   mismatches: list[dict]) -> bool:
+    got = _verdict_rows(results)
+    want = _recorded_rows(event)
+    if allowed == bool(event.get("allowed")) and got == want:
+        return True
+    obj = (request.get("object") or {})
+    mismatches.append({
+        "name": (obj.get("metadata") or {}).get("name"),
+        "recorded_allowed": bool(event.get("allowed")),
+        "replayed_allowed": allowed,
+        "recorded": want, "replayed": got})
+    return False
+
+
 def replay_admissions(events: list[dict], client,
                       compare: bool = True) -> StreamReplayReport:
     """Re-review each corpus event through ``client`` and (optionally)
@@ -117,14 +147,16 @@ def replay_admissions(events: list[dict], client,
     with the webhook's enforcementAction partition (deny blocks, warn/
     dryrun admit), so a corpus recorded by the webhook reproduces
     exactly under the same policy set.  Events whose payload was
-    byte-capped at record time are skipped, not guessed at."""
+    byte-capped to the identifying envelope at record time are counted
+    in ``skipped_oversize``, not guessed at."""
     t0 = time.perf_counter()
-    replayed = skipped = matched = 0
+    replayed = skipped = oversize = matched = 0
     mismatches: list[dict] = []
+    rows_per_event: list[list[tuple]] = []
     for event in events:
         request = event.get("request") or {}
         if _truncated(request):
-            skipped += 1
+            oversize += 1
             continue
         try:
             resp = client.review(request)
@@ -137,19 +169,69 @@ def replay_admissions(events: list[dict], client,
         allowed = not any(r.enforcement_action not in ("warn", "dryrun")
                           for r in results)
         replayed += 1
-        if not compare:
-            continue
-        got = _verdict_rows(results)
-        want = _recorded_rows(event)
-        if allowed == bool(event.get("allowed")) and got == want:
+        rows_per_event.append(_verdict_rows(results))
+        if compare and _compare_event(event, request, results, allowed,
+                                      mismatches):
             matched += 1
-        else:
-            obj = (request.get("object") or {})
-            mismatches.append({
-                "name": (obj.get("metadata") or {}).get("name"),
-                "recorded_allowed": bool(event.get("allowed")),
-                "replayed_allowed": allowed,
-                "recorded": want, "replayed": got})
     return StreamReplayReport(
         replayed=replayed, skipped=skipped, matched=matched,
-        mismatches=mismatches, wall_s=time.perf_counter() - t0)
+        mismatches=mismatches, wall_s=time.perf_counter() - t0,
+        skipped_oversize=oversize,
+        digest=_stream_digest(rows_per_event))
+
+
+def replay_admissions_batched(events: list[dict], client,
+                              compare: bool = True,
+                              batch_size: int = 256
+                              ) -> StreamReplayReport:
+    """Batched twin of :func:`replay_admissions`: replayable events go
+    through ``client.review_batch`` — the webhook's device micro-batch
+    seam, one [B, C] matrix pass per chunk when the driver is eligible
+    (see jax_driver REVIEW_BATCH_MIN_EVALS) — instead of one scalar
+    ``review`` per event.  Verdict comparison, accounting, and the
+    stream ``digest`` are computed from the same normalized rows, so
+    the report must be bit-identical to the scalar oracle's; a chunk
+    that fails wholesale falls back to per-event scalar replay so one
+    poisoned request cannot sink its neighbours' accounting."""
+    t0 = time.perf_counter()
+    replayed = skipped = oversize = matched = 0
+    mismatches: list[dict] = []
+    rows_per_event: list[list[tuple]] = []
+    pending: list[dict] = []                 # events with replayable payloads
+    for event in events:
+        request = event.get("request") or {}
+        if _truncated(request):
+            oversize += 1
+            continue
+        pending.append(event)
+    for lo in range(0, len(pending), max(1, batch_size)):
+        chunk = pending[lo:lo + max(1, batch_size)]
+        requests = [ev.get("request") or {} for ev in chunk]
+        try:
+            resps = client.review_batch(requests)
+        except Exception:  # noqa: BLE001 — fall back to scalar replay
+            resps = None
+        for i, event in enumerate(chunk):
+            request = requests[i]
+            try:
+                resp = (resps[i] if resps is not None
+                        else client.review(request))
+            except Exception as e:  # noqa: BLE001
+                skipped += 1
+                mismatches.append({"request": request.get("name"),
+                                   "error": str(e)})
+                continue
+            results = resp.results()
+            allowed = not any(
+                r.enforcement_action not in ("warn", "dryrun")
+                for r in results)
+            replayed += 1
+            rows_per_event.append(_verdict_rows(results))
+            if compare and _compare_event(event, request, results,
+                                          allowed, mismatches):
+                matched += 1
+    return StreamReplayReport(
+        replayed=replayed, skipped=skipped, matched=matched,
+        mismatches=mismatches, wall_s=time.perf_counter() - t0,
+        skipped_oversize=oversize,
+        digest=_stream_digest(rows_per_event), batched=True)

@@ -1,0 +1,480 @@
+"""Policy promotion pipeline tests (PR 18, ROADMAP item 5).
+
+Three suites over the rollout subsystem:
+
+- capture: the durable admission log's crash-safety contract — torn
+  tails truncate, CRC failures reject a segment's remainder, the
+  bounded queue drops (counted) instead of blocking admission, and a
+  reader walks segments in order across process restarts;
+- controller: the promotion state machine graduates only on recorded
+  evidence, rejects on any unexpected denial, rolls back atomically on
+  a brownout escalation (live enforcement provably restored), and
+  resumes mid-rollout at the same rung after a warm restart;
+- fleet: map-reduce graduation with per-cluster evidence, candidate
+  regressions blocking only their cluster and a straggler fault
+  holding only itself.
+"""
+
+import os
+import random
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from gatekeeper_tpu.rollout import capture as cap
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# capture log durability (pure stdlib — no jax in this suite)
+
+
+class TestCaptureLog:
+    def test_round_trip_preserves_order(self, tmp_path):
+        d = str(tmp_path)
+        log = cap.CaptureLog(d)
+        for i in range(25):
+            assert log.append({"i": i})
+        assert log.flush()
+        recs, rep = cap.scan(d)
+        assert [r["i"] for r in recs] == list(range(25))
+        assert rep["records"] == 25
+        assert rep["corrupt_segments"] == rep["torn_tails"] == 0
+        log.close()
+
+    def test_rotation_seals_and_prunes(self, tmp_path):
+        d = str(tmp_path)
+        log = cap.CaptureLog(d, segment_max=512, keep=3)
+        for i in range(60):
+            log.append({"i": i, "pad": "x" * 40})
+        assert log.flush()
+        st = log.stats()
+        assert st["rotations"] >= 3
+        assert 0 < st["segments"] <= 3
+        recs, _rep = cap.scan(d)
+        idx = [r["i"] for r in recs]
+        # pruning drops oldest segments; what's left is an ordered,
+        # contiguous suffix ending at the newest record
+        assert idx == list(range(idx[0], 60))
+        log.close()
+
+    def test_torn_tail_truncated_on_reopen(self, tmp_path):
+        """A crash mid-write leaves a partial trailing frame: the
+        reader skips it, and the next writer truncates it so the
+        segment stays appendable — no committed record is lost."""
+        d = str(tmp_path)
+        log = cap.CaptureLog(d)
+        for i in range(10):
+            log.append({"i": i})
+        log.close()
+        path = cap.list_segments(d)[-1][1]
+        with open(path, "ab") as f:
+            f.write(cap._FRAME.pack(9999, 0)[:6])     # torn frame header
+        recs, rep = cap.scan(d)
+        assert rep["torn_tails"] == 1
+        assert [r["i"] for r in recs] == list(range(10))
+        log2 = cap.CaptureLog(d)
+        assert log2.append({"i": 10})
+        assert log2.flush()
+        assert log2.stats()["torn_truncated"] == 1
+        recs, rep = cap.scan(d)
+        assert [r["i"] for r in recs] == list(range(11))
+        assert rep["torn_tails"] == 0
+        log2.close()
+
+    def test_crc_corruption_rejects_segment_remainder(self, tmp_path):
+        """A flipped payload byte fails the CRC: the rest of that
+        segment is untrusted and rejected, later segments read on."""
+        d = str(tmp_path)
+        log = cap.CaptureLog(d, segment_max=512)
+        for i in range(40):
+            log.append({"i": i, "pad": "x" * 40})
+        log.close()
+        segs = cap.list_segments(d)
+        assert len(segs) >= 3
+        path0 = segs[0][1]
+        data = bytearray(open(path0, "rb").read())
+        data[len(cap.SEGMENT_MAGIC) + cap._FRAME.size] ^= 0xFF
+        with open(path0, "wb") as f:
+            f.write(bytes(data))
+        recs, rep = cap.scan(d)
+        assert rep["corrupt_segments"] == 1
+        idx = [r["i"] for r in recs]
+        assert idx and idx[0] > 0               # seg 0 rejected entirely
+        assert idx == list(range(idx[0], 40))   # later segments intact
+
+    def test_bounded_queue_drops_counted_never_blocks(self, tmp_path):
+        d = str(tmp_path)
+        log = cap.CaptureLog(d, queue_size=4)
+        log._writer = threading.current_thread()   # stall: no drain yet
+        results = [log.append({"i": i}) for i in range(10)]
+        assert results == [True] * 4 + [False] * 6
+        st = log.stats()
+        assert st["enqueued"] == 4 and st["dropped"] == 6
+        # un-stall: the backlog (and only it) commits
+        log._writer = threading.Thread(target=log._drain, daemon=True)
+        log._writer.start()
+        assert log.flush()
+        recs, _rep = cap.scan(d)
+        assert [r["i"] for r in recs] == [0, 1, 2, 3]
+        log.close()
+
+    def test_cross_process_reader_continuity(self, tmp_path):
+        """Two writer processes in sequence: the second resumes the
+        segment sequence (appending to the unsealed tail), and one
+        reader sees every committed record in order."""
+        d = str(tmp_path)
+        child = (
+            "import sys\n"
+            "sys.path.insert(0, sys.argv[3])\n"
+            "from gatekeeper_tpu.rollout.capture import CaptureLog\n"
+            "log = CaptureLog(sys.argv[1], segment_max=4096)\n"
+            "start = int(sys.argv[2])\n"
+            "for i in range(start, start + 25):\n"
+            "    assert log.append({'i': i})\n"
+            "log.close()\n")
+        for start in (0, 25):
+            subprocess.run([sys.executable, "-c", child, d, str(start),
+                            _REPO], check=True, timeout=60)
+        recs, rep = cap.scan(d)
+        assert [r["i"] for r in recs] == list(range(50))
+        assert rep["corrupt_segments"] == rep["torn_tails"] == 0
+
+    def test_append_after_close_is_refused(self, tmp_path):
+        log = cap.CaptureLog(str(tmp_path))
+        log.append({"i": 0})
+        log.close()
+        assert log.append({"i": 1}) is False
+
+
+# ---------------------------------------------------------------------------
+# promotion controller
+
+
+N_TEMPLATES = 4
+N_ROWS = 60
+
+
+def _policy_subset(n=N_TEMPLATES):
+    from gatekeeper_tpu.library import all_docs
+    pairs = all_docs()[:n]
+    return [t for t, _c in pairs], [c for _t, c in pairs]
+
+
+def _mk_client(templates, constraints, n_rows=N_ROWS, seed=7):
+    from gatekeeper_tpu.client.client import Backend
+    from gatekeeper_tpu.engine.jax_driver import JaxDriver
+    from gatekeeper_tpu.library import make_mixed
+    from gatekeeper_tpu.target.k8s import K8sValidationTarget
+    driver = JaxDriver()
+    handler = K8sValidationTarget()
+    client = Backend(driver).new_client([handler])
+    for d in templates:
+        client.add_template(d)
+    for d in constraints:
+        client.add_constraint(d)
+    client.add_data_batch(make_mixed(random.Random(seed), n_rows))
+    return driver, handler, client
+
+
+def _record_corpus(client, monkeypatch, tmp_path, n=24, seed=23,
+                   extra_objs=()):
+    """Drive n admissions through the webhook handler into a capture
+    log under tmp_path and return the loaded events."""
+    from gatekeeper_tpu.library import make_mixed
+    from gatekeeper_tpu.obs import flightrecorder as fr
+    from gatekeeper_tpu.webhook.policy import ValidationHandler
+    corpus = str(tmp_path / "corpus")
+    monkeypatch.setenv("GATEKEEPER_FLIGHT_DIR", corpus)
+    monkeypatch.setenv("GATEKEEPER_FLIGHT_ADMISSION", "1")
+    monkeypatch.setattr(fr, "_recorder", None)
+    vh = ValidationHandler(client)
+    objs = make_mixed(random.Random(seed), n) + list(extra_objs)
+    for obj in objs:
+        vh.handle({
+            "uid": "u", "operation": "CREATE",
+            "kind": {"group": "", "version": "v1",
+                     "kind": obj.get("kind", "")},
+            "name": (obj.get("metadata") or {}).get("name", ""),
+            "userInfo": {"username": "t", "groups": []},
+            "object": obj})
+    events = fr.load_admission_corpus(corpus)
+    assert len(events) == len(objs)
+    return events
+
+
+# a pod the first-4 library constraints all allow: gcr.io image, no
+# privilege escalation, pod- and container-level RuntimeDefault seccomp
+_GOOD_POD = {
+    "apiVersion": "v1", "kind": "Pod",
+    "metadata": {"name": "good-pod", "namespace": "default"},
+    "spec": {
+        "securityContext": {"seccompProfile": {"type": "RuntimeDefault"}},
+        "containers": [
+            {"name": "app", "image": "gcr.io/org/app:1.2",
+             "securityContext": {
+                 "allowPrivilegeEscalation": False,
+                 "seccompProfile": {"type": "RuntimeDefault"}}}]}}
+
+
+@pytest.fixture(autouse=True)
+def _rollout_env(monkeypatch, tmp_path):
+    monkeypatch.setenv("GATEKEEPER_SNAPSHOT_DIR",
+                       str(tmp_path / "snaps"))
+    monkeypatch.delenv("GATEKEEPER_FAULT", raising=False)
+    monkeypatch.delenv("GATEKEEPER_BROWNOUT", raising=False)
+    from gatekeeper_tpu.resilience import faults
+    faults.reset_for_tests()
+    yield
+    faults.reset_for_tests()
+
+
+class TestPromotionController:
+    def test_graduates_to_deny_on_clean_evidence(self, monkeypatch,
+                                                 tmp_path):
+        from gatekeeper_tpu.rollout import PromotionController
+        templates, constraints = _policy_subset()
+        _d, _h, client = _mk_client(templates, constraints)
+        events = _record_corpus(client, monkeypatch, tmp_path)
+        candidate = [dict(c) for c in constraints[1:]]     # drop one
+        ctrl = PromotionController(client, templates, candidate,
+                                   name="t-clean", events=events,
+                                   verify_parity=True)
+        assert ctrl.run(target_rung="deny") == "deny"
+        assert [h["to"] for h in ctrl.history] == \
+            ["shadow", "replayed", "dryrun", "warn", "deny"]
+        g = ctrl.evidence["replay_gate"]
+        assert g["parity"] is True
+        assert g["unexpected_denials"] == 0
+        assert g["replayed"] == len(events)
+        assert g["scalar_digest"] == g["batched_digest"]
+        for c in candidate:
+            doc = client.constraints[c["kind"]][c["metadata"]["name"]]
+            assert doc["spec"]["enforcementAction"] == "deny"
+
+    def test_rejects_on_unexpected_denial(self, monkeypatch, tmp_path):
+        """The candidate widens the live set with a stricter repo
+        allow-list that would deny pods the corpus recorded as
+        allowed — those events are the rejection evidence, and
+        nothing installs."""
+        import copy
+        from gatekeeper_tpu.rollout import (PromotionController,
+                                            REJECTED,
+                                            live_enforcement_fingerprint)
+        import copy as _copy
+        templates, constraints = _policy_subset()
+        _d, _h, client = _mk_client(templates, constraints)
+        events = _record_corpus(client, monkeypatch, tmp_path, n=32,
+                                extra_objs=[_copy.deepcopy(_GOOD_POD)])
+        good = [e for e in events
+                if (e["request"].get("object") or {})
+                .get("metadata", {}).get("name") == "good-pod"]
+        assert good and good[0]["allowed"] is True
+        strict = copy.deepcopy(
+            next(c for c in constraints
+                 if c["kind"] == "K8sAllowedRepos"))
+        strict["metadata"]["name"] = "repos-strict"
+        strict["spec"]["parameters"]["repos"] = ["registry.invalid/"]
+        before = live_enforcement_fingerprint(client)
+        ctrl = PromotionController(
+            client, templates,
+            [dict(c) for c in constraints] + [strict],
+            name="t-widen", events=events)
+        assert ctrl.run(target_rung="deny") == REJECTED
+        assert ctrl.history[-1]["reason"] == "unexpected_denials"
+        assert ctrl.evidence[REJECTED]["offending"]
+        off = ctrl.evidence[REJECTED]["offending"][0]
+        assert off["recorded_allowed"] is True
+        assert off["replayed_allowed"] is False
+        assert ctrl.installed is None
+        assert live_enforcement_fingerprint(client) == before
+
+    def test_rejects_without_evidence(self, monkeypatch, tmp_path):
+        from gatekeeper_tpu.rollout import PromotionController, REJECTED
+        templates, constraints = _policy_subset()
+        _d, _h, client = _mk_client(templates, constraints)
+        ctrl = PromotionController(client, templates, constraints[1:],
+                                   name="t-empty", events=[])
+        assert ctrl.run(target_rung="deny") == REJECTED
+        assert ctrl.history[-1]["reason"] == "no_evidence"
+        assert ctrl.installed is None
+
+    def test_brownout_rolls_back_and_restores(self, monkeypatch,
+                                              tmp_path):
+        """The acceptance contract: a brownout escalation ≥ SHED_WARN
+        mid-rollout reverts atomically, and live enforcement is
+        provably identical to the pre-rollout state (fingerprint)."""
+        from gatekeeper_tpu.rollout import (PromotionController,
+                                            ROLLED_BACK,
+                                            live_enforcement_fingerprint)
+        from gatekeeper_tpu.webhook.overload import OverloadController
+        templates, constraints = _policy_subset()
+        _d, _h, client = _mk_client(templates, constraints)
+        events = _record_corpus(client, monkeypatch, tmp_path)
+        before = live_enforcement_fingerprint(client)
+        ctrl = PromotionController(client, templates,
+                                   [dict(c) for c in constraints[1:]],
+                                   name="t-brown", events=events)
+        assert ctrl.run(target_rung="warn") == "warn"
+        assert live_enforcement_fingerprint(client) != before
+        ovl = OverloadController(lambda: 0, capacity=10)
+        ctrl.attach_overload(ovl)
+        monkeypatch.setenv("GATEKEEPER_BROWNOUT", "2")
+        ovl.rung()                              # escalate -> listener
+        assert ctrl.state == ROLLED_BACK
+        ev = ctrl.evidence[ROLLED_BACK]
+        assert ev["restored"] is True
+        assert ev["from_rung"] == "warn"
+        assert ev["brownout"]["to"] >= 2
+        assert live_enforcement_fingerprint(client) == before
+        assert ctrl.installed is None
+
+    def test_low_brownout_rung_does_not_abort(self, monkeypatch,
+                                              tmp_path):
+        from gatekeeper_tpu.rollout import PromotionController
+        templates, constraints = _policy_subset()
+        _d, _h, client = _mk_client(templates, constraints)
+        events = _record_corpus(client, monkeypatch, tmp_path)
+        ctrl = PromotionController(client, templates,
+                                   [dict(c) for c in constraints[1:]],
+                                   name="t-low", events=events)
+        assert ctrl.run(target_rung="dryrun") == "dryrun"
+        ctrl._on_brownout(0, 1, 0.5)            # below SHED_WARN
+        assert ctrl.state == "dryrun"
+
+    def test_rollback_before_install_is_noop(self, monkeypatch,
+                                             tmp_path):
+        from gatekeeper_tpu.rollout import PromotionController
+        templates, constraints = _policy_subset()
+        _d, _h, client = _mk_client(templates, constraints)
+        ctrl = PromotionController(client, templates, constraints[1:],
+                                   name="t-noop", events=[])
+        assert ctrl.rollback(reason="nothing") is False
+        assert ctrl.state == "candidate"
+
+    def test_warm_restart_resumes_same_rung(self, monkeypatch,
+                                            tmp_path):
+        """Kill the process at warn (simulated by a fresh client and
+        controller), resume from the ninth snapshot tier, re-apply the
+        rung, and finish the ladder."""
+        from gatekeeper_tpu.resilience import snapshot as snap
+        from gatekeeper_tpu.rollout import PromotionController
+        templates, constraints = _policy_subset()
+        _d, _h, client = _mk_client(templates, constraints)
+        events = _record_corpus(client, monkeypatch, tmp_path)
+        candidate = [dict(c) for c in constraints[1:]]
+        ctrl = PromotionController(client, templates, candidate,
+                                   name="t-resume", events=events)
+        assert ctrl.run(target_rung="warn") == "warn"
+        ro_hits_before = snap.stats.ro_hits
+
+        _d2, _h2, client2 = _mk_client(templates, constraints)
+        ctrl2 = PromotionController(client2, templates, candidate,
+                                    name="t-resume", events=events)
+        assert ctrl2.resume() is True
+        assert snap.stats.ro_hits > ro_hits_before
+        assert ctrl2.state == "warn" and ctrl2.installed == "warn"
+        for c in candidate:
+            doc = client2.constraints[c["kind"]][c["metadata"]["name"]]
+            assert doc["spec"]["enforcementAction"] == "warn"
+        assert ctrl2.step() == "deny"
+
+    def test_resume_without_snapshot_is_false(self, monkeypatch,
+                                              tmp_path):
+        from gatekeeper_tpu.rollout import PromotionController
+        templates, constraints = _policy_subset()
+        _d, _h, client = _mk_client(templates, constraints)
+        ctrl = PromotionController(client, templates, constraints[1:],
+                                   name="t-none", events=[])
+        assert ctrl.resume() is False
+
+
+# ---------------------------------------------------------------------------
+# fleet graduation
+
+
+def _mk_fleet(templates, constraints, n_clusters, rows=30):
+    from gatekeeper_tpu.library import make_mixed
+    from gatekeeper_tpu.whatif import make_cluster
+    return [make_cluster(f"c{i}", templates, constraints,
+                         objs=make_mixed(random.Random(100 + i), rows))
+            for i in range(n_clusters)]
+
+
+class TestFleetGraduation:
+    def test_clean_candidate_graduates_everywhere(self):
+        from gatekeeper_tpu.rollout import GRADUATED, graduate_fleet
+        templates, constraints = _policy_subset()
+        fleet = _mk_fleet(templates, constraints, 5)
+        rep = graduate_fleet(fleet, templates,
+                             [dict(c) for c in constraints[1:]],
+                             block_size=2)
+        assert rep.n_clusters == 5 and rep.n_blocks == 3
+        assert rep.graduated == 5 and rep.blocked == rep.held == 0
+        assert all(ev.status == GRADUATED and ev.added == 0
+                   for ev in rep.per_cluster)
+        assert "5/5 graduated" in rep.headline()
+
+    def test_widening_candidate_blocks_with_evidence(self):
+        from gatekeeper_tpu.rollout import BLOCKED, graduate_fleet
+        templates, constraints = _policy_subset()
+        fleet = _mk_fleet(templates, constraints[1:], 3)
+        rep = graduate_fleet(fleet, templates,
+                             [dict(c) for c in constraints],
+                             block_size=2)
+        blocked = [ev for ev in rep.per_cluster if ev.status == BLOCKED]
+        assert blocked and rep.blocked == len(blocked)
+        assert all(ev.added > 0 for ev in blocked)
+
+    def test_straggler_fault_holds_only_itself(self, monkeypatch):
+        from gatekeeper_tpu.resilience import faults
+        from gatekeeper_tpu.rollout import HELD, graduate_fleet
+        templates, constraints = _policy_subset()
+        fleet = _mk_fleet(templates, constraints, 4)
+        monkeypatch.setenv("GATEKEEPER_FAULT", "fleet_straggler")
+        faults.reset_for_tests()
+        rep = graduate_fleet(fleet, templates,
+                             [dict(c) for c in constraints[1:]],
+                             block_size=2)
+        held = [ev for ev in rep.per_cluster if ev.status == HELD]
+        assert len(held) == 1 and rep.held == 1
+        assert "fleet_straggler" in held[0].error
+        assert rep.graduated == 3
+
+class TestPromotionStorm:
+    def test_storm_rolls_back_and_restores(self):
+        """The chaos soak's invariants 9/10 in isolation: a brownout
+        mid-rollout aborts the promotion and the side client's live
+        enforcement fingerprint is restored — twice, proving the side
+        stack is reusable across storm events in one soak."""
+        from gatekeeper_tpu.resilience import chaos
+        viol = []
+        report = chaos.SoakReport(seed=1, duration_s=0, events=[])
+        box = {}
+        for _ in range(2):
+            chaos._promotion_storm(
+                report, lambda kind, **f: viol.append((kind, f)), box)
+        assert viol == []
+        assert report.promotion_storms == 2
+        assert report.promotion_rollbacks == 2
+
+    def test_storm_in_fault_pool(self):
+        from gatekeeper_tpu.resilience.chaos import FAULTS, ONE_SHOT
+        assert "promotion_storm" in FAULTS
+        assert "promotion_storm" not in ONE_SHOT
+
+
+class TestFleetScale:
+    @pytest.mark.slow
+    def test_hundred_cluster_fleet_single_pass(self):
+        from gatekeeper_tpu.rollout import graduate_fleet
+        templates, constraints = _policy_subset(3)
+        fleet = _mk_fleet(templates, constraints, 100, rows=10)
+        rep = graduate_fleet(fleet, templates,
+                             [dict(c) for c in constraints[1:]])
+        assert rep.n_clusters == 100
+        assert rep.graduated == 100
+        assert rep.n_blocks == (100 + rep.block_size - 1) // rep.block_size

@@ -241,8 +241,12 @@ class TestReplay:
         events = [{"request": {"object": {"__truncated__": True}},
                    "allowed": True, "verdicts": []}]
         srep = replay_admissions(events, live["client"])
-        assert srep.skipped == 1 and srep.replayed == 0
+        assert srep.skipped_oversize == 1       # distinct from errors
+        assert srep.skipped == 0 and srep.replayed == 0
         assert not srep.exact                   # nothing replayed
+        from gatekeeper_tpu.whatif import replay_admissions_batched
+        brep = replay_admissions_batched(events, live["client"])
+        assert brep.skipped_oversize == 1 and brep.skipped == 0
 
 
 # ---------------------------------------------------------------------------
@@ -338,19 +342,30 @@ class TestCorpusHygiene:
         small = {"kind": "ConfigMap", "metadata": {"name": "s"}}
         assert cap_payload(small) == small
 
-    def test_corpus_files_pruned_by_keep(self, monkeypatch, tmp_path):
+    def test_corpus_segments_pruned_by_keep(self, monkeypatch, tmp_path):
+        """The capture log rotates at the segment cap and prunes sealed
+        segments down to GATEKEEPER_CAPTURE_KEEP."""
         from gatekeeper_tpu.obs.flightrecorder import FlightRecorder
         monkeypatch.setenv("GATEKEEPER_FLIGHT_DIR", str(tmp_path))
         monkeypatch.setenv("GATEKEEPER_FLIGHT_ADMISSION", "1")
-        monkeypatch.setenv("GATEKEEPER_FLIGHT_KEEP", "2")
-        for _ in range(4):      # each recorder opens its own jsonl file
-            rec = FlightRecorder(ring=8)
+        monkeypatch.setenv("GATEKEEPER_CAPTURE_SEGMENT_BYTES", "4096")
+        monkeypatch.setenv("GATEKEEPER_CAPTURE_KEEP", "2")
+        rec = FlightRecorder(ring=8)
+        for i in range(200):
             rec.record_admission(
                 {"operation": "CREATE", "kind": {"kind": "Pod"},
-                 "object": {"metadata": {"name": "p"}}}, True)
-        files = [f for f in os.listdir(tmp_path)
-                 if f.startswith("admission-")]
-        assert 0 < len(files) <= 2
+                 "object": {"metadata": {"name": f"p{i}",
+                                         "labels": {"pad": "x" * 64}}}},
+                True)
+        log = rec._capture_log()
+        assert log.flush()
+        st = rec.capture_stats()
+        assert st["dropped"] == 0 and st["written"] == 200
+        assert st["rotations"] >= 2             # cap actually rotated
+        files = [f for f in os.listdir(tmp_path / "capture")
+                 if f.endswith(".seg")]
+        assert 0 < len(files) <= 2              # pruned to keep
+        log.close()
 
     def test_record_admission_persists_verdict_fields(self, monkeypatch,
                                                       tmp_path):
