@@ -262,6 +262,13 @@ def _slim_headline() -> dict:
             pr["digest"] = pm["parity_digest"]
         if pr:
             slim["promotion"] = pr
+    cf = DETAIL.get("compile_surface")
+    if isinstance(cf, dict):
+        cfs = {k: cf.get(k) for k in ("certified", "ok")
+               if cf.get(k) is not None}
+        if cf.get("uncertified_retraces") is not None:
+            cfs["uncertified"] = cf["uncertified_retraces"]
+        slim["compile_surface"] = cfs
     rx = DETAIL.get("regex_high_cardinality")
     rh = DETAIL.get("regex_heavy")
     if isinstance(rx, dict) or isinstance(rh, dict):
@@ -2271,6 +2278,79 @@ def bench_selector_heavy(detail):
         constraints, oracle_n=2_000)
 
 
+def bench_compile_surface(detail):
+    """Stage-7 compile-surface certification row: full library install
+    under ``GATEKEEPER_COMPILE_SURFACE=strict``, certificate coverage
+    + AOT precompile at prepare_audit, then a full sweep and memoized
+    steady sweeps whose every jit dispatch must stay inside the
+    certified surface (``uncertified_retraces == 0`` is the gate).
+
+    Deliberately sized ≤2k rows and NEVER at north-star N: the gates
+    here are coverage counts and a zero counter, not a wall — and the
+    20000x201 matrix hangs the CPU watchdog on fallback containers."""
+    from gatekeeper_tpu.analysis import compilesurface as cs_mod
+
+    n = sized(2_000, 400, 2_000)
+    log(f"[compile_surface] n={n}, strict certification + steady sweep")
+    saved_mode = os.environ.get("GATEKEEPER_COMPILE_SURFACE")
+    os.environ["GATEKEEPER_COMPILE_SURFACE"] = "strict"
+    try:
+        pre0 = cs_mod.precompiles_run
+        jd = JaxDriver()
+        client = Backend(jd).new_client([K8sValidationTarget()])
+        for tdoc, cdoc in all_docs():
+            client.add_template(tdoc)
+            client.add_constraint(cdoc)
+        client.add_data_batch(make_mixed(random.Random(11), n))
+        t0 = time.perf_counter()
+        jd.prepare_audit(TARGET_NAME)       # certify + AOT precompile
+        prepare_s = time.perf_counter() - t0
+        st = jd.state[TARGET_NAME]
+        certs = getattr(st, "compilesurfaces", {})
+        certified = sum(1 for c in certs.values()
+                        if c.bounded and not c.scalar_pin)
+        pinned = sum(1 for c in certs.values() if c.scalar_pin)
+        n_unbounded = sum(1 for c in certs.values() if not c.bounded)
+        t0 = time.perf_counter()
+        results, _ = jd.query_audit(TARGET_NAME, QueryOpts(full=True))
+        full_s = time.perf_counter() - t0
+        steady: list[float] = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            jd.query_audit(TARGET_NAME, QueryOpts(full=True))
+            steady.append(time.perf_counter() - t0)
+        uncertified = getattr(jd.executor, "retrace_uncertified", 0)
+        row = {
+            "n_resources": n,
+            "templates": len(certs),
+            "certified": certified,
+            "pinned": pinned,
+            "unbounded": n_unbounded,
+            "signatures_certified": sum(
+                c.n_signatures for c in certs.values() if c.bounded),
+            "aot_precompiles": cs_mod.precompiles_run - pre0,
+            "uncertified_retraces": uncertified,
+            "prepare_seconds": round(prepare_s, 3),
+            "full_seconds": round(full_s, 3),
+            "steady_seconds": round(min(steady), 4) if steady else None,
+            "n_results": len(results),
+            # scalar-only fallback pins everything: coverage is vacuous
+            # there, so the gate only binds on a device-capable run
+            "ok": (uncertified == 0 and n_unbounded == 0
+                   and (certified >= 45 or FALLBACK)),
+        }
+        detail["compile_surface"] = row
+        log(f"[compile_surface] {certified} certified, {pinned} pinned, "
+            f"{n_unbounded} unbounded, "
+            f"{row['aot_precompiles']} AOT precompile(s), "
+            f"uncertified_retraces={uncertified}")
+    finally:
+        if saved_mode is None:
+            os.environ.pop("GATEKEEPER_COMPILE_SURFACE", None)
+        else:
+            os.environ["GATEKEEPER_COMPILE_SURFACE"] = saved_mode
+
+
 def _verdict_digest(results) -> str:
     """Order-independent digest of a full audit result set (same shape
     as resilience/smoke.py's) — the bit-identity oracle the regex rows
@@ -2918,6 +2998,8 @@ def main():
     run_phase("whatif", bench_whatif, 400)
     quiesce_upgrades()
     run_phase("promotion", bench_promotion, 300)
+    quiesce_upgrades()
+    run_phase("compile_surface", bench_compile_surface, 300)
     quiesce_upgrades()
     run_phase("regex_heavy", bench_regex_heavy, 300)
     run_phase("selector_heavy", bench_selector_heavy, 300)

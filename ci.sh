@@ -31,6 +31,12 @@ python -m gatekeeper_tpu.analysis.selflint --lockorder gatekeeper_tpu/engine gat
 # futures — engine and enforce code must rebind a fresh dict/handle,
 # never mutate in place
 python -m gatekeeper_tpu.analysis.selflint --rebind gatekeeper_tpu/engine gatekeeper_tpu/enforce
+# retrace-hazard self-lint (the static twin of the Stage-7 compile-
+# surface certificate): no per-call jit construction, no host-value
+# jnp.asarray baking, no shape-dependent branching inside kernel-side
+# functions — any of these dispatches signatures the certifier cannot
+# enumerate
+python -m gatekeeper_tpu.analysis.selflint --retrace gatekeeper_tpu/engine gatekeeper_tpu/ir gatekeeper_tpu/enforce gatekeeper_tpu/ops
 
 echo "== certify (translation validation over the library) =="
 # Stage-4 translation validation: bounded-model Rego<->IR equivalence
@@ -80,6 +86,26 @@ echo "$SP" | grep -q " 0 violation(s)" \
   || { echo "shardplan stage found violations" >&2; exit 1; }
 echo "$SP" | grep -Eq "(4[0-9]|[5-9][0-9]|[0-9]{3,}) shard-eligible" \
   || { echo "shardplan stage certified < 40 shard-eligible" >&2; exit 1; }
+
+echo "== compilesurface (Stage-7 compile-surface certificates) =="
+# Stage-7 compile-surface certifier: every device-lowered template's
+# reachable jit-signature set must be statically finite under the
+# deployment caps (pad-geometry ladders composed into a certificate).
+# rc=1 is the expected warning tier (the scalar pin); rc=2 (an
+# unbounded surface or analyzer error) fails the build, and the
+# library must keep >= 45 of its 49 templates fully certified with 0
+# unbounded.
+CSF_RC=0
+CSF=$(JAX_PLATFORMS=cpu GATEKEEPER_COMPILE_SURFACE=strict timeout -k 10 240 \
+      python -m gatekeeper_tpu.client.probe --compilesurface --library \
+      | tail -3) || CSF_RC=$?
+echo "$CSF"
+[ "$CSF_RC" -le 1 ] \
+  || { echo "compilesurface stage failed (rc=$CSF_RC)" >&2; exit 1; }
+echo "$CSF" | grep -q " 0 unbounded" \
+  || { echo "compilesurface stage found unbounded surfaces" >&2; exit 1; }
+echo "$CSF" | grep -Eq "(4[5-9]|[5-9][0-9]|[0-9]{3,}) certified" \
+  || { echo "compilesurface stage certified < 45 templates" >&2; exit 1; }
 
 echo "== whatif (shadow / replay / fleet parity probe) =="
 # What-if engine self-check: a shadow (live ∪ candidate) sweep must be
@@ -223,10 +249,19 @@ assert cold["dfa_compiles"] > 0, \
 assert warm["dfa_compiles"] == 0, \
     f"warm run recompiled DFAs instead of loading the dfa " \
     f"snapshot tier: {warm}"
+assert cold["compile_surfaces"] > 0, \
+    f"cold run never certified a compile surface (stage-7 off?): {cold}"
+assert warm["compile_surfaces"] == 0, \
+    f"warm run re-ran Stage-7 compile-surface analysis: {warm}"
+assert cold["aot_precompiles"] > 0, \
+    f"cold run never AOT-precompiled the certified surface: {cold}"
+assert warm["aot_precompiles"] == 0, \
+    f"warm run repeated the startup AOT compile storm instead of " \
+    f"honoring the cs-tier geometry stamp: {warm}"
 print(f"restart smoke ok: startup cold {cold['startup_seconds']}s -> "
       f"warm {warm['startup_seconds']}s; "
       f"{warm['restart_persistent_cache_hits']} snapshot hits, "
-      f"0 re-lowerings, 0 DFA recompiles, "
+      f"0 re-lowerings, 0 DFA recompiles, 0 warm AOT compiles, "
       f"verdict digest {warm['verdict_digest']}")
 EOF
 
@@ -248,6 +283,7 @@ echo "== chaos (seeded 30s soak, admission + audit under faults) =="
 # headline — grep it from the trailing window like the bench gate.
 CH_RC=0
 CH=$(JAX_PLATFORMS=cpu GATEKEEPER_SUPERVISOR_BACKOFF_S=0.5 \
+     GATEKEEPER_COMPILE_SURFACE=strict \
      timeout -k 10 300 \
      python -m gatekeeper_tpu.resilience.chaos --seed 7 --duration 30 \
      | tail -3) || CH_RC=$?
@@ -262,6 +298,8 @@ echo "$CH" | grep -Eq "watch_ev=[1-9][0-9]*" \
   || { echo "chaos soak delivered no watch events" >&2; exit 1; }
 echo "$CH" | grep -Eq "ledger_checks=[1-9][0-9]*" \
   || { echo "chaos soak ran no ledger checkpoints" >&2; exit 1; }
+echo "$CH" | grep -q "uncertified_retraces=0 " \
+  || { echo "chaos soak dispatched outside the compile surface" >&2; exit 1; }
 
 echo "== bench smoke (quick shapes) =="
 GATEKEEPER_BENCH_QUICK=1 GATEKEEPER_BENCH_N=20000 python bench.py > /tmp/bench.json
@@ -380,6 +418,15 @@ assert rx.get("in_jit_vs_host_loop", 0) >= 10, \
 ov = d.get("overload")
 assert isinstance(ov, dict) and ov.get("within_budget") is True, \
     f"no within-budget overload row in the trailing headline: {d}"
+# the compile_surface row must survive the window: the memoized steady
+# sweep under GATEKEEPER_COMPILE_SURFACE=strict must complete with
+# every jit dispatch inside the certified surface (0 uncertified
+# retraces) and the library coverage of record (>= 45 certified, or a
+# flagged scalar-fallback run)
+cfs = d.get("compile_surface")
+assert isinstance(cfs, dict) and cfs.get("ok") is True \
+    and cfs.get("uncertified", 1) == 0, \
+    f"no clean compile_surface row in the trailing headline: {d}"
 # the promotion row must survive the window: the rollout evidence
 # gate's batched corpus replay must beat the scalar replay oracle by
 # >=3x with bit-identical sha256 verdict digests, the controller must
@@ -409,6 +456,8 @@ print("bench ok:", d["metric"], round(d["value"], 1), d["unit"],
       f"{rx.get('in_jit_vs_host_loop')}x parity {rx.get('parity_digest')}; "
       f"promotion replay {pm.get('replay_speedup')}x parity "
       f"{pm.get('digest')} -> {pm.get('final_rung')} with "
-      f"{pm.get('fleet_graduated')} clusters graduated)")
+      f"{pm.get('fleet_graduated')} clusters graduated; "
+      f"compile surface {cfs.get('certified')} certified, "
+      f"{cfs.get('uncertified')} uncertified retraces)")
 EOF
 echo "CI PASS"

@@ -63,6 +63,26 @@ b.arrays[k]``), mutating dict methods (``.update``/``.pop``/
 assignment on any ``<expr>.arrays`` / ``<expr>.base_dirty`` attribute.
 Reads stay legal; this codifies the invariant documented at
 engine/jax_driver.py (previously enforced only by comment).
+
+``--retrace`` switches to the RETRACE-HAZARD checker for kernel-side
+code, the static twin of the Stage-7 compile-surface certificate
+(analysis/compilesurface.py): inside kernel roots it flags (a)
+``jax.jit(...)`` / ``jit(...)`` / ``partial(jax.jit, ...)`` calls — a
+jit wrapper constructed inside a traced function is a fresh
+unmemoized executable per call, invisible to the compile cache and
+the AOT precompiler; (b) ``jnp.asarray(...)`` / ``jnp.array(...)``
+over freshly CONSTRUCTED host data (a literal, comprehension, or call
+result) — such a value is baked per-signature into the compiled
+artifact, so every drifting input shape is a retrace; re-wrapping an
+already-bound array (``jnp.asarray(arrays[name])``, a plain name) is
+a no-op under trace and exempt; and (c) ``if``-tests on ``.shape`` /
+``.ndim`` — shape-dependent Python branching specializes the trace
+beyond the pad-bucket ladder the certificate enumerated; the numpy
+broadcast-dimension probe (``x.shape[i] == 1``) is exempt, it selects
+between layouts inside the same certified lattice.  All three are
+legitimate at the host seams (the memoized ``_compiled`` cache,
+binding prep) — the lint scopes to the jit closure, so those seams
+are naturally exempt.
 """
 
 from __future__ import annotations
@@ -104,6 +124,16 @@ _LOCK_BLOCKING_QUALIFIED = {("time", "sleep")}
 # a fresh object, never mutated in place
 _REBIND_ATTRS = {"arrays", "base_dirty", "mask", "page_table", "ij_dev"}
 _DICT_MUTATORS = {"update", "setdefault", "pop", "clear", "popitem"}
+
+# retrace-hazard rule set (--retrace): host->device conversion calls
+# that bake per-trace constants when they appear inside the trace
+_RETRACE_CONVERT = {
+    ("jnp", "asarray"), ("jnp", "array"),
+    ("jax", "numpy", "asarray"), ("jax", "numpy", "array"),
+}
+# attributes whose appearance in an `if` test makes the branch
+# shape-dependent (trace specialization past the pad-bucket ladder)
+_RETRACE_SHAPE_ATTRS = {"shape", "ndim"}
 
 
 def _dotted(node: ast.AST) -> tuple[str, ...] | None:
@@ -264,6 +294,71 @@ def _lint_tree(tree: ast.Module, path: str) -> list[str]:
                         f"{'.'.join(d)}() inside kernel-side function "
                         f"{root.name!r}")
                     break
+    return findings
+
+
+def _is_broadcast_probe(test: ast.AST) -> bool:
+    """``x.shape[i] == 1`` / ``!= 1`` — the numpy broadcast-dimension
+    idiom.  Axis-1 vs axis-N layout selection is static per signature
+    and stays inside the certified pad lattice, so it is exempt from
+    the shape-branch rule."""
+    if isinstance(test, ast.Compare) and len(test.ops) == 1 \
+            and isinstance(test.ops[0], (ast.Eq, ast.NotEq)):
+        return any(isinstance(s, ast.Constant) and s.value == 1
+                   for s in (test.left, test.comparators[0]))
+    return False
+
+
+def _bakes_host_value(call: ast.Call) -> bool:
+    """True when an asarray/array call converts freshly constructed
+    host data (literal, comprehension, call result) rather than
+    re-wrapping an already-materialized array (Name / Attribute /
+    Subscript — a no-op under trace)."""
+    if not call.args:
+        return False
+    return not isinstance(call.args[0],
+                          (ast.Name, ast.Attribute, ast.Subscript))
+
+
+def _lint_retrace_tree(tree: ast.Module, path: str) -> list[str]:
+    """Flag retrace hazards inside kernel-side functions: per-call jit
+    construction, in-trace host->device conversion, shape-dependent
+    Python branching.  Walks root bodies (not decorator lists — the
+    root's own ``@jax.jit`` is the legitimate seam, not a finding)."""
+    findings: list[str] = []
+    for root in _kernel_roots(tree):
+        for stmt in root.body:
+            for sub in ast.walk(stmt):
+                if isinstance(sub, (ast.If, ast.IfExp)):
+                    if _is_broadcast_probe(sub.test):
+                        continue
+                    for t in ast.walk(sub.test):
+                        if isinstance(t, ast.Attribute) \
+                                and t.attr in _RETRACE_SHAPE_ATTRS:
+                            findings.append(
+                                f"{path}:{sub.lineno}: shape-dependent "
+                                f"branch on .{t.attr} inside kernel-side "
+                                f"function {root.name!r} (trace "
+                                f"specialization past the certified "
+                                f"pad ladder)")
+                            break
+                    continue
+                if not isinstance(sub, ast.Call):
+                    continue
+                if _is_jit_expr(sub.func) or _is_jit_expr(sub):
+                    findings.append(
+                        f"{path}:{sub.lineno}: jit construction inside "
+                        f"kernel-side function {root.name!r} (per-call "
+                        f"executable, invisible to the compile cache "
+                        f"and AOT precompiler)")
+                    continue
+                d = _dotted(sub.func)
+                if d in _RETRACE_CONVERT and _bakes_host_value(sub):
+                    findings.append(
+                        f"{path}:{sub.lineno}: {'.'.join(d)}() over "
+                        f"freshly constructed host data inside "
+                        f"kernel-side function {root.name!r} (baked "
+                        f"per trace; convert at the binding seam)")
     return findings
 
 
@@ -538,19 +633,27 @@ def lint_rebind_paths(paths: list[str]) -> list[str]:
     return _lint_files(paths, _lint_rebind_tree)
 
 
+def lint_retrace_paths(paths: list[str]) -> list[str]:
+    return _lint_files(paths, _lint_retrace_tree)
+
+
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     locks = "--locks" in argv
     lockorder = "--lockorder" in argv
     rebind = "--rebind" in argv
+    retrace = "--retrace" in argv
     argv = [a for a in argv if a not in ("--locks", "--lockorder",
-                                         "--rebind")]
+                                         "--rebind", "--retrace")]
     if not argv:
         print("usage: python -m gatekeeper_tpu.analysis.selflint "
-              "[--locks|--lockorder|--rebind] <dir-or-file>...",
+              "[--locks|--lockorder|--rebind|--retrace] <dir-or-file>...",
               file=sys.stderr)
         return 2
-    if locks:
+    if retrace:
+        findings = lint_retrace_paths(argv)
+        kind_msg = "retrace hazard(s) in kernel-side code"
+    elif locks:
         findings = lint_lock_paths(argv)
         kind_msg = "blocking call(s) under _lock"
     elif lockorder:

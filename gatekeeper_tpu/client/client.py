@@ -365,6 +365,23 @@ class Client:
                 total = pred if total is None else total + pred
         return total
 
+    def certified_review_rungs(self, max_n: int | None = None
+                               ) -> list[int] | None:
+        """Batch sizes inside every target's Stage-7 certified compile
+        surface (the micro-batcher's deadline-shrink ladder), or None
+        when any target lacks a fully certified surface — the batcher
+        then falls back to blind halving."""
+        fn = getattr(self.driver, "certified_review_rungs", None)
+        if fn is None:
+            return None
+        out: set[int] | None = None
+        for name in self.targets:
+            rungs = fn(name, max_n)
+            if rungs is None:
+                return None
+            out = set(rungs) if out is None else out & set(rungs)
+        return sorted(out) if out else None
+
     def prefetch_external(self, objs: list) -> None:
         """Warm the external-data provider caches for a micro-batch
         ahead of evaluation (the webhook batcher wires this in): one

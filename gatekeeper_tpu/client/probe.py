@@ -872,6 +872,63 @@ def run_shardplan(paths: list[str], use_library: bool = False) -> int:
     return _severity_rc(n_viol + errs["n"], n_inelig + n_pin)
 
 
+def run_compilesurface(paths: list[str], use_library: bool = False) -> int:
+    """``--compilesurface``: Stage-7 compile-surface certification
+    (analysis/compilesurface.py) over template files and/or the
+    built-in library.  For each device-lowered template, statically
+    enumerate every shape signature its jitted programs can be
+    dispatched with (the pad-geometry ladders of ir/prep.py under the
+    deployment caps) and print the certified signature count per
+    padded axis; a template whose surface is input-unbounded under the
+    caps is an error-severity finding (an attacker-controlled retrace
+    storm), and scalar-fallback templates are reported as pinned (no
+    device program, nothing to certify).  Exit contract
+    (:func:`_severity_rc`): 2 on any unbounded surface or unloadable
+    input, 1 when every device surface is bounded but some template is
+    pinned, 0 fully certified."""
+    import sys
+    import time as _time
+
+    from gatekeeper_tpu.analysis import compilesurface
+
+    work = _load_work(paths, use_library)
+    if work is None:
+        return 2
+    t0 = _time.perf_counter()
+    errs = {"n": 0}
+    n_cert = n_unbounded = n_pin = 0
+    total_sigs = 0
+    for kind, compiled, lowered, cdocs in _compile_work(work, errs):
+        if lowered is None:
+            n_pin += 1
+            print(f"  pin  {kind}: scalar fallback (no device program, "
+                  "nothing to certify)")
+            continue
+        try:
+            cert = compilesurface.analyze(kind, lowered)
+        except Exception as e:          # noqa: BLE001
+            errs["n"] += 1
+            print(f"  FAIL {kind}: analyzer error: {e}", file=sys.stderr)
+            continue
+        if cert.bounded:
+            n_cert += 1
+            total_sigs += cert.n_signatures
+            axes = ", ".join(f"{cls}[{lo}..{cap}]:{n}"
+                             for cls, lo, cap, n in cert.axes)
+            print(f"  ok   {kind}: {cert.n_signatures} signature(s), "
+                  f"{cert.delta_rungs} delta rung(s)")
+            print(f"         axes: {axes or '(static only)'}")
+        else:
+            n_unbounded += 1
+            print(f"  FAIL {kind}: compile_surface_unbounded — "
+                  f"{cert.reason}", file=sys.stderr)
+    wall = _time.perf_counter() - t0
+    print(f"compilesurface: {len(work)} template(s), {n_cert} certified, "
+          f"{n_unbounded} unbounded, {n_pin} pinned, "
+          f"{total_sigs} total signature(s) in {wall:.1f}s")
+    return _severity_rc(n_unbounded + errs["n"], n_pin)
+
+
 def run_whatif() -> int:
     """``--whatif``: self-validate the what-if engine's four parity
     contracts over the built-in library (ROADMAP item 5) —
@@ -1398,6 +1455,8 @@ def _run_subcommand(argv: list[str]) -> int | None:
             rest, use_library=use_library)),
         ("--shardplan", lambda rest: run_shardplan(
             rest, use_library=use_library)),
+        ("--compilesurface", lambda rest: run_compilesurface(
+            rest, use_library=use_library)),
         ("--pages", lambda rest: run_pages(
             rest, use_library=use_library)),
         ("--lint", lambda rest: run_lint(
@@ -1414,7 +1473,8 @@ def main(argv=None) -> int:
     engines (the readiness wiring the reference's Probe exists for).
     ``--builtins`` lists the builtin registry instead of probing;
     ``--lint <template.yaml>... [--library]`` runs the static-analysis
-    pass and ``--certify`` the Stage-4 translation validator instead;
+    pass, ``--certify`` the Stage-4 translation validator, and
+    ``--compilesurface`` the Stage-7 compile-surface certifier instead;
     analysis subcommands share one exit contract: 0 clean, 1 warnings
     only, 2 any error-severity finding or unreadable input.
 

@@ -129,7 +129,11 @@ class Manager:
             # severed connection
             submit_timeout=REQUEST_TIMEOUT_S * 0.9,
             prefetch=self.client.prefetch_external,
-            predict_seconds=self.client.predict_review_seconds)
+            predict_seconds=self.client.predict_review_seconds,
+            # Stage-7: deadline shrinks step along the certified
+            # compile-surface rungs instead of halving blindly
+            certified_rungs=lambda: self.client.certified_review_rungs(
+                args.max_batch))
         self.overload = OverloadController(self.batcher.depth,
                                            self.batcher.capacity,
                                            metrics=self.metrics)

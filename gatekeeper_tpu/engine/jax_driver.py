@@ -191,6 +191,9 @@ class JaxTargetState(TargetState):
         self.footprints: dict[str, object] = {}
         # kind -> Stage-6 partition plan (analysis/shardplan.py)
         self.shardplans: dict[str, object] = {}
+        # kind -> Stage-7 compile-surface certificate
+        # (analysis/compilesurface.py)
+        self.compilesurfaces: dict[str, object] = {}
         # kind -> last device sweep payload + guards, for
         # footprint-driven selective invalidation (_selective_reuse)
         self.sweep_cache: dict[str, dict] = {}
@@ -256,6 +259,11 @@ class JaxDriver(LocalDriver):
         self.supervisor.add_recovery_listener(self, "_on_backend_recovered")
         self.executor = ProgramExecutor(mesh=mesh)
         self.metrics = Metrics()
+        # Stage-7 retrace sentinel: consulted by the executor ONLY on a
+        # jit cache miss; a signature outside the installed
+        # CompileSurface certificate is counted + flight-recorded here
+        # (strict-mode refusal happens at the executor seam)
+        self.executor.surface_guard = self._surface_guard
         # serializes reader-side cache fills (bindings/mask delta prep):
         # racing audit readers would otherwise interleave interner
         # appends and column/cache mutations across different kinds —
@@ -471,6 +479,15 @@ class JaxDriver(LocalDriver):
                 st.shardplans[kind] = sp
             else:
                 st.shardplans.pop(kind, None)
+            # stage 7 (compile surface): certifies the finite signature
+            # set the jitted programs can be entered with; the cs
+            # snapshot tier keeps warm restarts at zero re-analyses.
+            # Scalar pins get the trivial empty-surface certificate.
+            cs_cert = self._compilesurface_lowered(kind, compiled)
+            if cs_cert is not None:
+                st.compilesurfaces[kind] = cs_cert
+            else:
+                st.compilesurfaces.pop(kind, None)
             st.sweep_cache.pop(kind, None)
         st.templates[kind] = compiled
         st.bump(kind)
@@ -546,6 +563,69 @@ class JaxDriver(LocalDriver):
         if not plan.eligible:
             self.metrics.counter("shardplan_ineligible").inc()
         return plan
+
+    def _compilesurface_lowered(self, kind: str,
+                                compiled: CompiledTemplate):
+        """Stage-7 compile-surface certification
+        (analysis/compilesurface.py) behind
+        GATEKEEPER_COMPILE_SURFACE=off|warn|strict.  Like stage 6 this
+        NEVER fails an install: an unbounded (or errored) surface only
+        excludes the kind from AOT precompilation and retrace gating —
+        it keeps serving through the lazy-recompile path, which is
+        always correct."""
+        from gatekeeper_tpu.analysis import compilesurface
+        if compilesurface.mode() == "off":
+            return None
+        if compiled.vectorized is None:
+            return compilesurface.scalar_surface(kind)
+        try:
+            cert = compilesurface.certify(kind, compiled,
+                                          compiled.vectorized)
+        except Exception as e:   # noqa: BLE001 — analysis must not take
+            # template install down with it; no certificate just means
+            # no AOT prewarm or retrace gating for this kind
+            from gatekeeper_tpu.utils.log import logger
+            logger("engine.jax_driver").warning(
+                "compile-surface analysis errored", kind=kind,
+                err=str(e))
+            self.metrics.counter("compilesurface_errors").inc()
+            return None
+        if not cert.bounded:
+            self.metrics.counter("compile_surface_unbounded").inc()
+            from gatekeeper_tpu.utils.log import logger
+            logger("engine.jax_driver").warning(
+                "compile surface unbounded; kind excluded from AOT "
+                "precompile and retrace gating", kind=kind,
+                reason=cert.reason)
+        return cert
+
+    def _surface_guard(self, program, arrays,
+                       delta_k: int | None = None) -> bool:
+        """Executor cache-miss hook: True when the dispatch signature
+        is inside the installed certificate (or the program is
+        unguarded).  An uncertified signature is counted and
+        flight-recorded; the executor decides refusal (strict) vs the
+        lazy-recompile fallback (warn)."""
+        from gatekeeper_tpu.analysis import compilesurface
+        try:
+            ok = compilesurface.dispatch_certified(program, arrays,
+                                                   delta_k=delta_k)
+        except Exception:   # noqa: BLE001 — the sentinel must never
+            return True     # take a legitimate dispatch down
+        if ok:
+            return True
+        compilesurface.uncertified_total += 1
+        self.metrics.counter("retrace_uncertified_total").inc()
+        try:
+            from gatekeeper_tpu.obs.flightrecorder import record_event
+            record_event(
+                "retrace_uncertified",
+                shapes={nm: tuple(int(d) for d in arrays[nm].shape)
+                        for nm in sorted(arrays)},
+                delta_k=delta_k, mode=compilesurface.mode())
+        except Exception:   # noqa: BLE001
+            pass
+        return False
 
     def _certify_lowered(self, kind: str, compiled: CompiledTemplate):
         """Stage-4 translation validation (analysis/transval.py) behind
@@ -1972,11 +2052,112 @@ class JaxDriver(LocalDriver):
         when a plan is ready (False: scalar-only, dedup off, or nothing
         lowered — the sweep then runs without a plan, as always)."""
         st = self.state.get(target)
-        if st is None or self.scalar_only \
-                or os.environ.get("GATEKEEPER_DEDUP", "on") == "off":
+        if st is None or self.scalar_only:
+            return False
+        # Stage-7: AOT-compile the certified signatures of the current
+        # geometry before declaring ready (warm restarts skip via the
+        # cs-tier geometry stamp — zero startup compiles)
+        self._precompile(st, target)
+        if os.environ.get("GATEKEEPER_DEDUP", "on") == "off":
             return False
         with self._prep_lock:
             return self._audit_dedup_plan(st, target) is not None
+
+    @locked_read
+    def precompile(self, target: str) -> int:
+        """AOT-lower and compile every Stage-7-certified signature of
+        the target's current geometry (the install/warm-restart seam,
+        also reached through :meth:`prepare_audit`).  Returns the
+        number of AOT compiles issued — 0 on a warm restart whose
+        geometry stamp is already in the cs snapshot tier."""
+        st = self.state.get(target)
+        if st is None:
+            return 0
+        return self._precompile(st, target)
+
+    def _precompile(self, st, target: str) -> int:
+        from gatekeeper_tpu.analysis import compilesurface
+        if compilesurface.mode() == "off" or self.scalar_only \
+                or not isinstance(st, JaxTargetState) \
+                or self.executor.mesh is not None:
+            return 0
+        entries: list[tuple] = []
+        with self._prep_lock:
+            for kind in sorted(st.templates):
+                compiled = st.templates[kind]
+                cert = st.compilesurfaces.get(kind)
+                cons = self._kind_constraints(st, kind)
+                if compiled.vectorized is None or not cons:
+                    continue
+                if cert is None or not cert.bounded \
+                        or getattr(cert, "scalar_pin", False):
+                    continue
+                try:
+                    bindings = self._kind_bindings(st, kind, compiled,
+                                                   cons)
+                except Exception:   # noqa: BLE001 — prewarm is an
+                    continue        # optimization, never a gate
+                # mirror the dispatch-time gate set (_install_gates):
+                # kinds with match criteria get a __match__ binding
+                with_match = any((c.get("spec") or {}).get("match")
+                                 for c in cons)
+                entries.append((kind, cert.digest,
+                                compiled.vectorized.program, bindings,
+                                with_match))
+        if not entries:
+            return 0
+        import hashlib as _hashlib
+        from gatekeeper_tpu.resilience import snapshot as _snap
+        geom = sorted((kind, dg, b.c_pad, b.r_pad, wm)
+                      for kind, dg, _p, b, wm in entries)
+        stamp = _hashlib.sha256(repr(geom).encode()).hexdigest()
+        if _snap.load_compilesurface(f"aot:{target}:{stamp}") is not None:
+            # warm restart at the same certified geometry: zero AOT
+            # compiles here — first dispatches reload their executables
+            # through the persistent compile cache instead of paying a
+            # startup compile storm
+            return 0
+        n = 0
+        for _kind, _dg, prog, bindings, wm in entries:
+            try:
+                self.executor.prewarm_audit_exec(
+                    prog, bindings, DEFAULT_PREWARM_CAP, with_match=wm)
+                compilesurface.precompiles_run += 1
+                n += 1
+            except Exception:   # noqa: BLE001 — best-effort
+                continue
+        self.metrics.counter("compile_surface_precompiles").inc(n)
+        _snap.save_compilesurface(f"aot:{target}:{stamp}",
+                                  {"target": target, "n": n})
+        return n
+
+    def certified_review_rungs(self, target: str,
+                               max_n: int | None = None
+                               ) -> list[int] | None:
+        """Batch sizes whose padded review signature is inside the
+        Stage-7 certified surface — the rungs the micro-batcher's
+        ``_fit_to_deadline`` may shrink along.  Review mini-tables pad
+        to ``bucket(B)`` (minimum 8), so the rungs are 1 plus the
+        power-of-two ladder up to the rows cap.  None when the stage is
+        off, nothing is certified yet, or any installed template's
+        surface is unbounded (the batcher then falls back to blind
+        halving)."""
+        from gatekeeper_tpu.analysis import compilesurface
+        from gatekeeper_tpu.ir import prep as _prep
+        if compilesurface.mode() == "off":
+            return None
+        st = self.state.get(target)
+        if not isinstance(st, JaxTargetState):
+            return None
+        certs = [st.compilesurfaces.get(k) for k in st.templates]
+        have = [c for c in certs if c is not None]
+        if not have or any(not c.bounded for c in have):
+            return None
+        rungs = [1] + list(_prep.bucket_ladder(
+            8, compilesurface._cap("r")))
+        if max_n is not None:
+            rungs = [r for r in rungs if r <= max_n] or [1]
+        return rungs
 
     def _shared_col(self, st, plan, kind: str, digest: str, bindings):
         """One shared conjunct's host column, page-partitioned ACROSS

@@ -831,6 +831,13 @@ class ProgramExecutor:
         self.h2d_scatter_rows = 0
         self._upgrade_q: list = []
         self._upgrade_thread = None
+        # Stage-7 retrace sentinel (analysis/compilesurface.py): the
+        # driver installs a guard(program, arrays, delta_k) -> bool
+        # consulted ONLY on a jit cache miss.  An uncertified signature
+        # bumps retrace_uncertified; under strict mode the dispatch is
+        # refused (UncertifiedRetrace) instead of compiled mid-traffic.
+        self.surface_guard = None
+        self.retrace_uncertified = 0
         # multi-chip: a (c, r) jax.sharding.Mesh — bindings device_put
         # with NamedShardings per ir/prep.binding_axes, executables built
         # via shard_map (parallel/sharding.py).  None = single device.
@@ -1215,6 +1222,8 @@ class ProgramExecutor:
         with self._lock:
             fn = self._cache.get(key)
         if fn is None:
+            self._guard_miss(program, arrays, delta_k=k)
+
             def raw(args: tuple, old: jax.Array, pt: jax.Array):
                 args = _widen_args(args)
                 d = dict(zip(names, args))
@@ -1245,6 +1254,33 @@ class ProgramExecutor:
         return (new_mask, np.asarray(idx), np.asarray(signs),
                 int(count), np.asarray(row_any))
 
+    def _guard_miss(self, program, arrays, delta_k: int | None = None):
+        """Stage-7 sentinel, called on a jit cache miss before tracing.
+        warn mode counts + records (the driver's guard does both) and
+        lets the lazy recompile proceed; strict mode refuses the
+        dispatch — a signature outside the certificate compiled
+        mid-traffic is exactly the retrace storm the CompileSurface
+        rules out."""
+        guard = self.surface_guard
+        if guard is None:
+            return
+        try:
+            ok = guard(program, arrays, delta_k)
+        except Exception:   # noqa: BLE001 — the sentinel must never
+            return          # take a legitimate dispatch down
+        if ok:
+            return
+        with self._lock:
+            self.retrace_uncertified += 1
+        from gatekeeper_tpu.analysis import compilesurface as _cs
+        if _cs.mode() == "strict":
+            shapes = {nm: tuple(int(d) for d in arrays[nm].shape)
+                      for nm in sorted(arrays)}
+            raise _cs.UncertifiedRetrace(
+                f"dispatch signature outside the certified compile "
+                f"surface (strict mode refuses the retrace): "
+                f"shapes={shapes}, delta_k={delta_k}")
+
     def _compiled(self, program: Program, arrays: dict, topk: int | None,
                   sharded: bool = False):
         """Callable for (program, shape bucket).  Tracing/lowering is
@@ -1269,6 +1305,7 @@ class ProgramExecutor:
             if fn is not None:
                 self.cache_hits += 1
         if fn is None:
+            self._guard_miss(program, arrays)
             # single-flight per key: concurrent misses (dispatch pool)
             # must not compile the same executable twice — the compile
             # service serializes, so a duplicate doubles cold latency

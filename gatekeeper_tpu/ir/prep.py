@@ -126,6 +126,102 @@ def binding_axes(name: str) -> tuple:
                      f"add its axes rule here")
 
 
+# bumped whenever a padding formula above (bucket/interner_bucket/
+# audit_pads) or a dim-class rule below changes shape semantics: the
+# Stage-7 compile-surface certificates key on it, so a geometry change
+# invalidates every persisted certificate instead of certifying stale
+# ladders
+PAD_GEOMETRY_VERSION = "padgeom-1"
+
+
+def bucket_ladder(minimum: int, cap: int) -> tuple[int, ...]:
+    """Every value :func:`bucket` (and :func:`interner_bucket`, whose
+    image is the same power-of-two set) can produce between ``minimum``
+    and ``cap`` inclusive — the finite growth ladder of one pad axis.
+    Empty when the cap is below the minimum."""
+    out = []
+    p = 1
+    while p < max(minimum, 1):
+        p <<= 1
+    while p <= cap:
+        out.append(p)
+        p <<= 1
+    return tuple(out)
+
+
+def binding_dim_classes(name: str) -> tuple[str, ...]:
+    """Pad-geometry class of each dim of one bound array, by the same
+    naming convention as :func:`binding_axes`:
+
+      'r'      — resource axis, padded by ``bucket()`` (audit_pads /
+                 review mini-tables / dirty-row delta buckets);
+      'c'      — constraint axis, ``bucket(·, minimum=4)``;
+      't'      — interner-table axis, ``interner_bucket()`` (grows with
+                 distinct strings, headroom-stepped);
+      'e'      — element axis, ``bucket(·, minimum=2)`` (grows with the
+                 longest per-resource list);
+      'static' — fixed at install time (constraint-set key counts, DFA
+                 state counts, the interner byte width): exactly one
+                 value per installed policy set, so it contributes no
+                 growth rung.
+
+    This is the single source the Stage-7 compile-surface certifier
+    (analysis/compilesurface.py) enumerates signature ladders from.
+    Raises on unknown names, mirroring binding_axes — an unclassified
+    binding means the compile surface is not provably finite."""
+    base = name.split(".")[0]
+    if name == "__match__":
+        return ("c", "r")
+    if name in ("__alive__", "__rank__", "__pagetable__"):
+        return ("r",)
+    if name == "__cvalid__":
+        return ("c",)
+    if name.startswith("__elem__:") or base.startswith("e:"):
+        return ("r", "e")
+    if base.startswith("r:"):
+        return ("r",)
+    if base.startswith("m") and base[1:].isdigit():
+        return ("static", "r")                   # memb [L, R]
+    if base.startswith("kl") and base[2:].isdigit():
+        if name.endswith(".kv"):
+            return ("static", "r")               # keyed values [K, R]
+        return ("c",)                            # .sel [C]
+    if base.startswith("ek") and base[2:].isdigit():
+        return ("static", "r", "e")              # elem keys [K, R, E]
+    if base.startswith("cs") and base[2:].isdigit():
+        if name.endswith(".vmap"):
+            return ("t",)                        # global id -> dense u [T]
+        return ("c", "static")                   # .bitmap / .B [C, U|L]
+    if base.startswith("cv") and base[2:].isdigit():
+        return ("c",)
+    if base.startswith("cb") and base[2:].isdigit():
+        return ("c",)
+    if base.startswith("pt") and base[2:].isdigit():
+        if name.endswith(".vmap"):
+            return ("t",)                        # global id -> dense u [T]
+        return ("c", "static")                   # .any / .all [C, U]
+    if base.startswith("ij") and base[2:].isdigit():
+        return ("r",)
+    if base.startswith("t") and base[1:].isdigit():
+        return ("t",)                            # unary table [T]
+    if name == "__strbytes__":
+        return ("t", "static")                   # interner bytes [T, W]
+    if name == "__strdfaok__":
+        return ("t",)
+    if base.startswith("dfa") and base[3:].isdigit():
+        if name.endswith(".trans"):
+            return ("static", "static")          # DFA table [S, 256]
+        if name.endswith(".xv"):
+            return ("t",)                        # host route-back [T]
+        return ("static",)                       # .accept [S]
+    if name.startswith("__shared_e__:"):
+        return ("r", "e")                        # dedup-injected [R, E]
+    if name.startswith("__shared__:"):
+        return ("r",)                            # dedup-injected [R]
+    raise ValueError(f"binding_dim_classes: unrecognized binding "
+                     f"{name!r}; add its pad-geometry rule here")
+
+
 # ---------------------------------------------------------------------------
 # prep spec: declarative requests emitted by the lowerer
 

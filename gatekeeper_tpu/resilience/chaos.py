@@ -385,6 +385,8 @@ class SoakReport:
     backend_degradations: int = 0
     backend_recoveries: int = 0
     backend_rejits: int = 0
+    uncertified_retraces: int = 0  # jit dispatches outside the Stage-7
+    #                                compile-surface certificate
     watch_events: int = 0        # frames the reactor ingested
     watch_pathologies: dict = dataclasses.field(default_factory=dict)
     reactor_resyncs: int = 0     # rung-2 + rung-3 ladder runs
@@ -405,6 +407,7 @@ class SoakReport:
                 f"/{self.queue_capacity} p99={self.p99_s * 1e3:.1f}ms "
                 f"recoveries={self.backend_recoveries} "
                 f"rejits={self.backend_rejits} "
+                f"uncertified_retraces={self.uncertified_retraces} "
                 f"watch_ev={self.watch_events} "
                 f"pathologies={sum(self.watch_pathologies.values())} "
                 f"resyncs={self.reactor_resyncs} "
@@ -453,6 +456,7 @@ def run_soak(seed: int = 7, duration_s: float = 30.0, rps: float = 150.0,
     prev_fault = os.environ.get("GATEKEEPER_FAULT")
     os.environ["GATEKEEPER_FAULT"] = ""
 
+    from gatekeeper_tpu.analysis import compilesurface as _cs
     from gatekeeper_tpu.api.config import GVK
     from gatekeeper_tpu.api.externaldata import IGNORE, Provider
     from gatekeeper_tpu.client.client import Backend
@@ -823,6 +827,18 @@ def run_soak(seed: int = 7, duration_s: float = 30.0, rps: float = 150.0,
         report.warnings.append(
             "watch stream carried no events: churn worker never ran "
             "(reactor invariants were vacuous)")
+
+    # Stage-7 compile-surface invariant: the whole soak — churn, review
+    # batches, backend kills, promotion storms — must never demand a jit
+    # signature outside the installed certificates.  One uncertified
+    # retrace means the certifier missed a reachable signature (or the
+    # caps are mis-sized for the workload): a violation either way.
+    report.uncertified_retraces = getattr(
+        live_client.driver.executor, "retrace_uncertified", 0)
+    if report.uncertified_retraces:
+        violation("uncertified_retrace",
+                  count=report.uncertified_retraces,
+                  mode=_cs.mode())
 
     sup = get_supervisor()
     report.backend_degradations = \

@@ -60,11 +60,17 @@ def main() -> int:
     # same contract for the Stage-6 partition plans: the warm process
     # must load every plan from the sp snapshot tier ("shardplans" == 0)
     os.environ.setdefault("GATEKEEPER_SHARDPLAN", "warn")
+    # and for the Stage-7 compile surfaces: the warm process must load
+    # every certificate from the cs tier ("compile_surfaces" == 0) AND
+    # skip the startup AOT compile storm via the cs-tier geometry stamp
+    # ("aot_precompiles" == 0)
+    os.environ.setdefault("GATEKEEPER_COMPILE_SURFACE", "warn")
 
     # imports before the clock starts: interpreter + jax import cost is
     # identical for cold and warm processes and would only dilute the
     # startup ratio the smoke stage asserts on
-    from gatekeeper_tpu.analysis import footprint, shardplan, transval
+    from gatekeeper_tpu.analysis import (compilesurface, footprint,
+                                         shardplan, transval)
     from gatekeeper_tpu.ops import regex_dfa
     from gatekeeper_tpu.client.client import Backend
     from gatekeeper_tpu.client.interface import QueryOpts
@@ -126,6 +132,8 @@ def main() -> int:
         "footprints": footprint.analyses_run,
         "shardplans": shardplan.analyses_run,
         "dfa_compiles": regex_dfa.compiles_run,
+        "compile_surfaces": compilesurface.analyses_run,
+        "aot_precompiles": compilesurface.precompiles_run,
     }
     print(json.dumps(out))
     return 0

@@ -73,7 +73,8 @@ class SnapshotStats:
                "sp_hits", "sp_misses",
                "pg_hits", "pg_misses",
                "dfa_hits", "dfa_misses",
-               "ro_hits", "ro_misses", "corrupt_discarded",
+               "ro_hits", "ro_misses",
+               "cs_hits", "cs_misses", "corrupt_discarded",
                "saves", "save_errors")
 
     def __init__(self):
@@ -443,6 +444,33 @@ def save_shardplan(digest: str, plan) -> bool:
     return _write_entry("sp", f"sp:{digest}", payload)
 
 
+def load_compilesurface(digest: str):
+    """Tenth tier: Stage-7 compile-surface certificates
+    (analysis/compilesurface.py), keyed by program cache_key +
+    pad-geometry version + ladder caps — plus the AOT-precompile
+    geometry stamps JaxDriver.precompile writes under ``aot:`` keys.
+    A warm restart reuses both: zero surface analyses AND zero AOT
+    executable compiles at startup (smoke's ``compile_surfaces`` /
+    ``aot_precompiles`` == 0 warm)."""
+    if not enabled():
+        return None
+    got = _read_entry("cs", f"cs:{digest}")
+    stats.bump("cs_hits" if got is not None else "cs_misses")
+    return got
+
+
+def save_compilesurface(digest: str, cert) -> bool:
+    if not enabled():
+        return False
+    try:
+        payload = dumps(cert)
+    except Exception as e:   # noqa: BLE001
+        stats.bump("save_errors")
+        _log.warning("compile surface not snapshottable", error=e)
+        return False
+    return _write_entry("cs", f"cs:{digest}", payload)
+
+
 def load_dfa(digest: str):
     """Eighth tier: compiled regex byte-DFA tables (ops/regex_dfa),
     keyed by the pattern + DFA_VERSION digest.  A warm restart that
@@ -551,12 +579,12 @@ def tier_counts(s: dict) -> tuple[int, int]:
             + s["store_hits"] + s.get("cert_hits", 0)
             + s.get("fp_hits", 0) + s.get("sp_hits", 0)
             + s.get("pg_hits", 0) + s.get("dfa_hits", 0)
-            + s.get("ro_hits", 0))
+            + s.get("ro_hits", 0) + s.get("cs_hits", 0))
     misses = (s["ir_misses"] + s["mod_misses"] + s["plan_misses"]
               + s["store_misses"] + s.get("cert_misses", 0)
               + s.get("fp_misses", 0) + s.get("sp_misses", 0)
               + s.get("pg_misses", 0) + s.get("dfa_misses", 0)
-              + s.get("ro_misses", 0))
+              + s.get("ro_misses", 0) + s.get("cs_misses", 0))
     return hits, misses
 
 

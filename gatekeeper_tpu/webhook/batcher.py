@@ -87,6 +87,8 @@ class MicroBatcher:
                  prefetch: Callable[[list[dict]], None] | None = None,
                  capacity: int | None = None,
                  predict_seconds: Callable[[int], float | None]
+                 | None = None,
+                 certified_rungs: Callable[[], list[int] | None]
                  | None = None):
         self.evaluate_batch = evaluate_batch
         self.max_batch = max_batch
@@ -108,6 +110,15 @@ class MicroBatcher:
         # reviews, None while uncalibrated): batch formation shrinks the
         # batch until the prediction fits the tightest member deadline
         self.predict_seconds = predict_seconds
+        # Stage-7 certified batch rungs (compile-surface certificates):
+        # batch sizes whose padded review signature is provably inside
+        # the certified surface.  Deadline shrinking steps along these
+        # rungs — halving 50 -> 25 keeps the same padded signature
+        # (bucket 32) and re-predicts the same latency, while stepping
+        # 50 -> 32 -> 16 actually changes the executable the cost model
+        # priced.  None (stage off / surface unbounded / no certs yet)
+        # falls back to blind halving.
+        self.certified_rungs = certified_rungs
         self._queue: list[_Pending] = []
         self._lock = threading.Lock()
         self._wake = threading.Condition(self._lock)
@@ -226,18 +237,35 @@ class MicroBatcher:
                 p.event.set()
         return take
 
+    def _rungs(self) -> list[int] | None:
+        """Certified batch rungs (ascending), or None for the halving
+        fallback.  Advisory: a broken provider must not shed."""
+        if self.certified_rungs is None:
+            return None
+        try:
+            rungs = self.certified_rungs()
+        except Exception:   # noqa: BLE001
+            return None
+        if not rungs:
+            return None
+        return sorted({int(r) for r in rungs if int(r) >= 1})
+
     def _fit_to_deadline(self, take: list[_Pending]) -> list[_Pending]:
         """Shrink the batch until the cost-model-predicted evaluation
         latency fits the tightest member deadline (PR-5 static cost
         model, continuously re-calibrated by PR-9 attribution) —
         predicted-over-budget members beyond the cut stay queued for
-        the next, smaller, batch.  No-op while uncalibrated."""
+        the next, smaller, batch.  No-op while uncalibrated.  With
+        Stage-7 certificates installed the shrink steps down the
+        certified rung ladder (each step changes the padded signature
+        the cost model priced); otherwise it halves blindly."""
         if self.predict_seconds is None or len(take) <= 1:
             return take
         deadlines = [p.deadline for p in take if p.deadline is not None]
         if not deadlines:
             return take
         budget = min(deadlines) - time.monotonic()
+        rungs = self._rungs()
         n = len(take)
         while n > 1:
             try:
@@ -246,7 +274,15 @@ class MicroBatcher:
                 return take     # a broken predictor must not shed
             if pred is None or pred <= budget:
                 break
-            n = max(1, n // 2)
+            if rungs is not None:
+                below = [r for r in rungs if r < n]
+                n = below[-1] if below else 1
+                self.metrics.counter(
+                    "admission_batch_rung_shrinks",
+                    "deadline shrinks stepped along certified "
+                    "compile-surface rungs").inc()
+            else:
+                n = max(1, n // 2)
         if n == len(take):
             return take
         self.metrics.counter(

@@ -138,3 +138,27 @@ class TestExitCodeContract:
     def test_certify_unloadable_input_exits_two(self, tmp_path):
         from gatekeeper_tpu.client.probe import main
         assert main(["--certify", str(tmp_path / "missing.yaml")]) == 2
+
+    def test_compilesurface_certified_and_seeded_unbounded(
+            self, tmp_path, monkeypatch, capsys):
+        from gatekeeper_tpu.analysis import compilesurface
+        from gatekeeper_tpu.client.probe import main
+        monkeypatch.setattr(compilesurface, "_memo", {})
+        monkeypatch.setattr(compilesurface, "surfaces", {})
+        monkeypatch.setattr(compilesurface, "unbounded", {})
+        good = _write_template(tmp_path, "ok.yaml", "ProbeOk", GOOD_REGO)
+        assert main(["--compilesurface", good]) == 0
+        out = capsys.readouterr().out
+        assert "1 certified" in out and "0 unbounded" in out
+        # the deterministic unbounded seam (same trick transval uses)
+        # must surface as the error tier of the contract
+        monkeypatch.setattr(compilesurface, "_memo", {})
+        monkeypatch.setenv("GATEKEEPER_CS_TEST_UNBOUNDED", "ProbeOk")
+        assert main(["--compilesurface", good]) == 2
+        err = capsys.readouterr().err
+        assert "compile_surface_unbounded" in err
+
+    def test_compilesurface_unloadable_input_exits_two(self, tmp_path):
+        from gatekeeper_tpu.client.probe import main
+        assert main(["--compilesurface",
+                     str(tmp_path / "missing.yaml")]) == 2
