@@ -37,6 +37,13 @@ python -m gatekeeper_tpu.analysis.selflint --rebind gatekeeper_tpu/engine gateke
 # functions — any of these dispatches signatures the certifier cannot
 # enumerate
 python -m gatekeeper_tpu.analysis.selflint --retrace gatekeeper_tpu/engine gatekeeper_tpu/ir gatekeeper_tpu/enforce gatekeeper_tpu/ops
+# alloc-discipline self-lint (the static twin of the Stage-8 memory-
+# surface certificate): no fresh device-buffer construction
+# (jnp.zeros/ones/full/empty/arange, device_put of freshly built host
+# values) in steady-state serve paths — buffers are built in
+# build/rebuild seams and reused; anything else needs an explicit
+# `# allocs-ok: <reason>` waiver
+python -m gatekeeper_tpu.analysis.selflint --allocs gatekeeper_tpu/engine gatekeeper_tpu/enforce gatekeeper_tpu/webhook gatekeeper_tpu/client
 
 echo "== certify (translation validation over the library) =="
 # Stage-4 translation validation: bounded-model Rego<->IR equivalence
@@ -106,6 +113,28 @@ echo "$CSF" | grep -q " 0 unbounded" \
   || { echo "compilesurface stage found unbounded surfaces" >&2; exit 1; }
 echo "$CSF" | grep -Eq "(4[5-9]|[5-9][0-9]|[0-9]{3,}) certified" \
   || { echo "compilesurface stage certified < 45 templates" >&2; exit 1; }
+
+echo "== memsurface (Stage-8 memory-surface certificates) =="
+# Stage-8 memory-surface certifier: every device-lowered template's
+# conservative peak-HBM claim must fit the installed budget, and the
+# claims are validated (not trusted) against the bytes actually
+# materialized at a small world — an under-claiming certificate is an
+# error.  rc=1 is the expected warning tier (the scalar pin); rc=2 (a
+# budget violation, under-claim, or analyzer error) fails the build,
+# and the library must keep >= 45 of its 49 templates certified.
+MS_RC=0
+MS=$(JAX_PLATFORMS=cpu GATEKEEPER_HBM_BUDGET=strict timeout -k 10 240 \
+     python -m gatekeeper_tpu.client.probe --memsurface --library \
+     | tail -3) || MS_RC=$?
+echo "$MS"
+[ "$MS_RC" -le 1 ] \
+  || { echo "memsurface stage failed (rc=$MS_RC)" >&2; exit 1; }
+echo "$MS" | grep -q " 0 over budget" \
+  || { echo "memsurface stage found budget violations" >&2; exit 1; }
+echo "$MS" | grep -q " 0 under-claimed" \
+  || { echo "memsurface stage found under-claiming certificates" >&2; exit 1; }
+echo "$MS" | grep -Eq "(4[5-9]|[5-9][0-9]|[0-9]{3,}) certified" \
+  || { echo "memsurface stage certified < 45 templates" >&2; exit 1; }
 
 echo "== whatif (shadow / replay / fleet parity probe) =="
 # What-if engine self-check: a shadow (live ∪ candidate) sweep must be
@@ -258,6 +287,10 @@ assert cold["aot_precompiles"] > 0, \
 assert warm["aot_precompiles"] == 0, \
     f"warm run repeated the startup AOT compile storm instead of " \
     f"honoring the cs-tier geometry stamp: {warm}"
+assert cold["memsurfaces"] > 0, \
+    f"cold run never certified a memory surface (stage-8 off?): {cold}"
+assert warm["memsurfaces"] == 0, \
+    f"warm run re-ran Stage-8 memory-surface analysis: {warm}"
 print(f"restart smoke ok: startup cold {cold['startup_seconds']}s -> "
       f"warm {warm['startup_seconds']}s; "
       f"{warm['restart_persistent_cache_hits']} snapshot hits, "
@@ -427,6 +460,15 @@ cfs = d.get("compile_surface")
 assert isinstance(cfs, dict) and cfs.get("ok") is True \
     and cfs.get("uncertified", 1) == 0, \
     f"no clean compile_surface row in the trailing headline: {d}"
+# the mem_surface row must survive the window: the Stage-8 certified
+# peak must dominate the measured live-buffer high-water within a 3x
+# band, and the certificate-driven devpages spill ladder must stay
+# bit-identical to the always-resident oracle under a tiny budget
+msf = d.get("mem_surface")
+assert isinstance(msf, dict) and msf.get("ok") is True \
+    and msf.get("within_band") is True \
+    and msf.get("spill_parity") is True, \
+    f"no clean mem_surface row in the trailing headline: {d}"
 # the promotion row must survive the window: the rollout evidence
 # gate's batched corpus replay must beat the scalar replay oracle by
 # >=3x with bit-identical sha256 verdict digests, the controller must
@@ -458,6 +500,7 @@ print("bench ok:", d["metric"], round(d["value"], 1), d["unit"],
       f"{pm.get('digest')} -> {pm.get('final_rung')} with "
       f"{pm.get('fleet_graduated')} clusters graduated; "
       f"compile surface {cfs.get('certified')} certified, "
-      f"{cfs.get('uncertified')} uncertified retraces)")
+      f"{cfs.get('uncertified')} uncertified retraces; mem surface "
+      f"ratio {msf.get('ratio')} spill parity {msf.get('spill_parity')})")
 EOF
 echo "CI PASS"

@@ -345,6 +345,40 @@ def reset_calibration() -> None:
         _cal_samples.clear()
 
 
+# Static prior for the uncalibrated window: weighted units the
+# reference transport retires per second, anchored on the round-3
+# live-device steady sweep (~674M row-evals/s; a unit is roughly one
+# weighted op over one padded cell, so the same order of magnitude).
+# Deliberately conservative (slow-side) — an over-predicting prior
+# shrinks a deadline-pressed first batch, which is the safe direction;
+# the first attribution sample replaces it entirely.
+_PRIOR_UNITS_PER_SECOND = 2.5e8
+
+
+def prior_scale() -> float:
+    """Seconds-per-unit assumed before calibration
+    (GATEKEEPER_COST_PRIOR_UPS overrides the units-per-second
+    anchor; <=0 disables the prior)."""
+    try:
+        ups = float(os.environ.get("GATEKEEPER_COST_PRIOR_UPS",
+                                   _PRIOR_UNITS_PER_SECOND))
+    except ValueError:
+        ups = _PRIOR_UNITS_PER_SECOND
+    return 1.0 / ups if ups > 0 else 0.0
+
+
+def effective_scale() -> float:
+    """The scale predictions should actually use: the fitted
+    seconds-per-unit once attribution samples exist, the static prior
+    until then.  Before this, ``predict_review_batch_seconds``
+    returned None for the whole uncalibrated window, so the
+    micro-batcher's deadline shrinking silently no-opped on exactly
+    the batches most likely to blow a deadline — the very first ones,
+    compiling cold."""
+    s = current_scale()
+    return s if s > 0.0 else prior_scale()
+
+
 # ---------------------------------------------------------------------------
 # install-time budget gate
 

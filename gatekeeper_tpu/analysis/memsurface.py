@@ -1,0 +1,520 @@
+"""Stage-8 memory-surface certifier: static peak-HBM accounting.
+
+Stages 4-7 certify verdicts, read-sets, sharding, and the compile
+surface.  None of them bounds *device memory*: a policy set that fits
+at install can OOM mid-sweep once bound constants (DFA tables, the
+interner byte matrix), per-kind binding arrays, devpages page state,
+and SSA intermediates stack up across the whole installed set.  An
+OOM discovered at sweep time is the worst possible failure mode — the
+engine is already serving traffic.
+
+This stage closes the hole statically.  An abstract interpreter over
+the lowered spec and SSA program computes one :class:`MemorySurface`
+certificate per template:
+
+  * **bound arrays** — every binding the prep layer can materialize
+    (the same static enumeration the Stage-7 certifier composes over),
+    as byte polynomials over the pad-geometry axis classes of
+    :func:`ir.prep.binding_dim_classes` ('r'/'c'/'t'/'e') with
+    install-time static dims resolved where statically known (DFA
+    state counts via ``ops/regex_dfa``, the interner byte width) and
+    conservatively defaulted otherwise;
+  * **SSA intermediates** — per-node value+defined pairs with
+    op-class liveness (a node's buffer lives from its definition to
+    its last use; rule conjuncts pin their nodes to the final reduce),
+    the per-program-point live sums kept symbolically so the peak is
+    evaluated at any geometry;
+  * **devpages residency** — the resident mask (old + new during the
+    delta swap), the on-device page table, and the bounded
+    ``(idx, signs)`` delta staging stream;
+  * **per-shard totals** — resource-axis terms divide across the
+    PR-11 PartitionPlan shard count (``bytes_at(..., n_shards=N)``).
+
+``peak = resident + max-over-points(intermediates) + devpages`` is an
+*over-approximation contract*: the certificate must never claim less
+than the measured live-buffer high-water (validated on CPU against
+``jax.live_arrays`` in tests, and against the actually-built binding
+arrays by ``probe --memsurface``).  The worst-signature headline
+evaluates the polynomial at the Stage-8 deployment caps
+(``GATEKEEPER_MS_MAX_*`` — deliberately smaller than the Stage-7
+compile-surface caps: those bound what may ever be *compiled*, these
+bound what the fleet is *sized* to hold resident at once).
+
+The install gate: ``GATEKEEPER_HBM_BUDGET=off|warn|strict`` with
+``GATEKEEPER_HBM_BUDGET_BYTES`` (default 16 GiB).  A template whose
+worst-signature peak exceeds the budget raises
+``hbm_budget_exceeded`` (strict rejects the install into
+``status.byPod[].errors``; warn counts and serves).  Certificates
+persist as the eleventh snapshot tier ``ms`` so a warm restart
+re-runs zero analyses.
+
+Three consumers make the certificate load-bearing: the devpages
+residency planner sizes its LRU resident set from the certified page
+bytes (``enforce/devpages.ResidencyPlanner``), the webhook
+micro-batcher caps batch formation at the largest certified rung
+whose signature fits the remaining budget, and the audit sweep orders
+kind dispatch so concurrent in-flight footprints stay under budget.
+
+``GATEKEEPER_MEMSURFACE_TEST_UNDER=<Kind>`` is the deterministic test
+seam: the analyzer deliberately under-claims for that kind (bypassing
+memo and snapshot), proving end-to-end that the validation harness
+catches an unsound certificate.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import os
+
+from gatekeeper_tpu.utils.log import logger
+
+log = logger("memsurface")
+
+MS_VERSION = "ms-1"
+
+# fresh analyses this process (mirrors compilesurface.analyses_run):
+# the restart smoke asserts a warm process re-analyzes nothing
+analyses_run = 0
+
+_memo: dict[str, "MemorySurface"] = {}
+
+# kind -> most recently published certificate
+surfaces: dict[str, "MemorySurface"] = {}
+
+# kind -> human reason, for templates whose worst-signature peak
+# exceeds the installed budget
+over_budget: dict[str, str] = {}
+
+
+def mode() -> str:
+    """off | warn | strict.  ``warn`` (default) certifies at install
+    and *counts* budget breaches but serves anyway; ``strict``
+    additionally rejects any install whose worst-signature peak
+    exceeds ``GATEKEEPER_HBM_BUDGET_BYTES`` (``hbm_budget_exceeded``
+    into ``status.byPod[].errors``); ``off`` disables the stage."""
+    return os.environ.get("GATEKEEPER_HBM_BUDGET", "warn").strip().lower()
+
+
+DEFAULT_BUDGET_BYTES = 16 << 30         # one v5e chip's HBM
+
+
+def budget_bytes() -> int:
+    try:
+        return int(os.environ.get("GATEKEEPER_HBM_BUDGET_BYTES",
+                                  DEFAULT_BUDGET_BYTES))
+    except ValueError:
+        return DEFAULT_BUDGET_BYTES
+
+
+# Stage-8 deployment-geometry caps: the *resident* geometry the fleet
+# is sized for, deliberately far below the Stage-7 compile-surface
+# caps (GATEKEEPER_CS_MAX_ROWS=1<<22 bounds what may ever be compiled;
+# a [c, r] mask at that geometry alone is 16 GiB — certifying "the
+# worst compilable signature fits" would reject every budget).  The
+# worst-signature headline and the install gate evaluate here.
+_CAP_DEFAULTS = {
+    "r": ("GATEKEEPER_MS_MAX_ROWS", 1 << 16),
+    "c": ("GATEKEEPER_MS_MAX_CONSTRAINTS", 1 << 6),
+    "t": ("GATEKEEPER_MS_MAX_TABLE", 1 << 14),
+    "e": ("GATEKEEPER_MS_MAX_ELEMS", 1 << 4),
+}
+
+# conservative default for a static dim whose install-time size is not
+# statically derivable from the template alone (constraint-set pad
+# lengths, parametric-table value counts: they depend on the installed
+# constraint parameters) — resolved exactly when the caller passes the
+# built shapes
+DEFAULT_STATIC_DIM = 64
+
+
+def _cap(cls: str) -> int:
+    name, dflt = _CAP_DEFAULTS[cls]
+    try:
+        return int(os.environ.get(name, dflt))
+    except ValueError:
+        return dflt
+
+
+def _caps_sig() -> tuple:
+    return tuple((cls, _cap(cls)) for cls in sorted(_CAP_DEFAULTS))
+
+
+def cap_dims() -> dict:
+    """The worst-signature evaluation point: every pad axis at its
+    Stage-8 deployment cap."""
+    return {cls: _cap(cls) for cls in _CAP_DEFAULTS}
+
+
+@dataclasses.dataclass(frozen=True)
+class MemorySurface:
+    """One template's certified memory surface.
+
+    ``bindings`` is the bound-array byte model: one entry per
+    materializable array as ``(name, dims, itemsize)`` where each dim
+    is an axis-class char ('r'/'c'/'t'/'e') or a resolved static int.
+    ``points`` is the intermediate-liveness model: per program point,
+    the live SSA terms as ``((axes, per_elem_bytes), ...)`` with axes
+    a subset-tuple of ('c','r','e').  ``has_r`` marks a resource axis
+    (the devpages residency terms apply).  All byte queries go through
+    :meth:`bytes_at`; nothing here is pre-evaluated, so one
+    certificate serves every geometry, shard count, and budget."""
+
+    kind: str
+    digest: str
+    bounded: bool
+    reason: str | None
+    bindings: tuple          # ((name, (dim, ...), itemsize), ...)
+    points: tuple            # (((axes, per_elem_bytes), ...), ...)
+    has_r: bool
+    scalar_pin: bool = False
+    version: str = MS_VERSION
+
+    # -- evaluation -------------------------------------------------
+
+    def _dim(self, d, dims: dict) -> int:
+        if isinstance(d, str):
+            return int(dims.get(d, _cap(d)))
+        return int(d) if d else DEFAULT_STATIC_DIM
+
+    def resident_bytes(self, dims: dict, shapes: dict | None = None,
+                       n_shards: int = 1) -> int:
+        """Bound-array bytes at a geometry.  ``shapes`` (name -> shape
+        tuple of the actually-built arrays) overrides the model where
+        present — exact static dims, exact pads.  Resource-axis arrays
+        divide across ``n_shards`` (ceil: padding replicates)."""
+        total = 0
+        for name, dcls, itemsize in self.bindings:
+            if shapes is not None and name in shapes:
+                n = 1
+                for v in shapes[name]:
+                    n *= int(v)
+                nbytes = n * itemsize
+                sharded = any(isinstance(d, str) and d == "r"
+                              for d in dcls)
+            else:
+                n = 1
+                sharded = False
+                for d in dcls:
+                    n *= self._dim(d, dims)
+                    sharded = sharded or d == "r"
+                nbytes = n * itemsize
+            if sharded and n_shards > 1:
+                nbytes = -(-nbytes // n_shards)
+            total += nbytes
+        return total
+
+    def transient_bytes(self, dims: dict, n_shards: int = 1) -> int:
+        """Peak live SSA-intermediate bytes: the max over program
+        points of the live value+defined pairs.  Every intermediate
+        carries the full evaluation lattice, so all terms shard along
+        the resource axis when present."""
+        peak = 0
+        for terms in self.points:
+            live = 0
+            for axes, per_elem in terms:
+                n = per_elem
+                for ax in axes:
+                    n *= self._dim(ax, dims)
+                if n_shards > 1 and "r" in axes:
+                    n = -(-n // n_shards)
+                live += n
+            peak = max(peak, live)
+        return peak
+
+    def devpages_bytes(self, dims: dict, delta_k: int | None = None,
+                       n_shards: int = 1) -> int:
+        """Devpages residency terms: the resident mask twice (old and
+        new coexist across the delta swap), the on-device page table,
+        and the compact (idx, signs) delta staging stream at width
+        ``delta_k`` (its ladder cap when unspecified)."""
+        if not self.has_r:
+            return 0
+        c = self._dim("c", dims)
+        r = self._dim("r", dims)
+        masks = 2 * c * r * 1                    # old + new bool masks
+        pt = r * 4                               # int32 page table
+        if n_shards > 1:
+            masks = -(-masks // n_shards)
+            pt = -(-pt // n_shards)
+        if delta_k is None:
+            delta_k = c * r                      # the overflow cap
+        return masks + pt + delta_k * 5          # idx int32 + signs bool
+
+    def peak_bytes(self, dims: dict | None = None,
+                   shapes: dict | None = None,
+                   delta_k: int | None = None,
+                   n_shards: int = 1,
+                   devpages: bool = True) -> int:
+        """The certificate's bottom line: conservative peak live bytes
+        for one sweep of this template at a geometry.  ``dims``
+        defaults to the Stage-8 caps (the worst certified signature);
+        pass the actual pads (and ``shapes``) to evaluate a live
+        deployment."""
+        if self.scalar_pin:
+            return 0
+        dims = dims if dims is not None else cap_dims()
+        total = self.resident_bytes(dims, shapes=shapes,
+                                    n_shards=n_shards)
+        total += self.transient_bytes(dims, n_shards=n_shards)
+        if devpages:
+            total += self.devpages_bytes(dims, delta_k=delta_k,
+                                         n_shards=n_shards)
+        return total
+
+
+def surface_digest(lowered) -> str:
+    """Certificate key: program cache_key + pad-geometry version +
+    Stage-8 caps.  Any geometry or model change invalidates persisted
+    certificates by key mismatch."""
+    from gatekeeper_tpu.analysis import footprint
+    from gatekeeper_tpu.ir import prep as _prep
+    return hashlib.sha256(repr((
+        MS_VERSION, _prep.PAD_GEOMETRY_VERSION, _caps_sig(),
+        repr(lowered.program.cache_key()),
+        repr(footprint._spec_sig(lowered.spec)),
+    )).encode()).hexdigest()
+
+
+# ---------------------------------------------------------------------------
+# the byte model: bound arrays
+
+
+# per-row bytes by column mode (matches the dtypes build_bindings
+# materializes: num/len -> .v float32 + .p bool; str/val -> int32 ids,
+# counted at full width even when the narrow-transfer path ships them
+# smaller — over-approximation is the contract; present/truthy -> bool)
+_MODE_BYTES = {"num": 5, "len": 5, "str": 4, "val": 4,
+               "present": 1, "truthy": 1}
+
+
+def _dfa_states(pattern: str) -> int:
+    """Exact DFA state count for a constant pattern — the one static
+    dim that is fully derivable from the template alone."""
+    from gatekeeper_tpu.ops import regex_dfa
+    dfa = regex_dfa.compile_dfa(pattern)
+    if dfa is None:
+        return 0
+    return int(len(dfa.accept))
+
+
+def _spec_bindings(spec) -> list[tuple]:
+    """The bound-array byte model: every array build_bindings can
+    materialize for this spec, as (name, dims, itemsize) with dims the
+    axis-class chars of ir/prep.binding_dim_classes and static dims
+    resolved where the template alone determines them (0 = unknown,
+    defaulted conservatively at evaluation)."""
+    out: list[tuple] = [
+        ("__alive__", ("r",), 1),
+        ("__match__", ("c", "r"), 1),
+        ("__rank__", ("r",), 4),
+        ("__pagetable__", ("r",), 4),
+        # build_bindings materializes the constraint-validity column
+        # unconditionally (all-valid when no cvalid_fns)
+        ("__cvalid__", ("c",), 1),
+    ]
+    for ax, _base in getattr(spec, "axes", ()):
+        out.append((f"__elem__:{ax}", ("r", "e"), 1))
+    for r in getattr(spec, "r_cols", ()):
+        out.append((r.name, ("r",), _MODE_BYTES.get(r.mode, 5)))
+    for r in getattr(spec, "e_cols", ()):
+        out.append((r.name, ("r", "e"), _MODE_BYTES.get(r.mode, 5)))
+    for r in getattr(spec, "tables", ()):
+        out.append((f"{r.name}.ok", ("t",), 1))
+        out.append((f"{r.name}.v", ("t",), 4))
+    for r in getattr(spec, "ptables", ()):
+        out.append((f"{r.name}.any", ("c", 0), 1))
+        out.append((f"{r.name}.all", ("c", 0), 1))
+        out.append((f"{r.name}.vmap", ("t",), 4))
+    for r in getattr(spec, "csets", ()):
+        out.append((r.name, ("c", 0), 1))
+        out.append((f"{r.name}.vmap", ("t",), 4))
+    for r in getattr(spec, "cvals", ()):
+        out.append((r.name, ("c",), 5))
+    for r in getattr(spec, "membs", ()):
+        out.append((r.name, (0, "r"), 1))
+    for r in getattr(spec, "elem_keys", ()):
+        out.append((r.name, (0, "r", "e"), 1))
+    for r in getattr(spec, "keyed_vals", ()):
+        out.append((f"{r.name}.kv", (0, "r"), 4))
+        out.append((f"{r.name}.sel", ("c",), 4))
+    for r in getattr(spec, "inv_joins", ()):
+        # the host-built r_bool column plus the in-jit join input
+        # records the devpages path stages (src/inv/sel/names, int32)
+        out.append((r.name, ("r",), 1))
+        for part in ("src", "inv", "sel", "names"):
+            out.append((f"r:ij.{r.name}.{part}", ("r",), 4))
+    for r in getattr(spec, "dfas", ()):
+        s = _dfa_states(r.pattern)
+        out.append((f"{r.name}.trans", (s, 256), 4))
+        out.append((f"{r.name}.accept", (s,), 1))
+        out.append((f"{r.name}.xv", ("t",), 1))
+    if getattr(spec, "dfas", ()):
+        from gatekeeper_tpu.store.interner import Interner
+        width = Interner().max_str_len
+        out.append(("__strbytes__", ("t", width), 1))
+        out.append(("__strdfaok__", ("t",), 1))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the byte model: SSA intermediates via op-class liveness
+
+
+# ops whose value array is wider than a bool mask (float32/int32);
+# everything else evaluates to a bool value.  The defined mask is a
+# bool beside either.
+_WIDE_OPS = frozenset({"const", "input", "table", "keyed_val",
+                       "arith", "count_e"})
+
+
+def _node_points(program) -> list[tuple]:
+    """Per-program-point live intermediate terms under last-use
+    liveness.  A node's (defined, value) pair materializes at its
+    definition point and frees after its last consumer; rule conjuncts
+    stay live through the final reduce, which also carries the output
+    violation mask.  Dead (unreachable) nodes never allocate."""
+    from gatekeeper_tpu.analysis.costmodel import (node_axes,
+                                                   reachable_nodes)
+    axes = node_axes(program)
+    reach = reachable_nodes(program)
+    n = len(program.nodes)
+    last_use = {}
+    for i in sorted(reach):
+        last_use[i] = i
+        for a in program.nodes[i].args:
+            if a in last_use:
+                last_use[a] = max(last_use[a], i)
+    for rule in program.rules:
+        for ci in rule.conjuncts:
+            if ci in last_use:
+                last_use[ci] = n                # live through the reduce
+    points: list[tuple] = []
+    live: dict[int, tuple] = {}
+    for i in sorted(reach):
+        c, r, e = axes[i]
+        ax = tuple(s for s, on in (("c", c), ("r", r), ("e", e)) if on)
+        per_elem = (4 if program.nodes[i].op in _WIDE_OPS else 1) + 1
+        live[i] = (ax, per_elem)
+        points.append(tuple(t for j, t in sorted(live.items())
+                            if last_use[j] >= i))
+        live = {j: t for j, t in live.items() if last_use[j] > i}
+    # the final reduce: every conjunct mask AND the [c, r] output
+    final = [t for j, t in sorted(live.items())]
+    final.append((("c", "r"), 1))
+    points.append(tuple(final))
+    return points
+
+
+def _test_under_kinds() -> frozenset:
+    raw = os.environ.get("GATEKEEPER_MEMSURFACE_TEST_UNDER", "")
+    return frozenset(k for k in raw.split(",") if k)
+
+
+def analyze(kind: str, lowered) -> MemorySurface:
+    """The Stage-8 abstract interpretation: compose the bound-array
+    byte model with the liveness-based intermediate model into one
+    symbolic certificate.  The TEST_UNDER seam deliberately drops the
+    intermediates and scales every binding down 64x — an unsound
+    under-claim the validation harness must catch."""
+    digest = surface_digest(lowered)
+    bindings = tuple(_spec_bindings(lowered.spec))
+    if kind in _test_under_kinds():
+        # itemsize 0: the seeded certificate claims (nearly) nothing
+        shrunk = tuple((name, dcls, 0) for name, dcls, _it in bindings)
+        return MemorySurface(
+            kind=kind, digest=digest, bounded=True,
+            reason="deliberately under-claimed (test seam)",
+            bindings=shrunk, points=(), has_r=True)
+    points = tuple(_node_points(lowered.program))
+    has_r = any("r" in [d for d in dcls if isinstance(d, str)]
+                for _nm, dcls, _it in bindings)
+    return MemorySurface(
+        kind=kind, digest=digest, bounded=True, reason=None,
+        bindings=bindings, points=points, has_r=has_r)
+
+
+def scalar_surface(kind: str) -> MemorySurface:
+    """The trivial certificate of a scalar-pinned template: no device
+    program, no device bytes — vacuously within any budget."""
+    return MemorySurface(
+        kind=kind, digest=f"scalar:{kind}", bounded=True, reason=None,
+        bindings=(), points=(), has_r=False, scalar_pin=True)
+
+
+# ---------------------------------------------------------------------------
+# memoized entry point + the budget verdict
+
+
+def certify(kind: str, compiled, lowered) -> MemorySurface:
+    """Memoized/snapshot-backed entry point the engine and probe use.
+    Certificates persist in the snapshot "ms" tier, so a warm restart
+    re-runs zero analyses.  The TEST_UNDER seam bypasses memo and
+    snapshot — the deliberately unsound certificate must reach the
+    caller, not a cached honest one."""
+    global analyses_run
+    digest = surface_digest(lowered)
+    seam = kind in _test_under_kinds()
+    if not seam:
+        cached = _memo.get(digest)
+        if cached is not None:
+            _publish(kind, cached)
+            return cached
+        from gatekeeper_tpu.resilience import snapshot as _snap
+        hit = _snap.load_memsurface(digest)     # 1-tuple or None
+        if hit is not None and isinstance(hit[0], MemorySurface) \
+                and hit[0].version == MS_VERSION:
+            _memo[digest] = hit[0]
+            _publish(kind, hit[0])
+            return hit[0]
+
+    cert = analyze(kind, lowered)
+    analyses_run += 1
+    if not seam:
+        _memo[digest] = cert
+        from gatekeeper_tpu.resilience import snapshot as _snap
+        _snap.save_memsurface(digest, cert)
+    _publish(kind, cert)
+    return cert
+
+
+def _publish(kind: str, cert: MemorySurface) -> None:
+    surfaces[kind] = cert
+    reason = budget_reason(cert)
+    if reason is None:
+        over_budget.pop(kind, None)
+    else:
+        over_budget[kind] = reason
+
+
+def budget_reason(cert: MemorySurface) -> str | None:
+    """The ``hbm_budget_exceeded`` verdict: non-None when the
+    certificate's worst-signature peak exceeds the installed budget."""
+    if cert.scalar_pin:
+        return None
+    peak = cert.peak_bytes()
+    budget = budget_bytes()
+    if peak <= budget:
+        return None
+    return (f"hbm_budget_exceeded: worst-signature peak "
+            f"{peak / (1 << 20):.0f} MiB exceeds the "
+            f"{budget / (1 << 20):.0f} MiB budget "
+            f"(GATEKEEPER_HBM_BUDGET_BYTES)")
+
+
+def surface_for(kind: str) -> MemorySurface | None:
+    """The most recently published certificate for a kind, or None
+    when not yet analyzed."""
+    return surfaces.get(kind)
+
+
+def policy_set_bytes(dims: dict | None = None,
+                     certs: dict | None = None) -> int:
+    """Roll the per-template peaks up to the whole installed set: the
+    sum of every certificate's peak at a geometry — templates coexist
+    on device (the identity-keyed binding caches keep every kind's
+    arrays resident across a sweep), so the set-level claim is the
+    sum, not the max."""
+    certs = certs if certs is not None else surfaces
+    return sum(c.peak_bytes(dims) for c in certs.values()
+               if isinstance(c, MemorySurface))

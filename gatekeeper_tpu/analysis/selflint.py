@@ -125,6 +125,26 @@ _LOCK_BLOCKING_QUALIFIED = {("time", "sleep")}
 _REBIND_ATTRS = {"arrays", "base_dirty", "mask", "page_table", "ij_dev"}
 _DICT_MUTATORS = {"update", "setdefault", "pop", "clear", "popitem"}
 
+# alloc-discipline rule set (--allocs): array constructors that, at
+# the HOST layer, materialize a fresh device buffer per call.  Inside
+# a jit trace the same spellings are XLA ops fused into the compiled
+# program (and the Stage-8 memory surface has already priced them), so
+# kernel roots are exempt; build/rebuild seams construct buffers by
+# design and are exempt by name; everything else — the steady-state
+# serve paths — must reuse ping-pong/recycled buffers, or carry an
+# explicit `# allocs-ok: <reason>` waiver on the line.
+_ALLOC_CONSTRUCTORS = {"zeros", "ones", "full", "empty", "arange",
+                       "zeros_like", "ones_like", "full_like"}
+_ALLOC_MODULE_PREFIXES = (("jnp",), ("jax", "numpy"))
+_ALLOC_DEVICE_PUT = (("jax", "device_put"), ("device_put",))
+# a function whose name carries one of these substrings is a
+# build/rebuild seam: constructing device state is its job
+_ALLOC_SEAM_MARKERS = ("build", "init", "rebuild", "prewarm", "warm",
+                       "prepare", "restore", "expand", "adopt",
+                       "migrate", "scatter", "put", "upload", "stage",
+                       "precompile", "compile")
+_ALLOC_WAIVER = "allocs-ok:"
+
 # retrace-hazard rule set (--retrace): host->device conversion calls
 # that bake per-trace constants when they appear inside the trace
 _RETRACE_CONVERT = {
@@ -318,6 +338,77 @@ def _bakes_host_value(call: ast.Call) -> bool:
         return False
     return not isinstance(call.args[0],
                           (ast.Name, ast.Attribute, ast.Subscript))
+
+
+def _alloc_seam(name: str) -> bool:
+    low = name.lower()
+    return any(m in low for m in _ALLOC_SEAM_MARKERS)
+
+
+def _is_alloc_call(call: ast.Call) -> str | None:
+    """Dotted name of a fresh-device-buffer construction, or None."""
+    d = _dotted(call.func)
+    if d is None:
+        return None
+    if len(d) >= 2 and d[-1] in _ALLOC_CONSTRUCTORS \
+            and d[:-1] in _ALLOC_MODULE_PREFIXES:
+        return ".".join(d)
+    if d in _ALLOC_DEVICE_PUT and _bakes_host_value(call):
+        return ".".join(d)
+    return None
+
+
+def _own_nodes(fn: ast.AST):
+    """Nodes lexically owned by *fn*, pruning nested function defs
+    (each nested def is judged under its own name by the caller)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        n = stack.pop()
+        yield n
+        if not isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            stack.extend(ast.iter_child_nodes(n))
+
+
+def _lint_allocs_tree(tree: ast.Module, path: str) -> list[str]:
+    try:
+        with open(path, encoding="utf-8") as fh:
+            lines = fh.read().splitlines()
+    except OSError:
+        lines = []
+    # exempt kernel roots (traced: constructors are XLA ops, priced by
+    # the Stage-8 memory surface) and build/rebuild seams, including
+    # any helper defined lexically inside either
+    exempt: set[int] = set()
+    for root in _kernel_roots(tree):
+        for sub in ast.walk(root):
+            exempt.add(id(sub))
+    for fn in ast.walk(tree):
+        if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and _alloc_seam(fn.name):
+            for sub in ast.walk(fn):
+                exempt.add(id(sub))
+    findings: list[str] = []
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if id(fn) in exempt:
+            continue
+        for sub in _own_nodes(fn):
+            if not isinstance(sub, ast.Call):
+                continue
+            desc = _is_alloc_call(sub)
+            if desc is None:
+                continue
+            # waiver comment on the call line or the line above it
+            span = lines[max(0, sub.lineno - 2):sub.lineno]
+            if any(_ALLOC_WAIVER in ln for ln in span):
+                continue
+            findings.append(
+                f"{path}:{sub.lineno}: fresh device buffer "
+                f"{desc}() in serve-path function {fn.name!r} "
+                f"(move to a build seam, reuse a recycled buffer, "
+                f"or waive with '# allocs-ok: <reason>')")
+    return findings
 
 
 def _lint_retrace_tree(tree: ast.Module, path: str) -> list[str]:
@@ -637,20 +728,30 @@ def lint_retrace_paths(paths: list[str]) -> list[str]:
     return _lint_files(paths, _lint_retrace_tree)
 
 
+def lint_allocs_paths(paths: list[str]) -> list[str]:
+    return _lint_files(paths, _lint_allocs_tree)
+
+
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     locks = "--locks" in argv
     lockorder = "--lockorder" in argv
     rebind = "--rebind" in argv
     retrace = "--retrace" in argv
+    allocs = "--allocs" in argv
     argv = [a for a in argv if a not in ("--locks", "--lockorder",
-                                         "--rebind", "--retrace")]
+                                         "--rebind", "--retrace",
+                                         "--allocs")]
     if not argv:
         print("usage: python -m gatekeeper_tpu.analysis.selflint "
-              "[--locks|--lockorder|--rebind|--retrace] <dir-or-file>...",
+              "[--locks|--lockorder|--rebind|--retrace|--allocs] "
+              "<dir-or-file>...",
               file=sys.stderr)
         return 2
-    if retrace:
+    if allocs:
+        findings = lint_allocs_paths(argv)
+        kind_msg = "fresh device-buffer alloc(s) in serve paths"
+    elif retrace:
         findings = lint_retrace_paths(argv)
         kind_msg = "retrace hazard(s) in kernel-side code"
     elif locks:

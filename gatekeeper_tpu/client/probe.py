@@ -929,6 +929,125 @@ def run_compilesurface(paths: list[str], use_library: bool = False) -> int:
     return _severity_rc(n_unbounded + errs["n"], n_pin)
 
 
+def run_memsurface(paths: list[str], use_library: bool = False) -> int:
+    """``--memsurface``: Stage-8 memory-surface certification
+    (analysis/memsurface.py) over template files and/or the built-in
+    library.  For each device-lowered template, print the certified
+    worst-signature peak and resident footprint against the installed
+    HBM budget; a peak past the budget is an error-severity
+    ``hbm_budget_exceeded`` finding, and scalar-fallback templates are
+    reported as pinned (no device program, zero device bytes).
+
+    Claimed certificates are validated, not trusted: the probe builds
+    the real Bindings for each template at a small world
+    (``GATEKEEPER_MS_PROBE_N``, default 64 resources) and checks
+    per-array that the certificate's claim at the exact built shapes
+    dominates the bytes actually materialized — a certificate that
+    under-claims any array (the ``GATEKEEPER_MEMSURFACE_TEST_UNDER``
+    seam seeds one deliberately) is an error-severity
+    ``memsurface_underclaim`` finding.  Exit contract
+    (:func:`_severity_rc`): 2 on any budget violation, under-claim, or
+    unloadable input, 1 when some template is pinned, 0 fully
+    certified within budget."""
+    import os as _os
+    import random
+    import sys
+    import time as _time
+
+    import numpy as np
+
+    from gatekeeper_tpu.analysis import memsurface
+    from gatekeeper_tpu.analysis.transval import _world_state
+    from gatekeeper_tpu.ir.prep import build_bindings
+    from gatekeeper_tpu.library import make_mixed
+
+    work = _load_work(paths, use_library)
+    if work is None:
+        return 2
+    t0 = _time.perf_counter()
+    errs = {"n": 0}
+    n_cert = n_over = n_pin = n_under = 0
+    probe_n = int(_os.environ.get("GATEKEEPER_MS_PROBE_N", "64"))
+    st, _rows, _handler = _world_state(make_mixed(random.Random(13),
+                                                  probe_n))
+    budget = memsurface.budget_bytes()
+    certs: dict = {}
+    # build_bindings packs value+presence (".v"/".p") and constraint-set
+    # (".B"/".bitmap") companions under one modeled base name
+    suffixes = (".v", ".p", ".B", ".bitmap")
+    for kind, compiled, lowered, cdocs in _compile_work(work, errs):
+        if lowered is None:
+            n_pin += 1
+            certs[kind] = memsurface.scalar_surface(kind)
+            print(f"  pin  {kind}: scalar fallback (host-evaluated, "
+                  "no device bytes to certify)")
+            continue
+        try:
+            cert = memsurface.analyze(kind, lowered)
+        except Exception as e:          # noqa: BLE001
+            errs["n"] += 1
+            print(f"  FAIL {kind}: analyzer error: {e}", file=sys.stderr)
+            continue
+        certs[kind] = cert
+        # ---- validate: claimed bytes must dominate the built arrays
+        under: list[str] = []
+        try:
+            bindings = build_bindings(lowered.spec, st.table, cdocs)
+        except Exception as e:          # noqa: BLE001
+            errs["n"] += 1
+            print(f"  FAIL {kind}: bindings build error: {e}",
+                  file=sys.stderr)
+            continue
+        model_item: dict[str, int] = {}
+        for name, _dcls, itemsize in cert.bindings:
+            model_item[name] = max(model_item.get(name, 0), itemsize)
+        grouped: dict[str, list] = {}
+        for aname, arr in bindings.arrays.items():
+            mname = aname
+            if mname not in model_item:
+                for suf in suffixes:
+                    base = aname[:-len(suf)] if aname.endswith(suf) else None
+                    if base and base in model_item:
+                        mname = base
+                        break
+            grouped.setdefault(mname, []).append(arr)
+        for mname, arrs in sorted(grouped.items()):
+            built = sum(int(a.nbytes) for a in arrs)
+            if mname not in model_item:
+                under.append(f"{mname} unmodeled ({built} B built)")
+                continue
+            claimed = model_item[mname] * max(
+                int(np.prod(a.shape)) for a in arrs)
+            if claimed < built:
+                under.append(f"{mname} claims {claimed} B < "
+                             f"{built} B built")
+        if under:
+            n_under += 1
+            print(f"  FAIL {kind}: memsurface_underclaim — "
+                  + "; ".join(under[:3]), file=sys.stderr)
+            continue
+        peak = cert.peak_bytes()
+        dims = {"c": bindings.c_pad, "r": bindings.r_pad}
+        resident = cert.resident_bytes(
+            dims, shapes={k: a.shape for k, a in bindings.arrays.items()})
+        reason = memsurface.budget_reason(cert)
+        if reason is not None:
+            n_over += 1
+            print(f"  FAIL {kind}: {reason}", file=sys.stderr)
+            continue
+        n_cert += 1
+        print(f"  ok   {kind}: peak {peak / (1 << 20):.1f} MiB @ worst "
+              f"signature, {resident / (1 << 20):.2f} MiB resident "
+              f"@ n={probe_n}")
+    set_bytes = memsurface.policy_set_bytes(certs=certs)
+    wall = _time.perf_counter() - t0
+    print(f"memsurface: {len(certs)} template(s), {n_cert} certified, "
+          f"{n_over} over budget, {n_under} under-claimed, {n_pin} "
+          f"pinned; policy set {set_bytes / (1 << 30):.2f} GiB of "
+          f"{budget / (1 << 30):.0f} GiB budget in {wall:.1f}s")
+    return _severity_rc(n_over + n_under + errs["n"], n_pin)
+
+
 def run_whatif() -> int:
     """``--whatif``: self-validate the what-if engine's four parity
     contracts over the built-in library (ROADMAP item 5) —
@@ -1457,6 +1576,8 @@ def _run_subcommand(argv: list[str]) -> int | None:
             rest, use_library=use_library)),
         ("--compilesurface", lambda rest: run_compilesurface(
             rest, use_library=use_library)),
+        ("--memsurface", lambda rest: run_memsurface(
+            rest, use_library=use_library)),
         ("--pages", lambda rest: run_pages(
             rest, use_library=use_library)),
         ("--lint", lambda rest: run_lint(
@@ -1474,7 +1595,8 @@ def main(argv=None) -> int:
     ``--builtins`` lists the builtin registry instead of probing;
     ``--lint <template.yaml>... [--library]`` runs the static-analysis
     pass, ``--certify`` the Stage-4 translation validator, and
-    ``--compilesurface`` the Stage-7 compile-surface certifier instead;
+    ``--compilesurface`` the Stage-7 compile-surface certifier, and
+    ``--memsurface`` the Stage-8 memory-surface certifier instead;
     analysis subcommands share one exit contract: 0 clean, 1 warnings
     only, 2 any error-severity finding or unreadable input.
 

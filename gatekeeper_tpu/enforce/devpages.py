@@ -36,6 +36,19 @@ from typing import Any
 
 import numpy as np
 
+
+def residency_budget_bytes() -> int | None:
+    """GATEKEEPER_DEVPAGES_BUDGET_BYTES: HBM the resident verdict
+    masks may claim per kind.  None (default) = unbounded — every page
+    stays device-resident, exactly the pre-Stage-8 behavior."""
+    raw = os.environ.get("GATEKEEPER_DEVPAGES_BUDGET_BYTES")
+    if not raw:
+        return None
+    try:
+        return int(raw)
+    except ValueError:
+        return None
+
 DELTA_K_MIN = 256
 """Smallest compiled width of the compact delta stream."""
 
@@ -94,6 +107,7 @@ class KindPages:
     ij_host: dict = dataclasses.field(default_factory=dict)
     #   the numpy twins the scatter staged from (identity-compared)
     geometry_adopted: bool = False
+    resident: Any = None          # ResidencyPlanner under a budget
 
     def geometry(self) -> dict:
         """Plain-data device-pagemap geometry for the pg snapshot tier:
@@ -130,7 +144,170 @@ def fresh_stats() -> dict:
             "delta_events": 0, "delta_overflows": 0,
             "rows_confirmed": 0, "direct_clears": 0,
             "inv_joins_device": 0, "geometry_adopted": 0,
-            "mask_builds": 0}
+            "mask_builds": 0,
+            "resident_spills": 0, "resident_restores": 0,
+            "resident_pages_device": 0}
+
+
+class ResidencyPlanner:
+    """Certificate-driven resident-set planner for one kind's verdict
+    mask (the ROADMAP item-4 spill ladder).
+
+    When the Stage-8 certificate's devpages claim at the ACTUAL sweep
+    geometry exceeds ``GATEKEEPER_DEVPAGES_BUDGET_BYTES``, the full
+    [c_pad, r_pad] resident mask no longer lives on device between
+    sweeps.  Instead the planner keeps an LRU-chosen *hot* subset of
+    pages in a compact device buffer of ``n_slots`` page-sized slots
+    (the largest power-of-two slot count whose bytes fit the budget —
+    the slot ladder), spills cold pages' bits to a pinned host mirror,
+    and reconstructs the exact full mask on demand before the next
+    delta sweep: hot pages scatter back from the slot buffer, spilled
+    pages restore through the existing row-scatter path
+    (``veval.ProgramExecutor._scatter_rows``), and never-violating
+    pages are zeros by the over-approximation contract.  Freed slots
+    are reused in place when the working set shifts.  ``expand`` after
+    ``store`` is bit-identical to the always-resident mask by
+    construction — the parity tests force a tiny budget and diff
+    against the unbudgeted oracle.
+
+    Inactive (``active`` False) whenever the claim fits the budget:
+    zero overhead, ``kp.mask`` holds the full mask exactly as before.
+    """
+
+    def __init__(self, budget: int, c_pad: int, r_pad: int,
+                 page_rows: int, cert=None):
+        self.budget = int(budget)
+        self.c_pad = int(c_pad)
+        self.r_pad = int(r_pad)
+        self.page_rows = max(int(page_rows), 1)
+        self.n_pages = -(-self.r_pad // self.page_rows)
+        dims = {"c": self.c_pad, "r": self.r_pad}
+        if cert is not None and getattr(cert, "has_r", False):
+            claim = cert.devpages_bytes(dims, delta_k=0)
+        else:
+            claim = 2 * self.c_pad * self.r_pad + 4 * self.r_pad
+        self.active = claim > self.budget
+        page_bytes = self.c_pad * self.page_rows
+        n_slots = 1
+        while (n_slots * 2 * page_bytes <= self.budget
+               and n_slots * 2 < self.n_pages):
+            n_slots *= 2
+        self.n_slots = n_slots
+        self.slot_of: dict[int, int] = {}     # page -> slot
+        self.free: list[int] = list(range(n_slots - 1, -1, -1))
+        self.lru: list[int] = []              # pages, most-recent last
+        self.dev_buf = None                   # [c_pad, n_slots*page_rows]
+        self.host_mask = np.zeros((self.c_pad, self.r_pad), dtype=bool)
+        self.spilled: set[int] = set()        # pages living host-side
+        self.spilled_any: set[int] = set()    # spilled pages with a bit
+        self.has_mask = False
+        self.spills = 0                       # pages spilled to host
+        self.restores = 0                     # pages restored to device
+
+    def compatible(self, c_pad: int, r_pad: int, page_rows: int) -> bool:
+        return (self.c_pad == c_pad and self.r_pad == r_pad
+                and self.page_rows == max(int(page_rows), 1))
+
+    def holds(self, c_pad: int, r_pad: int) -> bool:
+        """True when expand() can reproduce a stored full mask at this
+        geometry."""
+        return (self.active and self.has_mask
+                and self.c_pad == c_pad and self.r_pad == r_pad)
+
+    def _page_rows_abs(self, page: int) -> np.ndarray:
+        lo = page * self.page_rows
+        rows = np.arange(lo, lo + self.page_rows, dtype=np.int64)
+        # the tail page pads by repeating the last real row: gather
+        # duplicates read one bit twice, scatter duplicates write the
+        # same bit twice — bit-identity holds either way
+        return np.minimum(rows, self.r_pad - 1)
+
+    def touch(self, pages) -> None:
+        """LRU bump: these pages were involved in the current sweep."""
+        for p in sorted(pages):
+            if 0 <= p < self.n_pages:
+                if p in self.lru:
+                    self.lru.remove(p)
+                self.lru.append(p)
+
+    def store(self, new_mask) -> None:
+        """Adopt a freshly computed full mask: keep the ``n_slots``
+        most-recently-touched pages in the device slot buffer, spill
+        the rest to the host mirror, release the full-size device
+        array."""
+        import jax.numpy as jnp
+        hot = self._hot_pages()
+        # free slots of pages leaving the hot set (reused below)
+        for p in [p for p in self.slot_of if p not in hot]:
+            self.free.append(self.slot_of.pop(p))
+        for p in hot:
+            if p not in self.slot_of:
+                self.slot_of[p] = self.free.pop()
+        gather = np.empty((self.n_slots * self.page_rows,),
+                          dtype=np.int64)
+        # slots without a page gather row 0 (never expanded back)
+        gather[:] = 0
+        for p, s in self.slot_of.items():
+            gather[s * self.page_rows:(s + 1) * self.page_rows] = \
+                self._page_rows_abs(p)
+        self.dev_buf = jnp.take(new_mask, jnp.asarray(gather), axis=1)
+        cold = [p for p in range(self.n_pages) if p not in hot]
+        newly_spilled = [p for p in cold if p not in self.spilled]
+        self.spills += len(newly_spilled)
+        if cold:
+            rows = np.concatenate([self._page_rows_abs(p) for p in cold])
+            bits = np.asarray(jnp.take(new_mask,
+                                       jnp.asarray(rows), axis=1))
+            self.host_mask[:, rows] = bits
+            for j, p in enumerate(cold):
+                seg = bits[:, j * self.page_rows:(j + 1) * self.page_rows]
+                if seg.any():
+                    self.spilled_any.add(p)
+                else:
+                    self.spilled_any.discard(p)
+        self.spilled = set(cold)
+        self.has_mask = True
+
+    def expand(self, ex):
+        """Reconstruct the exact full [c_pad, r_pad] mask: hot pages
+        scatter back from the slot buffer, spilled non-zero pages
+        restore host->device through the executor's existing
+        row-scatter path, all-zero pages stay zeros."""
+        import jax.numpy as jnp
+        full = jnp.zeros((self.c_pad, self.r_pad), dtype=bool)
+        if self.slot_of:
+            rows = np.concatenate(
+                [self._page_rows_abs(p)
+                 for p in sorted(self.slot_of)])
+            idx = np.concatenate(
+                [np.arange(self.slot_of[p] * self.page_rows,
+                           (self.slot_of[p] + 1) * self.page_rows)
+                 for p in sorted(self.slot_of)])
+            full = full.at[:, rows].set(
+                jnp.take(self.dev_buf, jnp.asarray(idx), axis=1))
+        restore = sorted(self.spilled & self.spilled_any)
+        if restore:
+            rows = np.concatenate(
+                [self._page_rows_abs(p) for p in restore])
+            full = ex._scatter_rows("__resident__", full,
+                                    self.host_mask, rows, False, axis=1)
+            self.restores += len(restore)
+        return full
+
+    def _hot_pages(self) -> set[int]:
+        """The ``n_slots`` most-recently-touched pages (LRU order,
+        seeded with the lowest page indices before any touch)."""
+        hot: list[int] = []
+        for p in reversed(self.lru):
+            if len(hot) >= self.n_slots:
+                break
+            hot.append(p)
+        for p in range(self.n_pages):
+            if len(hot) >= self.n_slots:
+                break
+            if p not in hot:
+                hot.append(p)
+        return set(hot)
 
 
 def inv_join_binding_names(join_name: str) -> tuple[str, str, str, str]:

@@ -194,6 +194,9 @@ class JaxTargetState(TargetState):
         # kind -> Stage-7 compile-surface certificate
         # (analysis/compilesurface.py)
         self.compilesurfaces: dict[str, object] = {}
+        # kind -> Stage-8 memory-surface certificate
+        # (analysis/memsurface.py)
+        self.memsurfaces: dict[str, object] = {}
         # kind -> last device sweep payload + guards, for
         # footprint-driven selective invalidation (_selective_reuse)
         self.sweep_cache: dict[str, dict] = {}
@@ -488,6 +491,16 @@ class JaxDriver(LocalDriver):
                 st.compilesurfaces[kind] = cs_cert
             else:
                 st.compilesurfaces.pop(kind, None)
+            # stage 8 (memory surface): certifies the conservative
+            # peak-HBM bytes of every certified signature; the ms
+            # snapshot tier keeps warm restarts at zero re-analyses.
+            # Strict mode rejects installs whose worst-signature peak
+            # exceeds the budget (hbm_budget_exceeded).
+            ms_cert = self._memsurface_lowered(kind, compiled)
+            if ms_cert is not None:
+                st.memsurfaces[kind] = ms_cert
+            else:
+                st.memsurfaces.pop(kind, None)
             st.sweep_cache.pop(kind, None)
         st.templates[kind] = compiled
         st.bump(kind)
@@ -597,6 +610,44 @@ class JaxDriver(LocalDriver):
                 "compile surface unbounded; kind excluded from AOT "
                 "precompile and retrace gating", kind=kind,
                 reason=cert.reason)
+        return cert
+
+    def _memsurface_lowered(self, kind: str, compiled: CompiledTemplate):
+        """Stage-8 memory-surface certification (analysis/memsurface.py)
+        behind GATEKEEPER_HBM_BUDGET=off|warn|strict.  warn (default):
+        certify the conservative peak-HBM bytes and count budget
+        breaches but serve anyway; strict: a template whose
+        worst-signature peak exceeds GATEKEEPER_HBM_BUDGET_BYTES fails
+        the install with ``hbm_budget_exceeded`` — the reconciler
+        expands the VetError into status.byPod[].errors."""
+        from gatekeeper_tpu.analysis import memsurface
+        if memsurface.mode() == "off":
+            return None
+        if compiled.vectorized is None:
+            return memsurface.scalar_surface(kind)
+        try:
+            cert = memsurface.certify(kind, compiled, compiled.vectorized)
+        except Exception as e:   # noqa: BLE001 — analysis must not take
+            # template install down with it; no certificate just means
+            # no budget gating or residency planning for this kind
+            from gatekeeper_tpu.utils.log import logger
+            logger("engine.jax_driver").warning(
+                "memory-surface analysis errored", kind=kind, err=str(e))
+            self.metrics.counter("memsurface_errors").inc()
+            return None
+        reason = memsurface.budget_reason(cert)
+        if reason is not None:
+            self.metrics.counter("hbm_budget_exceeded").inc()
+            from gatekeeper_tpu.utils.log import logger
+            logger("engine.jax_driver").warning(
+                "memory surface exceeds HBM budget", kind=kind,
+                reason=reason)
+            if memsurface.mode() == "strict":
+                from gatekeeper_tpu.analysis.diagnostics import Diagnostic
+                from gatekeeper_tpu.errors import VetError
+                raise VetError([Diagnostic(code="hbm_budget_exceeded",
+                                           severity="error",
+                                           message=f"{kind}: {reason}")])
         return cert
 
     def _surface_guard(self, program, arrays,
@@ -1152,13 +1203,37 @@ class JaxDriver(LocalDriver):
             kp.page_rows = table.page_rows
             kp.n_pages = table.n_pages
         kp.free = tuple(table.free_slots())
-        mask_valid = (kp.mask is not None and kp.gen == ent.gen
+        # Stage-8 residency planning: under a devpages budget whose
+        # certified claim the full resident mask exceeds, the mask
+        # lives split across a hot device slot buffer and a host spill
+        # mirror (enforce/devpages.ResidencyPlanner) and is
+        # reconstructed bit-identically here before the delta sweep
+        planner = kp.resident
+        budget = _dvp.residency_budget_bytes()
+        if budget is None:
+            planner = kp.resident = None
+        elif planner is None \
+                or not planner.compatible(c_pad, r_pad, table.page_rows):
+            planner = _dvp.ResidencyPlanner(
+                budget, c_pad, r_pad, table.page_rows,
+                cert=st.memsurfaces.get(kind))
+            kp.resident = planner
+        planner_holds = planner is not None \
+            and planner.holds(c_pad, r_pad)
+        _rs_sp0 = planner.spills if planner is not None else 0
+        _rs_rs0 = planner.restores if planner is not None else 0
+        have_mask = planner_holds or (
+            kp.mask is not None
+            and tuple(kp.mask.shape) == (c_pad, r_pad))
+        mask_valid = (have_mask and kp.gen == ent.gen
                       and kp.remap == table.remap_generation
                       and kp.conver == conver
-                      and kp.c_pad == c_pad and kp.slots == r_pad
-                      and tuple(kp.mask.shape) == (c_pad, r_pad))
+                      and kp.c_pad == c_pad and kp.slots == r_pad)
         if refresh_only or not mask_valid:
+            # allocs-ok: cold rebuild after geometry/generation change
             old_mask = jnp.zeros((c_pad, r_pad), dtype=bool)
+        elif planner_holds:
+            old_mask = planner.expand(ex)
         else:
             old_mask = kp.mask
         ij_sig = tuple((req.name, bool(req.exclude_same_name))
@@ -1265,7 +1340,21 @@ class JaxDriver(LocalDriver):
                 - len({r // table.page_rows for r in involved}))
         else:
             dv["mask_builds"] += 1
-        kp.mask = new_mask
+        if planner is not None and planner.active:
+            # LRU bump the pages this sweep actually touched, then
+            # split the fresh mask across the slot buffer and the
+            # host spill mirror — the full-size device array is
+            # released (the certified resident claim is what stays)
+            if not refresh_only:
+                planner.touch({r // planner.page_rows
+                               for r in involved})
+            planner.store(new_mask)
+            kp.mask = None
+            dv["resident_spills"] += planner.spills - _rs_sp0
+            dv["resident_restores"] += planner.restores - _rs_rs0
+            dv["resident_pages_device"] += len(planner.slot_of)
+        else:
+            kp.mask = new_mask
         kp.gen = table.generation
         kp.remap = table.remap_generation
         kp.conver = conver
@@ -2157,7 +2246,85 @@ class JaxDriver(LocalDriver):
             8, compilesurface._cap("r")))
         if max_n is not None:
             rungs = [r for r in rungs if r <= max_n] or [1]
+        cap = self.memsurface_review_cap(target)
+        if cap is not None:
+            rungs = [r for r in rungs if r <= cap] or [1]
         return rungs
+
+    @locked_read
+    def memsurface_review_cap(self, target: str) -> int | None:
+        """Stage-8 consumer 2: the largest certified review-batch rung
+        whose worst per-kind dispatch footprint fits the HBM budget
+        left after the installed set's certified resident arrays.  A
+        review batch pads its mini-table to ``bucket(B)`` rows and
+        dispatches one kind at a time, so the in-flight claim is the
+        max (not sum) over installed kinds of the peak at that row
+        geometry.  None when the stage is off or nothing is certified
+        (the batcher then caps only by the Stage-7 rung ladder)."""
+        from gatekeeper_tpu.analysis import memsurface
+        from gatekeeper_tpu.ir import prep as _prep
+        if memsurface.mode() == "off":
+            return None
+        st = self.state.get(target)
+        if not isinstance(st, JaxTargetState):
+            return None
+        certs = [c for c in st.memsurfaces.values()
+                 if isinstance(c, memsurface.MemorySurface)
+                 and not c.scalar_pin]
+        if not certs:
+            return None
+        remaining = memsurface.budget_bytes() - sum(
+            c.resident_bytes(memsurface.cap_dims()) for c in certs)
+        if remaining <= 0:
+            return 1
+        rungs = [1] + list(_prep.bucket_ladder(
+            8, memsurface._cap("r")))
+        best = 1
+        for rung in rungs:
+            dims = memsurface.cap_dims()
+            dims["r"] = _prep.bucket(max(rung, 1))
+            claim = max(c.peak_bytes(dims, devpages=False)
+                        for c in certs)
+            if claim <= remaining:
+                best = rung
+            else:
+                break
+        return best
+
+    def memsurface_sweep_order(self, st, kinds: list[str]) -> list[str]:
+        """Stage-8 consumer 3: order full-sweep kind dispatch so
+        concurrent in-flight footprints stay under budget.  JAX
+        dispatch is async — while kind i's program drains, kind i+1's
+        uploads and intermediates are already materializing, so the
+        transient claim of *adjacent* kinds coexists.  Weaving the
+        certified-peak order (largest, smallest, second-largest, ...)
+        minimizes the worst adjacent-pair sum without changing the
+        result: phase-2 formatting re-sorts tagged results into a
+        total order, so any dispatch permutation is parity-safe on
+        the full path.  Falls back to sorted order when the stage is
+        off or any kind lacks a certificate (determinism over
+        cleverness)."""
+        from gatekeeper_tpu.analysis import memsurface
+        base = sorted(kinds)
+        if memsurface.mode() == "off" or len(base) < 3:
+            return base
+        peaks = {}
+        for k in base:
+            cert = st.memsurfaces.get(k)
+            if not isinstance(cert, memsurface.MemorySurface):
+                return base
+            peaks[k] = 0 if cert.scalar_pin else cert.peak_bytes()
+        ranked = sorted(base, key=lambda k: (-peaks[k], k))
+        woven: list[str] = []
+        lo, hi = 0, len(ranked) - 1
+        while lo <= hi:
+            woven.append(ranked[lo])
+            if lo != hi:
+                woven.append(ranked[hi])
+            lo += 1
+            hi -= 1
+        self.metrics.counter("memsurface_sweep_reorders").inc()
+        return woven
 
     def _shared_col(self, st, plan, kind: str, digest: str, bindings):
         """One shared conjunct's host column, page-partitioned ACROSS
@@ -2574,6 +2741,14 @@ class JaxDriver(LocalDriver):
                         dedup_plan = self._audit_dedup_plan(st, target)
                     _prep_done("__axes_and_plan__", _tk)
                     _sweep_kinds = sorted(st.templates)
+                    if full and trace is None and not self.scalar_only:
+                        # Stage-8 consumer 3: dispatch order packs
+                        # adjacent in-flight footprints under budget;
+                        # parity-safe here because phase 2 re-sorts
+                        # tagged results into a total order (pages/
+                        # ledger kinds only occur when not full)
+                        _sweep_kinds = self.memsurface_sweep_order(
+                            st, _sweep_kinds)
                     for _kind_i, kind in enumerate(_sweep_kinds):
                         # fault injection: kill the backend mid-sweep
                         # (after the first kind when there are several)
@@ -3153,11 +3328,14 @@ class JaxDriver(LocalDriver):
         """Cost-model-predicted wall seconds for a review batch of size
         ``n_reviews`` against the installed constraint set — the PR-5
         static cost vector priced by the PR-9 calibrated seconds-per-unit
-        scale.  None while uncalibrated (no attribution samples yet) —
-        callers (deadline-aware batch sizing, overload ladder) must treat
-        None as "no opinion", never as zero."""
+        scale, seeded with the static prior while uncalibrated
+        (costmodel.effective_scale) so deadline-aware batch shrinking
+        has an opinion from the very first batch.  None only when there
+        is nothing to predict (no reviews, prior disabled and no
+        samples) — callers must treat None as "no opinion", never as
+        zero."""
         from gatekeeper_tpu.analysis import costmodel
-        scale = costmodel.current_scale()
+        scale = costmodel.effective_scale()
         if scale <= 0.0 or n_reviews <= 0:
             return None
         st = self._state(target)

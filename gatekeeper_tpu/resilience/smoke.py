@@ -17,8 +17,9 @@ translation-validation Certificate restored from the cert snapshot
 tier instead of re-derived), ``footprints == 0`` (every Stage-5
 dependency footprint restored from the fp snapshot tier instead of
 re-analyzed), ``shardplans == 0`` (every Stage-6 partition plan
-restored from the sp snapshot tier), an identical
-``verdict_digest``, and
+restored from the sp snapshot tier), ``memsurfaces == 0`` (every
+Stage-8 memory-surface certificate restored from the ms snapshot
+tier), an identical ``verdict_digest``, and
 a substantially smaller ``serving_seconds`` — ci.sh's restart-smoke
 stage asserts exactly that.  The workload is deterministic
 (seeded RNG), so cold and warm evaluate the same inventory whether it
@@ -65,12 +66,15 @@ def main() -> int:
     # skip the startup AOT compile storm via the cs-tier geometry stamp
     # ("aot_precompiles" == 0)
     os.environ.setdefault("GATEKEEPER_COMPILE_SURFACE", "warn")
+    # and for the Stage-8 memory surfaces: the warm process must load
+    # every certificate from the ms tier ("memsurfaces" == 0)
+    os.environ.setdefault("GATEKEEPER_HBM_BUDGET", "warn")
 
     # imports before the clock starts: interpreter + jax import cost is
     # identical for cold and warm processes and would only dilute the
     # startup ratio the smoke stage asserts on
     from gatekeeper_tpu.analysis import (compilesurface, footprint,
-                                         shardplan, transval)
+                                         memsurface, shardplan, transval)
     from gatekeeper_tpu.ops import regex_dfa
     from gatekeeper_tpu.client.client import Backend
     from gatekeeper_tpu.client.interface import QueryOpts
@@ -134,6 +138,7 @@ def main() -> int:
         "dfa_compiles": regex_dfa.compiles_run,
         "compile_surfaces": compilesurface.analyses_run,
         "aot_precompiles": compilesurface.precompiles_run,
+        "memsurfaces": memsurface.analyses_run,
     }
     print(json.dumps(out))
     return 0

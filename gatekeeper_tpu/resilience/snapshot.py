@@ -74,7 +74,8 @@ class SnapshotStats:
                "pg_hits", "pg_misses",
                "dfa_hits", "dfa_misses",
                "ro_hits", "ro_misses",
-               "cs_hits", "cs_misses", "corrupt_discarded",
+               "cs_hits", "cs_misses",
+               "ms_hits", "ms_misses", "corrupt_discarded",
                "saves", "save_errors")
 
     def __init__(self):
@@ -471,6 +472,31 @@ def save_compilesurface(digest: str, cert) -> bool:
     return _write_entry("cs", f"cs:{digest}", payload)
 
 
+def load_memsurface(digest: str):
+    """Eleventh tier: Stage-8 memory-surface certificates
+    (analysis/memsurface.py), keyed by program cache_key +
+    pad-geometry version + MS deployment caps.  A warm restart
+    re-runs zero peak-HBM analyses (smoke's ``memsurfaces`` == 0
+    warm); a caps or geometry change invalidates by key mismatch."""
+    if not enabled():
+        return None
+    got = _read_entry("ms", f"ms:{digest}")
+    stats.bump("ms_hits" if got is not None else "ms_misses")
+    return got
+
+
+def save_memsurface(digest: str, cert) -> bool:
+    if not enabled():
+        return False
+    try:
+        payload = dumps(cert)
+    except Exception as e:   # noqa: BLE001
+        stats.bump("save_errors")
+        _log.warning("memory surface not snapshottable", error=e)
+        return False
+    return _write_entry("ms", f"ms:{digest}", payload)
+
+
 def load_dfa(digest: str):
     """Eighth tier: compiled regex byte-DFA tables (ops/regex_dfa),
     keyed by the pattern + DFA_VERSION digest.  A warm restart that
@@ -579,12 +605,14 @@ def tier_counts(s: dict) -> tuple[int, int]:
             + s["store_hits"] + s.get("cert_hits", 0)
             + s.get("fp_hits", 0) + s.get("sp_hits", 0)
             + s.get("pg_hits", 0) + s.get("dfa_hits", 0)
-            + s.get("ro_hits", 0) + s.get("cs_hits", 0))
+            + s.get("ro_hits", 0) + s.get("cs_hits", 0)
+            + s.get("ms_hits", 0))
     misses = (s["ir_misses"] + s["mod_misses"] + s["plan_misses"]
               + s["store_misses"] + s.get("cert_misses", 0)
               + s.get("fp_misses", 0) + s.get("sp_misses", 0)
               + s.get("pg_misses", 0) + s.get("dfa_misses", 0)
-              + s.get("ro_misses", 0) + s.get("cs_misses", 0))
+              + s.get("ro_misses", 0) + s.get("cs_misses", 0)
+              + s.get("ms_misses", 0))
     return hits, misses
 
 

@@ -213,7 +213,15 @@ class MicroBatcher:
         """Pop up to max_batch live entries under the lock, dropping
         withdrawn and already-expired entries first — an expired entry
         would be evaluated for a caller whose apiserver already gave
-        up, pure wasted device time under overload."""
+        up, pure wasted device time under overload.  With certificates
+        installed, formation additionally caps at the top certified
+        rung — the rung ladder is already clipped to the largest batch
+        whose Stage-8 memory surface fits the remaining HBM budget, so
+        a batch that would blow the budget is never even formed."""
+        cap = self.max_batch
+        rungs = self._rungs()
+        if rungs is not None and rungs[-1] < cap:
+            cap = rungs[-1]
         take: list[_Pending] = []
         rest: list[_Pending] = []
         expired: list[_Pending] = []
@@ -223,7 +231,12 @@ class MicroBatcher:
             if p.deadline is not None and p.deadline <= now:
                 expired.append(p)
                 continue
-            (take if len(take) < self.max_batch else rest).append(p)
+            (take if len(take) < cap else rest).append(p)
+        if rest and cap < self.max_batch:
+            self.metrics.counter(
+                "admission_batch_budget_caps",
+                "batch formations truncated at the largest certified "
+                "rung fitting the HBM budget").inc()
         self._queue = rest
         self._gauge_depth(len(rest))
         if expired:
@@ -255,7 +268,10 @@ class MicroBatcher:
         latency fits the tightest member deadline (PR-5 static cost
         model, continuously re-calibrated by PR-9 attribution) —
         predicted-over-budget members beyond the cut stay queued for
-        the next, smaller, batch.  No-op while uncalibrated.  With
+        the next, smaller, batch.  The predictor is seeded with the
+        static cost-model prior (costmodel.effective_scale), so
+        shrinking has an opinion from the very first batch — it no
+        longer no-ops through the uncalibrated window.  With
         Stage-7 certificates installed the shrink steps down the
         certified rung ladder (each step changes the padded signature
         the cost model priced); otherwise it halves blindly."""
